@@ -1,0 +1,145 @@
+"""Resource arithmetic semantics tests.
+
+Ports the *behavioral cases* of the reference's
+``pkg/scheduler/api/resource_info_test.go`` (574 LoC): epsilon-tolerant
+LessEqual, Sub assertions, IsEmpty quanta, FitDelta, Diff.
+"""
+
+import pytest
+
+from volcano_tpu.api import (
+    CPU,
+    MEMORY,
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    Resource,
+    res_min,
+    share,
+)
+
+
+def R(cpu=0.0, mem=0.0, **scalars):
+    return Resource(cpu, mem, scalars or None)
+
+
+class TestLessEqual:
+    def test_zero_fits_zero(self):
+        assert R().less_equal(R())
+
+    def test_within_cpu_epsilon(self):
+        # |l - r| < 10 milli passes.
+        assert R(cpu=1009, mem=0).less_equal(R(cpu=1000, mem=0))
+        assert not R(cpu=1010, mem=0).less_equal(R(cpu=1000, mem=0))
+
+    def test_within_memory_epsilon(self):
+        m = 10 * 1024 * 1024
+        assert R(mem=1000 + m - 1).less_equal(R(mem=1000))
+        assert not R(mem=1000 + m).less_equal(R(mem=1000))
+
+    def test_scalar_below_quantum_skipped(self):
+        # Scalars requesting <= 10 milli always pass, even vs nothing.
+        assert R(**{"nvidia.com/gpu": 10}).less_equal(R())
+        assert not R(**{"nvidia.com/gpu": 1000}).less_equal(R())
+
+    def test_scalar_epsilon(self):
+        gpu = "nvidia.com/gpu"
+        assert Resource(0, 0, {gpu: 1009}).less_equal(Resource(0, 0, {gpu: 1000}))
+        assert not Resource(0, 0, {gpu: 1010}).less_equal(Resource(0, 0, {gpu: 1000}))
+
+    def test_nil_scalars_pass(self):
+        assert R(cpu=500, mem=100).less_equal(R(cpu=1000, mem=1000))
+
+
+class TestLess:
+    def test_strict(self):
+        assert R(cpu=1, mem=1).less(R(cpu=2, mem=2))
+        assert not R(cpu=2, mem=1).less(R(cpu=2, mem=2))
+
+    def test_scalar_nil_receiver(self):
+        # l has no scalars; r has a scalar above quantum -> less holds.
+        assert R(cpu=1, mem=1).less(Resource(2, 2, {"x": 100}))
+        # r scalar below quantum -> not less.
+        assert not R(cpu=1, mem=1).less(Resource(2, 2, {"x": 5}))
+
+
+class TestIsEmpty:
+    def test_empty(self):
+        assert R().is_empty()
+        assert R(cpu=9.999).is_empty()
+        assert R(mem=MIN_MEMORY - 1).is_empty()
+        assert Resource(0, 0, {"g": 9}).is_empty()
+
+    def test_not_empty(self):
+        assert not R(cpu=MIN_MILLI_CPU).is_empty()
+        assert not R(mem=MIN_MEMORY).is_empty()
+        assert not Resource(0, 0, {"g": 10}).is_empty()
+
+
+class TestArithmetic:
+    def test_add(self):
+        r = R(cpu=100, mem=200, g=300)
+        r.add(R(cpu=10, mem=20, g=30))
+        assert r.milli_cpu == 110 and r.memory == 220
+        assert r.scalars["g"] == 330
+
+    def test_sub_ok(self):
+        r = R(cpu=100, mem=200, g=300)
+        r.sub(R(cpu=50, mem=100, g=100))
+        assert r.milli_cpu == 50 and r.memory == 100 and r.scalars["g"] == 200
+
+    def test_sub_insufficient_asserts(self):
+        with pytest.raises(AssertionError):
+            R(cpu=10).sub(R(cpu=100))
+
+    def test_sub_within_epsilon_allowed(self):
+        # LessEqual's epsilon lets Sub go slightly negative: load-bearing.
+        r = R(cpu=100)
+        r.sub(R(cpu=109))
+        assert r.milli_cpu == -9
+
+    def test_multi(self):
+        r = R(cpu=100, mem=200, g=50).multi(1.5)
+        assert r.milli_cpu == 150 and r.memory == 300 and r.scalars["g"] == 75
+
+    def test_fit_delta(self):
+        r = R(cpu=100, mem=MIN_MEMORY * 3)
+        r.fit_delta(R(cpu=50, mem=MIN_MEMORY))
+        assert r.milli_cpu == 100 - 50 - MIN_MILLI_CPU
+        assert r.memory == MIN_MEMORY * 3 - MIN_MEMORY - MIN_MEMORY
+
+    def test_diff(self):
+        inc, dec = R(cpu=100, mem=50).diff(R(cpu=40, mem=80))
+        assert inc.milli_cpu == 60 and inc.memory == 0
+        assert dec.milli_cpu == 0 and dec.memory == 30
+
+    def test_set_max(self):
+        r = R(cpu=10, mem=100)
+        r.set_max_resource(R(cpu=5, mem=200, g=7))
+        assert r.milli_cpu == 10 and r.memory == 200 and r.scalars["g"] == 7
+
+
+class TestHelpers:
+    def test_min(self):
+        m = res_min(R(cpu=10, mem=50), R(cpu=20, mem=30))
+        assert m.milli_cpu == 10 and m.memory == 30
+
+    def test_share(self):
+        assert share(0, 0) == 0.0
+        assert share(5, 0) == 1.0
+        assert share(5, 10) == 0.5
+
+
+class TestParsing:
+    def test_from_resource_list_strings(self):
+        r = Resource.from_resource_list(
+            {"cpu": "2", "memory": "1Gi", "pods": "110", "nvidia.com/gpu": "1"}
+        )
+        assert r.milli_cpu == 2000
+        assert r.memory == 1024**3
+        assert r.max_task_num == 110
+        assert r.scalars["nvidia.com/gpu"] == 1000
+
+    def test_from_resource_list_millis(self):
+        r = Resource.from_resource_list({"cpu": "500m", "memory": "512Mi"})
+        assert r.milli_cpu == 500
+        assert r.memory == 512 * 1024**2

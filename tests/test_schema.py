@@ -1,0 +1,126 @@
+"""Array schema / snapshot encoder tests: the device mirror must agree with
+the host data model."""
+
+import numpy as np
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    Taint,
+    TaskStatus,
+    Toleration,
+)
+from volcano_tpu.arrays import ResourceSlots, encode_cluster
+from volcano_tpu.cache import ClusterStore
+
+
+def make_cluster():
+    store = ClusterStore()
+    store.add_node(
+        Node(
+            name="n1",
+            allocatable={"cpu": "4", "memory": "8Gi", "pods": 110},
+            labels={"zone": "a"},
+        )
+    )
+    store.add_node(
+        Node(
+            name="n2",
+            allocatable={"cpu": "8", "memory": "16Gi", "pods": 110},
+            labels={"zone": "b"},
+            taints=[Taint(key="dedicated", value="ml", effect="NoSchedule")],
+        )
+    )
+    store.add_pod_group(PodGroup(name="pg1", min_member=2))
+    for i in range(3):
+        store.add_pod(
+            Pod(
+                name=f"p{i}",
+                annotations={GROUP_NAME_ANNOTATION: "pg1"},
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+                node_selector={"zone": "a"} if i == 0 else {},
+                tolerations=[
+                    Toleration(key="dedicated", operator="Equal", value="ml",
+                               effect="NoSchedule")
+                ]
+                if i == 2
+                else [],
+            )
+        )
+    return store
+
+
+def encode(store):
+    snap = store.snapshot()
+    job = snap.jobs["default/pg1"]
+    pending = sorted(
+        job.task_status_index[TaskStatus.Pending].values(), key=lambda t: t.name
+    )
+    return encode_cluster(snap, pending, ["default/pg1"])
+
+
+def test_encode_shapes_and_values():
+    arrays, maps = encode(make_cluster())
+    R = maps.slots.width
+    assert R == 2  # cpu, memory only
+    n1 = maps.node_index["n1"]
+    assert arrays.nodes.idle[n1, 0] == 4000
+    assert arrays.nodes.idle[n1, 1] == 8 * 1024**3
+    assert arrays.nodes.max_tasks[n1] == 110
+    assert arrays.nodes.real.sum() == 2
+    assert arrays.tasks.real.sum() == 3
+    assert arrays.jobs.min_available[0] == 2
+    # eps vector carries the Go quanta.
+    assert arrays.eps[0] == MIN_MILLI_CPU
+    assert arrays.eps[1] == MIN_MEMORY
+
+
+def test_label_bitsets_match_selectors():
+    arrays, maps = encode(make_cluster())
+    n1, n2 = maps.node_index["n1"], maps.node_index["n2"]
+    # p0 requires zone=a: its selector bits must be subset of n1's labels only.
+    p0 = maps.task_uids.index(
+        next(t.uid for t in maps.task_infos if t.name == "p0")
+    )
+    sel = arrays.tasks.sel_bits[p0]
+    assert arrays.tasks.has_selector[p0]
+    assert np.all((sel & ~arrays.nodes.label_bits[n1]) == 0)
+    assert not np.all((sel & ~arrays.nodes.label_bits[n2]) == 0)
+
+
+def test_taint_toleration_bits():
+    arrays, maps = encode(make_cluster())
+    n2 = maps.node_index["n2"]
+    # n2 has one gating taint bit.
+    assert arrays.nodes.taint_bits[n2].sum() > 0
+    p2 = maps.task_uids.index(
+        next(t.uid for t in maps.task_infos if t.name == "p2")
+    )
+    p1 = maps.task_uids.index(
+        next(t.uid for t in maps.task_infos if t.name == "p1")
+    )
+    # p2 tolerates the taint; p1 does not.
+    assert np.all((arrays.nodes.taint_bits[n2] & ~arrays.tasks.tol_bits[p2]) == 0)
+    assert not np.all(
+        (arrays.nodes.taint_bits[n2] & ~arrays.tasks.tol_bits[p1]) == 0
+    )
+
+
+def test_scalar_slots():
+    store = make_cluster()
+    store.add_node(
+        Node(name="g1", allocatable={"cpu": "4", "memory": "8Gi",
+                                     "nvidia.com/gpu": 8})
+    )
+    arrays, maps = encode(store)
+    assert maps.slots.width == 3
+    g1 = maps.node_index["g1"]
+    gpu_slot = maps.slots.index["nvidia.com/gpu"]
+    assert arrays.nodes.idle[g1, gpu_slot] == 8000
+    assert bool(arrays.scalar_slot[gpu_slot])
+    assert not bool(arrays.scalar_slot[0])

@@ -1,0 +1,136 @@
+"""Cluster store + snapshot tests.
+
+Mirrors the test pattern of the reference's cache tests
+(``pkg/scheduler/cache/event_handlers_test.go`` and the builder helpers in
+``pkg/scheduler/util/test_utils.go:33-92``): build pods/nodes/podgroups/queues
+through the event API and assert the derived accounting.
+"""
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    Queue,
+    TaskStatus,
+)
+from volcano_tpu.cache import ClusterStore, FakeBinder
+
+
+def build_pod(name, ns="default", group="pg1", cpu="1", mem="1Gi", phase=PodPhase.Pending, node=None):
+    return Pod(
+        name=name,
+        namespace=ns,
+        annotations={GROUP_NAME_ANNOTATION: group} if group else {},
+        containers=[{"cpu": cpu, "memory": mem}],
+        phase=phase,
+        node_name=node,
+    )
+
+
+def build_node(name, cpu="4", mem="8Gi", pods=110):
+    return Node(name=name, allocatable={"cpu": cpu, "memory": mem, "pods": pods})
+
+
+def test_default_queue_created():
+    store = ClusterStore()
+    assert "default" in store.queues
+    assert store.queues["default"].weight == 1
+
+
+def test_add_pod_builds_job_and_node_accounting():
+    store = ClusterStore(binder=FakeBinder())
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=2))
+    store.add_pod(build_pod("p1"))
+    store.add_pod(build_pod("p2", phase=PodPhase.Running, node="n1"))
+
+    job = store.jobs["default/pg1"]
+    assert len(job.tasks) == 2
+    assert job.min_available == 2
+    # Running pod holds node resources.
+    n1 = store.nodes["n1"]
+    assert n1.used.milli_cpu == 1000
+    assert n1.idle.milli_cpu == 3000
+
+
+def test_snapshot_is_deep_copy():
+    store = ClusterStore()
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    store.add_pod(build_pod("p1"))
+
+    snap = store.snapshot()
+    assert "default/pg1" in snap.jobs
+    # Mutating the snapshot must not touch the store.
+    snap.nodes["n1"].idle.milli_cpu = 0
+    assert store.nodes["n1"].idle.milli_cpu == 4000
+    snap_job = snap.jobs["default/pg1"]
+    task = next(iter(snap_job.tasks.values()))
+    snap_job.update_task_status(task, TaskStatus.Allocated)
+    stored_task = next(iter(store.jobs["default/pg1"].tasks.values()))
+    assert stored_task.status == TaskStatus.Pending
+
+
+def test_job_without_podgroup_not_in_snapshot():
+    store = ClusterStore()
+    store.add_pod(build_pod("p1", group="orphan-pg"))
+    snap = store.snapshot()
+    assert "default/orphan-pg" not in snap.jobs
+
+
+def test_bind_updates_store_and_binder():
+    binder = FakeBinder()
+    store = ClusterStore(binder=binder)
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    pod = build_pod("p1")
+    store.add_pod(pod)
+
+    job = store.jobs["default/pg1"]
+    task = next(iter(job.tasks.values()))
+    store.bind(task, "n1")
+
+    assert binder.binds == {"default/p1": "n1"}
+    # Pod now bound: node accounting reflects it.
+    assert store.nodes["n1"].used.milli_cpu == 1000
+    # Task status derives from pod state (Pending + node -> Bound).
+    assert store.jobs["default/pg1"].tasks[task.uid].status == TaskStatus.Bound
+
+
+def test_evict_marks_releasing():
+    store = ClusterStore()
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    pod = build_pod("p1", phase=PodPhase.Running, node="n1")
+    store.add_pod(pod)
+
+    task = next(iter(store.jobs["default/pg1"].tasks.values()))
+    store.evict(task, "preempt")
+    n1 = store.nodes["n1"]
+    assert n1.releasing.milli_cpu == 1000
+    assert n1.used.milli_cpu == 1000
+
+
+def test_node_future_idle():
+    store = ClusterStore()
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    store.add_pod(build_pod("p1", phase=PodPhase.Running, node="n1"))
+    task = next(iter(store.jobs["default/pg1"].tasks.values()))
+    store.evict(task, "test")
+    n1 = store.nodes["n1"]
+    # future idle = idle + releasing - pipelined
+    assert n1.future_idle().milli_cpu == 4000
+
+
+def test_delete_pod_removes_accounting():
+    store = ClusterStore()
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    pod = build_pod("p1", phase=PodPhase.Running, node="n1")
+    store.add_pod(pod)
+    store.delete_pod(pod)
+    assert store.nodes["n1"].used.milli_cpu == 0
+    assert len(store.jobs["default/pg1"].tasks) == 0

@@ -1,0 +1,12 @@
+"""volcano-tpu: a TPU-native batch scheduling framework.
+
+A ground-up rebuild of the capabilities of Volcano (gang scheduling,
+fair-share queues, preemption/reclaim, job lifecycle management) whose
+per-cycle allocate/preempt hot loops run as jitted JAX/XLA kernels over dense
+cluster arrays on TPU, instead of goroutine-parallel object loops.
+
+See SURVEY.md at the repo root for the structural analysis of the reference
+(`/root/reference`, volcano.sh v0.4) this framework is built to match.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""Dense array schema + snapshot encoder for the device-side data plane."""
+
+from .schema import (
+    ClusterArrays,
+    IndexMaps,
+    JobArrays,
+    NodeArrays,
+    QueueArrays,
+    ResourceSlots,
+    TaskArrays,
+    encode_cluster,
+    pad_dim,
+)
+
+__all__ = [
+    "ClusterArrays",
+    "IndexMaps",
+    "JobArrays",
+    "NodeArrays",
+    "QueueArrays",
+    "ResourceSlots",
+    "TaskArrays",
+    "encode_cluster",
+    "pad_dim",
+]

@@ -1,0 +1,89 @@
+"""Cache side-effect interfaces and test fakes.
+
+Mirrors ``pkg/scheduler/cache/interface.go:27-78`` (Cache, Binder, Evictor,
+StatusUpdater, VolumeBinder) and the fakes in
+``pkg/scheduler/util/test_utils.go:94-170`` that the reference's action tests
+are built on.  Real deployments plug in binders that talk to the cluster
+control plane; tests assert on the fake channels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Protocol
+
+from ..api import JobInfo, PodGroup, TaskInfo
+
+
+class Binder(Protocol):
+    def bind(self, task: TaskInfo, hostname: str) -> None: ...
+
+
+class Evictor(Protocol):
+    def evict(self, pod) -> None: ...
+
+
+class StatusUpdater(Protocol):
+    def update_pod_condition(self, pod, condition) -> None: ...
+
+    def update_pod_group(self, pg: PodGroup) -> None: ...
+
+
+class VolumeBinder(Protocol):
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None: ...
+
+    def bind_volumes(self, task: TaskInfo) -> None: ...
+
+
+class FakeBinder:
+    """Records binds into a map + ordered channel (test_utils.go:94-117)."""
+
+    def __init__(self):
+        self.binds: Dict[str, str] = {}
+        self.channel: List[str] = []
+        self._lock = threading.Lock()
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        with self._lock:
+            key = f"{task.namespace}/{task.name}"
+            self.binds[key] = hostname
+            self.channel.append(key)
+
+
+class FakeEvictor:
+    """Records evictions (test_utils.go:119-143)."""
+
+    def __init__(self):
+        self.evicts: List[str] = []
+        self.channel: List[str] = []
+        self._lock = threading.Lock()
+
+    def evict(self, pod) -> None:
+        with self._lock:
+            key = f"{pod.namespace}/{pod.name}"
+            self.evicts.append(key)
+            self.channel.append(key)
+
+
+class FakeStatusUpdater:
+    """No-op status updater (test_utils.go:145-157)."""
+
+    def __init__(self):
+        self.pod_conditions: List[object] = []
+        self.pod_groups: List[PodGroup] = []
+
+    def update_pod_condition(self, pod, condition) -> None:
+        self.pod_conditions.append((pod, condition))
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        self.pod_groups.append(pg)
+
+
+class FakeVolumeBinder:
+    """No-op volume binder (test_utils.go:159-170)."""
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        return None
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        return None

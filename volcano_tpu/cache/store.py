@@ -1,0 +1,374 @@
+"""In-memory cluster store: the scheduler cache.
+
+The TPU-native equivalent of ``pkg/scheduler/cache/cache.go``: a mutex-guarded
+mirror of cluster state mutated through an event API (the analog of the
+reference's informer event handlers, ``cache/event_handlers.go:178-731``),
+producing a deep-copied ``ClusterInfo`` snapshot per scheduling cycle
+(cache.go:652-730).  It is also the system of record for the control plane:
+controllers and the scheduler communicate only through this store, mirroring
+how the reference's planes communicate only through the API server.
+
+Bind/Evict mirror cache.go:439-554: they update the cached pod and dispatch to
+the pluggable Binder/Evictor; failures resync the task from the store
+(errTasks semantics, cache.go:627-649, simplified to synchronous resync).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..api import (
+    GROUP_NAME_ANNOTATION,
+    NAMESPACE_WEIGHT_KEY,
+    ClusterInfo,
+    JobInfo,
+    NamespaceInfo,
+    Node,
+    NodeInfo,
+    Pod,
+    PodGroup,
+    PodGroupCondition,
+    PodGroupPhase,
+    PodPhase,
+    PriorityClass,
+    Queue,
+    QueueInfo,
+    ResourceQuota,
+    TaskInfo,
+    TaskStatus,
+    pod_key,
+)
+from .interface import (
+    Binder,
+    Evictor,
+    FakeBinder,
+    FakeEvictor,
+    FakeStatusUpdater,
+    FakeVolumeBinder,
+    StatusUpdater,
+    VolumeBinder,
+)
+
+DEFAULT_QUEUE = "default"
+
+
+class ClusterStore:
+    """Mutex-guarded cluster state mirror + snapshotter."""
+
+    def __init__(
+        self,
+        binder: Optional[Binder] = None,
+        evictor: Optional[Evictor] = None,
+        status_updater: Optional[StatusUpdater] = None,
+        volume_binder: Optional[VolumeBinder] = None,
+        default_queue: str = DEFAULT_QUEUE,
+    ):
+        self._lock = threading.RLock()
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.namespace_weights: Dict[str, int] = {}
+        # Raw spec objects (system of record for controllers):
+        self.pods: Dict[str, Pod] = {}
+        self.pod_groups: Dict[str, PodGroup] = {}
+        self.raw_queues: Dict[str, Queue] = {}
+
+        self.binder: Binder = binder or FakeBinder()
+        self.evictor: Evictor = evictor or FakeEvictor()
+        self.status_updater: StatusUpdater = status_updater or FakeStatusUpdater()
+        self.volume_binder: VolumeBinder = volume_binder or FakeVolumeBinder()
+
+        # Watchers notified on spec mutations (the controllers' "informers").
+        self._watchers: List[Callable[[str, str, object], None]] = []
+
+        # Create the default queue at startup, weight 1 (cache.go:244-254).
+        self.add_queue(Queue(name=default_queue, weight=1))
+
+    # ------------------------------------------------------------- watchers
+
+    def watch(self, fn: Callable[[str, str, object], None]) -> None:
+        """Register fn(kind, event, obj) called after each mutation."""
+        self._watchers.append(fn)
+
+    def _notify(self, kind: str, event: str, obj: object) -> None:
+        for fn in self._watchers:
+            fn(kind, event, obj)
+
+    # ------------------------------------------------------- job bookkeeping
+
+    def _get_or_create_job(self, job_id: str) -> JobInfo:
+        job = self.jobs.get(job_id)
+        if job is None:
+            job = JobInfo(job_id)
+            self.jobs[job_id] = job
+        return job
+
+    def _add_task(self, pod: Pod) -> None:
+        ti = TaskInfo(pod)
+        if ti.job:
+            job = self._get_or_create_job(ti.job)
+            job.add_task_info(ti)
+        if ti.node_name:
+            node = self.nodes.get(ti.node_name)
+            if node is None:
+                # Task on an unknown node: hold a placeholder so accounting
+                # catches up when the node arrives (event_handlers.go addTask).
+                node = NodeInfo(None)
+                node.name = ti.node_name
+                self.nodes[ti.node_name] = node
+            fresh = ti.clone()
+            fresh.node_name = ""
+            node.add_task(fresh)
+
+    def _remove_task(self, pod: Pod) -> None:
+        job_id = pod.job_id()
+        job = self.jobs.get(job_id)
+        if job is not None:
+            ti = job.tasks.get(pod.uid)
+            if ti is not None:
+                job.delete_task_info(ti)
+        if pod.node_name:
+            node = self.nodes.get(pod.node_name)
+            if node is not None:
+                probe = TaskInfo(pod)
+                if pod_key(pod) in node.tasks:
+                    node.remove_task(probe)
+
+    # --------------------------------------------------------- pod handlers
+
+    def add_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if not pod.annotations.get(GROUP_NAME_ANNOTATION):
+                # Pods without a group are auto-wrapped by the podgroup
+                # controller; the scheduler cache only tracks grouped pods.
+                self.pods[pod.uid] = pod
+                self._notify("Pod", "add", pod)
+                return
+            self.pods[pod.uid] = pod
+            self._add_task(pod)
+            self._notify("Pod", "add", pod)
+
+    def update_pod(self, pod: Pod) -> None:
+        with self._lock:
+            old = self.pods.get(pod.uid)
+            if old is not None and old.annotations.get(GROUP_NAME_ANNOTATION):
+                self._remove_task(old)
+            self.pods[pod.uid] = pod
+            if pod.annotations.get(GROUP_NAME_ANNOTATION):
+                self._add_task(pod)
+            self._notify("Pod", "update", pod)
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self._lock:
+            old = self.pods.pop(pod.uid, None)
+            if old is not None and old.annotations.get(GROUP_NAME_ANNOTATION):
+                self._remove_task(old)
+            self._notify("Pod", "delete", pod)
+
+    # -------------------------------------------------------- node handlers
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            existing = self.nodes.get(node.name)
+            if existing is not None:
+                existing.set_node(node)
+            else:
+                self.nodes[node.name] = NodeInfo(node)
+            self._notify("Node", "add", node)
+
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            existing = self.nodes.get(node.name)
+            if existing is None:
+                self.nodes[node.name] = NodeInfo(node)
+            else:
+                existing.set_node(node)
+            self._notify("Node", "update", node)
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+            self._notify("Node", "delete", name)
+
+    # --------------------------------------------------- pod group handlers
+
+    def add_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            self.pod_groups[pg.uid] = pg
+            job = self._get_or_create_job(pg.uid)
+            job.set_pod_group(pg)
+            if pg.priority_class and pg.priority_class in self.priority_classes:
+                job.priority = self.priority_classes[pg.priority_class].value
+            self._notify("PodGroup", "add", pg)
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        with self._lock:
+            self.pod_groups[pg.uid] = pg
+            job = self._get_or_create_job(pg.uid)
+            job.set_pod_group(pg)
+            if pg.priority_class and pg.priority_class in self.priority_classes:
+                job.priority = self.priority_classes[pg.priority_class].value
+            self._notify("PodGroup", "update", pg)
+
+    def delete_pod_group(self, uid: str) -> None:
+        with self._lock:
+            self.pod_groups.pop(uid, None)
+            job = self.jobs.get(uid)
+            if job is not None:
+                job.unset_pod_group()
+                if not job.tasks:
+                    del self.jobs[uid]
+            self._notify("PodGroup", "delete", uid)
+
+    # ------------------------------------------------------- queue handlers
+
+    def add_queue(self, queue: Queue) -> None:
+        with self._lock:
+            self.raw_queues[queue.name] = queue
+            self.queues[queue.name] = QueueInfo(queue)
+            self._notify("Queue", "add", queue)
+
+    def update_queue(self, queue: Queue) -> None:
+        with self._lock:
+            self.raw_queues[queue.name] = queue
+            self.queues[queue.name] = QueueInfo(queue)
+            self._notify("Queue", "update", queue)
+
+    def delete_queue(self, name: str) -> None:
+        with self._lock:
+            self.raw_queues.pop(name, None)
+            self.queues.pop(name, None)
+            self._notify("Queue", "delete", name)
+
+    # ------------------------------------------- priority class / quota
+
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self.priority_classes[pc.name] = pc
+            self._notify("PriorityClass", "add", pc)
+
+    def delete_priority_class(self, name: str) -> None:
+        with self._lock:
+            self.priority_classes.pop(name, None)
+            self._notify("PriorityClass", "delete", name)
+
+    def add_resource_quota(self, quota: ResourceQuota) -> None:
+        """Track namespace weight from the quota annotation
+        (event_handlers.go quota path + namespace_info.go:33-37)."""
+        with self._lock:
+            raw = quota.annotations.get(NAMESPACE_WEIGHT_KEY)
+            if raw is not None:
+                try:
+                    self.namespace_weights[quota.namespace] = max(
+                        self.namespace_weights.get(quota.namespace, 0), int(raw)
+                    )
+                except ValueError:
+                    pass
+            self._notify("ResourceQuota", "add", quota)
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> ClusterInfo:
+        """Deep-copied point-in-time view (cache.go:652-730)."""
+        with self._lock:
+            info = ClusterInfo()
+            for name, node in self.nodes.items():
+                info.nodes[name] = node.clone()
+            for name, queue in self.queues.items():
+                info.queues[name] = queue.clone()
+            namespaces = set()
+            for job_id, job in self.jobs.items():
+                # Jobs without a PodGroup are not schedulable yet
+                # (cache.go snapshot skips jobs with missing PodGroup).
+                if job.pod_group is None:
+                    continue
+                info.jobs[job_id] = job.clone()
+                namespaces.add(job.namespace)
+            for ns in namespaces:
+                info.namespace_info[ns] = NamespaceInfo(
+                    ns, self.namespace_weights.get(ns, 1)
+                )
+            return info
+
+    # ------------------------------------------------------------ side effects
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        """Bind task's pod to a host (cache.go:492-554, synchronous here).
+
+        Copy-on-write: the stored Pod is replaced, never mutated, so
+        snapshot TaskInfos holding the old Pod keep their point-in-time view.
+        """
+        with self._lock:
+            pod = self.pods.get(task.uid)
+            if pod is None:
+                raise KeyError(f"unknown pod {task.uid}")
+            self.binder.bind(task, hostname)
+            self._remove_task(pod)
+            pod = copy.copy(pod)
+            pod.node_name = hostname
+            self.pods[pod.uid] = pod
+            self._add_task(pod)
+            self._notify("Pod", "bind", pod)
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        """Evict task's pod (cache.go:439-489, synchronous here)."""
+        with self._lock:
+            pod = self.pods.get(task.uid)
+            if pod is None:
+                raise KeyError(f"unknown pod {task.uid}")
+            # Mark the cached pod as terminating: resources become Releasing.
+            self._remove_task(pod)
+            pod = copy.copy(pod)
+            pod.deleting = True
+            self.pods[pod.uid] = pod
+            self._add_task(pod)
+            self.evictor.evict(pod)
+            self._notify("Pod", "evict", pod)
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    def update_job_status(self, job: JobInfo) -> JobInfo:
+        """Write PodGroup status back (interface.go UpdateJobStatus +
+        job_updater.go semantics)."""
+        with self._lock:
+            pg = job.pod_group
+            if pg is None:
+                return job
+            stored = self.pod_groups.get(pg.uid)
+            if stored is not None:
+                stored.status = pg.status
+                self.status_updater.update_pod_group(stored)
+                self._notify("PodGroup", "status", stored)
+            return job
+
+    def record_job_condition(self, job: JobInfo, condition: PodGroupCondition) -> None:
+        if job.pod_group is None:
+            return
+        with self._lock:
+            # Write to the *stored* PodGroup (the snapshot may share or hold
+            # its own reference); replace same-type condition, mirroring
+            # jobUpdater behavior.
+            pg = self.pod_groups.get(job.pod_group.uid, job.pod_group)
+            conditions = [c for c in pg.status.conditions if c.type != condition.type]
+            conditions.append(condition)
+            pg.status.conditions = conditions
+
+    # --------------------------------------------------------------- helpers
+
+    def pending_pods(self) -> List[Pod]:
+        with self._lock:
+            return [
+                p
+                for p in self.pods.values()
+                if p.phase == PodPhase.Pending and not p.node_name
+            ]
+
+    def task_in_store(self, uid: str) -> Optional[Pod]:
+        return self.pods.get(uid)
