@@ -3,6 +3,10 @@
 Multi-chip sharding is validated on a virtual CPU mesh
 (xla_force_host_platform_device_count), matching how the driver dry-runs the
 multi-chip path; real-TPU benchmarking happens in bench.py.
+
+Note: the environment's TPU plugin pins jax_platforms at interpreter startup
+(before conftest runs), so the env var alone is not enough — we override the
+live jax config after import.
 """
 
 import os
@@ -14,3 +18,7 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,344 @@
+"""Device-kernel tests: fit semantics vs the host oracle, scoring math, and
+the allocate solver's gang/pipeline/overuse semantics.
+
+Follows the reference's action-test pattern
+(pkg/scheduler/actions/allocate/allocate_test.go:155-222): build a cluster
+through the store, run the solver, assert the assignment.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    Queue,
+    Resource,
+    TaskStatus,
+)
+from volcano_tpu.arrays import encode_cluster
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.ops import (
+    default_weights,
+    less_equal,
+    solve,
+    static_predicate_mask,
+)
+from volcano_tpu.ops.scoring import binpack_score, ScoreWeights
+
+
+# ---------------------------------------------------------------- fit kernel
+
+
+def test_less_equal_matches_host_oracle():
+    rng = np.random.default_rng(42)
+    eps = np.array([10.0, 10 * 1024 * 1024, 10.0], np.float32)
+    scalar = np.array([False, False, True])
+    for _ in range(200):
+        l = rng.choice(
+            [0.0, 5.0, 9.999, 10.0, 1000.0, 1009.0, 1010.0, 2.5e7], size=3
+        )
+        r = rng.choice([0.0, 5.0, 10.0, 1000.0, 1005.0, 2.0e7, 3.0e7], size=3)
+        host_l = Resource(l[0], l[1], {"g": l[2]} if l[2] else None)
+        host_r = Resource(r[0], r[1], {"g": r[2]} if r[2] else None)
+        got = bool(
+            less_equal(
+                jnp.asarray(l, jnp.float32), jnp.asarray(r, jnp.float32),
+                jnp.asarray(eps), jnp.asarray(scalar),
+            )
+        )
+        want = host_l.less_equal(host_r)
+        assert got == want, f"l={l} r={r}: device={got} host={want}"
+
+
+def test_binpack_score_math():
+    # binpack.go:248-259: score_r = (used+req)*w/cap; 0 if over capacity.
+    w = ScoreWeights(
+        binpack_weight=1.0,
+        binpack_res=jnp.array([1.0, 1.0], jnp.float32),
+        least_req_weight=0.0,
+        most_req_weight=0.0,
+        balanced_weight=0.0,
+        node_affinity_weight=0.0,
+    )
+    req = jnp.array([1000.0, 0.0], jnp.float32)  # cpu-only request
+    allocatable = jnp.array([[4000.0, 8.0], [2000.0, 8.0]], jnp.float32)
+    used = jnp.array([[1000.0, 0.0], [1500.0, 0.0]], jnp.float32)
+    s = binpack_score(req, allocatable, used, w)
+    # node0: (1000+1000)/4000 * 1 / 1 * 10 = 5.0
+    assert float(s[0]) == pytest.approx(5.0)
+    # node1: (1500+1000)=2500 > 2000 -> 0
+    assert float(s[1]) == pytest.approx(0.0)
+
+
+# ------------------------------------------------------------ solver harness
+
+
+def build_store(nodes, groups):
+    """nodes: [(name, cpu, mem)], groups: [(pg_name, min_member, queue,
+    [(pod_name, cpu, mem)])]"""
+    store = ClusterStore()
+    for name, cpu, mem in nodes:
+        store.add_node(Node(name=name, allocatable={"cpu": cpu, "memory": mem}))
+    for pg_name, min_member, queue, pods in groups:
+        if queue != "default" and queue not in store.queues:
+            store.add_queue(Queue(name=queue, weight=1))
+        store.add_pod_group(
+            PodGroup(name=pg_name, min_member=min_member, queue=queue)
+        )
+        for pod_name, cpu, mem in pods:
+            store.add_pod(
+                Pod(
+                    name=pod_name,
+                    annotations={GROUP_NAME_ANNOTATION: pg_name},
+                    containers=[{"cpu": cpu, "memory": mem}],
+                )
+            )
+    return store
+
+
+def run_solver(store, job_ids=None, deserved_inf=True):
+    snap = store.snapshot()
+    job_ids = job_ids or sorted(snap.jobs.keys())
+    pending = []
+    for jid in job_ids:
+        job = snap.jobs[jid]
+        tasks = sorted(
+            job.task_status_index.get(TaskStatus.Pending, {}).values(),
+            key=lambda t: (-t.priority, t.pod.creation_timestamp),
+        )
+        pending.extend(t for t in tasks if not t.resreq.is_empty())
+    arrays, maps = encode_cluster(snap, pending, job_ids)
+    mask = static_predicate_mask(arrays)
+    Q, R = arrays.queues.capability.shape
+    deserved = np.full((Q, R), 3e38, np.float32) if deserved_inf else arrays.queues.deserved
+    res = solve(
+        arrays.nodes.idle,
+        arrays.nodes.allocatable,
+        arrays.nodes.releasing,
+        arrays.nodes.pipelined,
+        arrays.nodes.num_tasks,
+        arrays.nodes.max_tasks,
+        arrays.nodes.port_bits,
+        arrays.tasks.req,
+        arrays.tasks.init_req,
+        arrays.tasks.job,
+        arrays.tasks.real,
+        arrays.tasks.port_bits,
+        arrays.jobs.queue,
+        arrays.jobs.min_available,
+        arrays.jobs.ready_base,
+        jnp.asarray(deserved),
+        arrays.queues.allocated,
+        mask,
+        default_weights(maps.slots.width),
+        jnp.asarray(arrays.eps),
+        jnp.asarray(arrays.scalar_slot),
+    )
+    return res, maps
+
+
+def assignments(res, maps):
+    out = {}
+    for i, uid in enumerate(maps.task_uids):
+        n = int(res.assigned[i])
+        ti = maps.task_infos[i]
+        out[ti.name] = maps.node_names[n] if n >= 0 else None
+    return out
+
+
+# ---------------------------------------------------------------- scenarios
+
+
+def test_gang_fits_all_assigned():
+    store = build_store(
+        nodes=[("n1", "4", "8Gi"), ("n2", "4", "8Gi")],
+        groups=[("pg1", 3, "default",
+                 [("p0", "2", "2Gi"), ("p1", "2", "2Gi"), ("p2", "2", "2Gi")])],
+    )
+    res, maps = run_solver(store)
+    a = assignments(res, maps)
+    assert all(v is not None for v in a.values()), a
+    assert not bool(res.never_ready[0])
+    # No node oversubscribed: 2 tasks max per 4-cpu node.
+    counts = {}
+    for v in a.values():
+        counts[v] = counts.get(v, 0) + 1
+    assert max(counts.values()) <= 2
+
+
+def test_gang_insufficient_discards_all():
+    # min_member=3 but only 2 tasks fit cluster-wide -> zero assignments.
+    store = build_store(
+        nodes=[("n1", "4", "8Gi")],
+        groups=[("pg1", 3, "default",
+                 [("p0", "2", "2Gi"), ("p1", "2", "2Gi"), ("p2", "2", "2Gi")])],
+    )
+    res, maps = run_solver(store)
+    a = assignments(res, maps)
+    assert all(v is None for v in a.values()), a
+    assert bool(res.never_ready[0])
+    # Capacity restored: final idle == initial.
+    assert float(res.idle[0, 0]) == 4000.0
+
+
+def test_gang_discard_frees_capacity_for_next_job():
+    # Failed gang must not consume capacity needed by a later job.
+    store = build_store(
+        nodes=[("n1", "4", "8Gi")],
+        groups=[
+            ("pga", 3, "default",
+             [("a0", "2", "1Gi"), ("a1", "2", "1Gi"), ("a2", "2", "1Gi")]),
+            ("pgb", 2, "default", [("b0", "2", "1Gi"), ("b1", "2", "1Gi")]),
+        ],
+    )
+    res, maps = run_solver(store, job_ids=["default/pga", "default/pgb"])
+    a = assignments(res, maps)
+    assert a["a0"] is None and a["a1"] is None and a["a2"] is None
+    assert a["b0"] == "n1" and a["b1"] == "n1"
+
+
+def test_partial_gang_min_available_less_than_replicas():
+    # 3 replicas, min_member=2, capacity for 2 -> exactly 2 assigned.
+    store = build_store(
+        nodes=[("n1", "4", "8Gi")],
+        groups=[("pg1", 2, "default",
+                 [("p0", "2", "2Gi"), ("p1", "2", "2Gi"), ("p2", "2", "2Gi")])],
+    )
+    res, maps = run_solver(store)
+    a = assignments(res, maps)
+    placed = [k for k, v in a.items() if v is not None]
+    assert len(placed) == 2
+    assert not bool(res.never_ready[0])
+
+
+def test_no_oversubscription_two_jobs():
+    store = build_store(
+        nodes=[("n1", "2", "4Gi"), ("n2", "2", "4Gi")],
+        groups=[
+            ("pg1", 1, "default", [("p0", "2", "1Gi")]),
+            ("pg2", 1, "default", [("q0", "2", "1Gi")]),
+        ],
+    )
+    res, maps = run_solver(store, job_ids=["default/pg1", "default/pg2"])
+    a = assignments(res, maps)
+    assert a["p0"] is not None and a["q0"] is not None
+    assert a["p0"] != a["q0"]  # each node has cpu for only one
+
+
+def test_pipeline_on_releasing_resources():
+    # Node full but with a releasing task: pending task gets pipelined,
+    # not allocated (allocate.go:224-232).
+    store = ClusterStore()
+    store.add_node(Node(name="n1", allocatable={"cpu": "2", "memory": "4Gi"}))
+    store.add_pod_group(PodGroup(name="old", min_member=1))
+    victim = Pod(
+        name="v0",
+        annotations={GROUP_NAME_ANNOTATION: "old"},
+        containers=[{"cpu": "2", "memory": "1Gi"}],
+        phase=PodPhase.Running,
+        node_name="n1",
+    )
+    store.add_pod(victim)
+    # Evict it -> releasing.
+    vt = next(iter(store.jobs["default/old"].tasks.values()))
+    store.evict(vt, "test")
+    store.add_pod_group(PodGroup(name="new", min_member=1))
+    store.add_pod(
+        Pod(
+            name="p0",
+            annotations={GROUP_NAME_ANNOTATION: "new"},
+            containers=[{"cpu": "2", "memory": "1Gi"}],
+        )
+    )
+    res, maps = run_solver(store, job_ids=["default/new"])
+    assert int(res.assigned[0]) == -1
+    assert int(res.pipelined[0]) == maps.node_index["n1"]
+
+
+def test_fit_failure_aborts_rest_of_job():
+    # p0 fits; p1 requests more than any node has -> no feasible node;
+    # p2 would fit but must not be attempted (allocate.go:189-193);
+    # job min=2 never ready -> all discarded.
+    store = build_store(
+        nodes=[("n1", "4", "8Gi")],
+        groups=[("pg1", 2, "default",
+                 [("p0", "1", "1Gi"), ("p1", "100", "1Gi"), ("p2", "1", "1Gi")])],
+    )
+    snap = store.snapshot()
+    job = snap.jobs["default/pg1"]
+    pending = sorted(
+        job.task_status_index[TaskStatus.Pending].values(),
+        key=lambda t: t.name,
+    )
+    arrays, maps = encode_cluster(snap, pending, ["default/pg1"])
+    mask = static_predicate_mask(arrays)
+    Q, R = arrays.queues.capability.shape
+    res = solve(
+        arrays.nodes.idle, arrays.nodes.allocatable, arrays.nodes.releasing,
+        arrays.nodes.pipelined, arrays.nodes.num_tasks, arrays.nodes.max_tasks,
+        arrays.nodes.port_bits, arrays.tasks.req, arrays.tasks.init_req,
+        arrays.tasks.job, arrays.tasks.real, arrays.tasks.port_bits,
+        arrays.jobs.queue, arrays.jobs.min_available, arrays.jobs.ready_base,
+        jnp.full((Q, R), 3e38, jnp.float32), arrays.queues.allocated, mask,
+        default_weights(maps.slots.width), jnp.asarray(arrays.eps),
+        jnp.asarray(arrays.scalar_slot),
+    )
+    assert bool(res.fit_failed[0])
+    assert bool(res.never_ready[0])
+    assert all(int(x) == -1 for x in res.assigned[:3])
+    assert float(res.idle[0, 0]) == 4000.0
+
+
+def test_node_selector_respected():
+    store = ClusterStore()
+    store.add_node(Node(name="n1", allocatable={"cpu": "4", "memory": "8Gi"},
+                        labels={"zone": "a"}))
+    store.add_node(Node(name="n2", allocatable={"cpu": "4", "memory": "8Gi"},
+                        labels={"zone": "b"}))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    store.add_pod(
+        Pod(
+            name="p0",
+            annotations={GROUP_NAME_ANNOTATION: "pg1"},
+            containers=[{"cpu": "1", "memory": "1Gi"}],
+            node_selector={"zone": "b"},
+        )
+    )
+    res, maps = run_solver(store)
+    a = assignments(res, maps)
+    assert a["p0"] == "n2"
+
+
+def test_binpack_prefers_used_node():
+    # With binpack enabled, the second task should pack onto the node that
+    # already hosts the first.
+    store = build_store(
+        nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")],
+        groups=[("pg1", 2, "default", [("p0", "1", "1Gi"), ("p1", "1", "1Gi")])],
+    )
+    snap = store.snapshot()
+    job = snap.jobs["default/pg1"]
+    pending = sorted(
+        job.task_status_index[TaskStatus.Pending].values(), key=lambda t: t.name
+    )
+    arrays, maps = encode_cluster(snap, pending, ["default/pg1"])
+    mask = static_predicate_mask(arrays)
+    Q, R = arrays.queues.capability.shape
+    res = solve(
+        arrays.nodes.idle, arrays.nodes.allocatable, arrays.nodes.releasing,
+        arrays.nodes.pipelined, arrays.nodes.num_tasks, arrays.nodes.max_tasks,
+        arrays.nodes.port_bits, arrays.tasks.req, arrays.tasks.init_req,
+        arrays.tasks.job, arrays.tasks.real, arrays.tasks.port_bits,
+        arrays.jobs.queue, arrays.jobs.min_available, arrays.jobs.ready_base,
+        jnp.full((Q, R), 3e38, jnp.float32), arrays.queues.allocated, mask,
+        default_weights(maps.slots.width, binpack_enabled=True,
+                        nodeorder_enabled=False),
+        jnp.asarray(arrays.eps), jnp.asarray(arrays.scalar_slot),
+    )
+    a = {maps.task_infos[i].name: int(res.assigned[i]) for i in range(2)}
+    assert a["p0"] == a["p1"]

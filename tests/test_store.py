@@ -134,3 +134,32 @@ def test_delete_pod_removes_accounting():
     store.delete_pod(pod)
     assert store.nodes["n1"].used.milli_cpu == 0
     assert len(store.jobs["default/pg1"].tasks) == 0
+
+
+def test_terminated_pods_release_node_resources():
+    # Succeeded/Failed pods must not consume node idle
+    # (reference isTerminated filter in node accounting).
+    store = ClusterStore()
+    store.add_node(build_node("n1"))
+    store.add_pod_group(PodGroup(name="pg1", min_member=1))
+    pod = build_pod("p1", phase=PodPhase.Running, node="n1")
+    store.add_pod(pod)
+    assert store.nodes["n1"].idle.milli_cpu == 3000
+    done = build_pod("p1", phase=PodPhase.Succeeded, node="n1")
+    done.uid = pod.uid
+    store.update_pod(done)
+    assert store.nodes["n1"].idle.milli_cpu == 4000
+    # Job still counts it for readiness.
+    assert store.jobs["default/pg1"].ready_task_num() == 1
+
+
+def test_ungrouped_bound_pod_occupies_node():
+    # A pod with no group annotation but bound to a node must still be
+    # visible in node accounting (cache.go tracks any pod with NodeName).
+    store = ClusterStore()
+    store.add_node(build_node("n1"))
+    store.add_pod(build_pod("sys-daemon", group=None, cpu="2",
+                            phase=PodPhase.Running, node="n1"))
+    assert store.nodes["n1"].idle.milli_cpu == 2000
+    snap = store.snapshot()
+    assert snap.nodes["n1"].idle.milli_cpu == 2000

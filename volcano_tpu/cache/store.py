@@ -111,6 +111,10 @@ class ClusterStore:
         if ti.job:
             job = self._get_or_create_job(ti.job)
             job.add_task_info(ti)
+        # Terminated pods hold no node resources (the reference filters
+        # them out of node accounting, event_handlers.go isTerminated).
+        if ti.status in (TaskStatus.Succeeded, TaskStatus.Failed):
+            return
         if ti.node_name:
             node = self.nodes.get(ti.node_name)
             if node is None:
@@ -125,7 +129,7 @@ class ClusterStore:
 
     def _remove_task(self, pod: Pod) -> None:
         job_id = pod.job_id()
-        job = self.jobs.get(job_id)
+        job = self.jobs.get(job_id) if job_id else None
         if job is not None:
             ti = job.tasks.get(pod.uid)
             if ti is not None:
@@ -140,13 +144,11 @@ class ClusterStore:
     # --------------------------------------------------------- pod handlers
 
     def add_pod(self, pod: Pod) -> None:
+        """Track a pod.  Ungrouped pods (no group annotation) still occupy
+        node resources when bound (the reference tracks ANY pod with a
+        NodeName, cache.go:320-332); they only lack a schedulable job until
+        the podgroup controller wraps them."""
         with self._lock:
-            if not pod.annotations.get(GROUP_NAME_ANNOTATION):
-                # Pods without a group are auto-wrapped by the podgroup
-                # controller; the scheduler cache only tracks grouped pods.
-                self.pods[pod.uid] = pod
-                self._notify("Pod", "add", pod)
-                return
             self.pods[pod.uid] = pod
             self._add_task(pod)
             self._notify("Pod", "add", pod)
@@ -154,17 +156,16 @@ class ClusterStore:
     def update_pod(self, pod: Pod) -> None:
         with self._lock:
             old = self.pods.get(pod.uid)
-            if old is not None and old.annotations.get(GROUP_NAME_ANNOTATION):
+            if old is not None:
                 self._remove_task(old)
             self.pods[pod.uid] = pod
-            if pod.annotations.get(GROUP_NAME_ANNOTATION):
-                self._add_task(pod)
+            self._add_task(pod)
             self._notify("Pod", "update", pod)
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:
             old = self.pods.pop(pod.uid, None)
-            if old is not None and old.annotations.get(GROUP_NAME_ANNOTATION):
+            if old is not None:
                 self._remove_task(old)
             self._notify("Pod", "delete", pod)
 

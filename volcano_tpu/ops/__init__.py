@@ -1,0 +1,19 @@
+"""Device kernels: fit predicates, scoring, and the allocate solver."""
+
+from .allocate import AllocResult, solve
+from .predicates import static_predicate_mask
+from .resreq import is_empty, less, less_equal, less_equal_strict
+from .scoring import ScoreWeights, default_weights, node_score
+
+__all__ = [
+    "AllocResult",
+    "solve",
+    "static_predicate_mask",
+    "is_empty",
+    "less",
+    "less_equal",
+    "less_equal_strict",
+    "ScoreWeights",
+    "default_weights",
+    "node_score",
+]

@@ -1,0 +1,297 @@
+"""The allocate solver: Volcano's hot loop as one jitted XLA program.
+
+Replaces the namespace->queue->job->task object loop of
+``pkg/scheduler/actions/allocate/allocate.go:40-250`` (predicate fan-out,
+score fan-out, best-node selection, capacity update, gang commit/discard)
+with a single sequential scan over pre-ordered tasks carrying dense cluster
+state.  Semantics preserved per task step:
+
+- predicate  = static mask (labels/taints/ports/ready) AND InitResreq fits
+  FutureIdle (allocate.go:98-105) AND pod-count fits AND no port clash
+  (the dynamic parts of the predicates plugin, updated as the solver assigns)
+- score      = additive scorers on current node state (allocate.go:202)
+- selection  = masked argmax (SelectBestNode; first-index tie-break instead
+  of random-among-max)
+- fits Idle  -> allocate: idle/queue/pod-count/ports updated (stmt.Allocate)
+- else       -> pipeline: FutureIdle reduced, effects NOT rolled back on
+  discard (ssn.Pipeline is session-level; statement.go records only
+  stmt ops; allocate.go:224-232)
+- a task with no feasible node aborts the remaining tasks of its job
+  (allocate.go:189-193 break)
+- gang       = job-boundary checkpoint/rollback: a job that never reaches
+  ready (ready_base + newly_allocated >= min_available) has all its
+  allocations rolled back (stmt.Discard, allocate.go:241-245); once ready,
+  every further allocation commits immediately (the reference re-opens a
+  fresh statement per task after readiness)
+- overused   = a job whose queue is overused vs its deserved share at the
+  job's start is skipped entirely (allocate.go:126-133)
+
+The step body is branchless (masked jnp.where updates) so XLA compiles one
+tight loop body; the only control flow is the fori_loop itself.
+
+Deviations from the reference (documented):
+- the reference re-picks the next <namespace, queue, job> after every job
+  using *live* DRF/share orderings; the fused solver processes jobs in the
+  order fixed at encode time.  The host action can run the solver in
+  multiple rounds with re-sorted order to recover the dynamic behavior
+  (actions/allocate.py).
+- tie-break is deterministic (lowest node index) instead of the reference's
+  random-among-max (scheduler_helper.go:201-212).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .resreq import less_equal
+from .scoring import ScoreWeights, node_score
+
+NEG = jnp.float32(-3.0e38)
+
+
+class AllocState(NamedTuple):
+    """Carry of the sequential scan.  Allocation-side state (idle, ntasks,
+    nports, q_alloc) is checkpointed at job boundaries for gang rollback;
+    pipeline-side state (pip_*) survives rollback (session-level Pipeline)."""
+
+    idle: jnp.ndarray  # [N, R]
+    pip_extra: jnp.ndarray  # [N, R] pipelined additions this cycle
+    ntasks: jnp.ndarray  # [N]
+    pip_ntasks: jnp.ndarray  # [N]
+    nports: jnp.ndarray  # [N, PW] uint32
+    pip_nports: jnp.ndarray  # [N, PW]
+    q_alloc: jnp.ndarray  # [Q, R]
+    q_pip: jnp.ndarray  # [Q, R]
+    assigned: jnp.ndarray  # [P] node index or -1
+    pipelined: jnp.ndarray  # [P] node index or -1
+    alloc_cnt: jnp.ndarray  # [J]
+    never_ready: jnp.ndarray  # [J] bool
+    fit_failed: jnp.ndarray  # [J] bool
+    ckpt_idle: jnp.ndarray
+    ckpt_ntasks: jnp.ndarray
+    ckpt_nports: jnp.ndarray
+    ckpt_q_alloc: jnp.ndarray
+    prev_job: jnp.ndarray  # scalar int32
+    job_ready: jnp.ndarray  # scalar bool
+    job_skip: jnp.ndarray  # scalar bool
+
+
+class AllocResult(NamedTuple):
+    assigned: jnp.ndarray  # [P] committed node index or -1
+    pipelined: jnp.ndarray  # [P] pipelined node index or -1
+    never_ready: jnp.ndarray  # [J] bool (gang discard happened)
+    fit_failed: jnp.ndarray  # [J] bool
+    idle: jnp.ndarray  # [N, R] final idle
+    q_alloc: jnp.ndarray  # [Q, R] final queue allocated (incl. pipelines)
+
+
+def _sel(c, a, b):
+    """Scalar-cond select matching array rank."""
+    return jnp.where(c, a, b)
+
+
+@jax.jit
+def solve(
+    # node state
+    idle0,  # [N, R]
+    allocatable,  # [N, R]
+    releasing,  # [N, R]
+    pipelined0,  # [N, R]
+    ntasks0,  # [N]
+    max_tasks,  # [N]
+    nports0,  # [N, PW]
+    # tasks (pre-ordered, job-contiguous)
+    req,  # [P, R]
+    init_req,  # [P, R]
+    task_job,  # [P]
+    task_real,  # [P]
+    task_ports,  # [P, PW]
+    # jobs
+    job_queue,  # [J]
+    min_available,  # [J]
+    ready_base,  # [J]
+    # queues
+    deserved,  # [Q, R] from the proportion plugin (+inf when disabled)
+    q_alloc0,  # [Q, R] allocated at session open
+    # predicate + scoring
+    static_mask,  # [P, N]
+    weights: ScoreWeights,
+    eps,  # [R]
+    scalar_slot,  # [R]
+) -> AllocResult:
+    P, _ = req.shape
+    J = min_available.shape[0]
+
+    state = AllocState(
+        idle=idle0,
+        pip_extra=jnp.zeros_like(idle0),
+        ntasks=ntasks0,
+        pip_ntasks=jnp.zeros_like(ntasks0),
+        nports=nports0,
+        pip_nports=jnp.zeros_like(nports0),
+        q_alloc=q_alloc0,
+        q_pip=jnp.zeros_like(q_alloc0),
+        assigned=jnp.full((P,), -1, jnp.int32),
+        pipelined=jnp.full((P,), -1, jnp.int32),
+        alloc_cnt=jnp.zeros((J,), jnp.int32),
+        never_ready=jnp.zeros((J,), bool),
+        fit_failed=jnp.zeros((J,), bool),
+        ckpt_idle=idle0,
+        ckpt_ntasks=ntasks0,
+        ckpt_nports=nports0,
+        ckpt_q_alloc=q_alloc0,
+        prev_job=jnp.int32(-1),
+        job_ready=jnp.bool_(True),
+        job_skip=jnp.bool_(True),
+    )
+
+    def step(t, s: AllocState) -> AllocState:
+        tt = jnp.minimum(t, P - 1)
+        is_pad = (t >= P) | ~task_real[tt]
+        jt = jnp.where(is_pad, jnp.int32(-1), task_job[tt])
+        jt_c = jnp.maximum(jt, 0)
+
+        # ---- job boundary: finalize previous job, open new one ----------
+        new_job = jt != s.prev_job
+        # Discard when the previous job never reached ready — including
+        # jobs aborted mid-way by a fit failure (Go breaks the task loop,
+        # then commit/discard still runs; allocate.go:189-245).  Rollback
+        # restores allocation-side state to the last commit point.
+        discard = new_job & (s.prev_job >= 0) & ~s.job_ready
+        pj_c = jnp.maximum(s.prev_job, 0)
+
+        idle = _sel(discard, s.ckpt_idle, s.idle)
+        ntasks = _sel(discard, s.ckpt_ntasks, s.ntasks)
+        nports = _sel(discard, s.ckpt_nports, s.nports)
+        q_alloc = _sel(discard, s.ckpt_q_alloc, s.q_alloc)
+        never_ready = s.never_ready.at[pj_c].set(
+            s.never_ready[pj_c] | discard
+        )
+
+        # New-job bookkeeping: checkpoint, overuse check, base readiness.
+        ckpt_idle = _sel(new_job, idle, s.ckpt_idle)
+        ckpt_ntasks = _sel(new_job, ntasks, s.ckpt_ntasks)
+        ckpt_nports = _sel(new_job, nports, s.ckpt_nports)
+        ckpt_q_alloc = _sel(new_job, q_alloc, s.ckpt_q_alloc)
+        qj = job_queue[jt_c]
+        q_total = q_alloc[qj] + s.q_pip[qj]
+        overused = ~less_equal(q_total, deserved[qj], eps, scalar_slot)
+        job_skip = _sel(
+            new_job, (jt < 0) | overused, s.job_skip
+        )
+        job_ready = _sel(
+            new_job,
+            (jt >= 0) & (ready_base[jt_c] >= min_available[jt_c]),
+            s.job_ready,
+        )
+        prev_job = _sel(new_job, jt, s.prev_job)
+
+        # ---- per-task processing (fully masked) -------------------------
+        active = ~is_pad & ~job_skip
+
+        future_idle = idle + releasing - pipelined0 - s.pip_extra
+        fit_future = less_equal(
+            init_req[tt][None, :], future_idle, eps, scalar_slot
+        )
+        total_ntasks = ntasks + s.pip_ntasks
+        pods_ok = (max_tasks <= 0) | (total_ntasks < max_tasks)
+        ports_used = nports | s.pip_nports
+        ports_ok = jnp.all((task_ports[tt][None, :] & ports_used) == 0, axis=-1)
+        feasible = static_mask[tt] & fit_future & pods_ok & ports_ok
+        any_feasible = jnp.any(feasible)
+
+        score = node_score(req[tt], allocatable, idle, weights)
+        score = jnp.where(feasible, score, NEG)
+        best = jnp.argmax(score).astype(jnp.int32)
+        fits_idle = less_equal(init_req[tt], idle[best], eps, scalar_slot)
+
+        do_alloc = active & any_feasible & fits_idle
+        do_pipeline = active & any_feasible & ~fits_idle
+        no_node = active & ~any_feasible
+
+        # Allocation-side updates (stmt.Allocate).
+        radd = jnp.where(do_alloc, req[tt], jnp.zeros_like(req[tt]))
+        idle = idle.at[best].add(-radd)
+        ntasks = ntasks.at[best].add(do_alloc.astype(jnp.int32))
+        nports = nports.at[best].set(
+            jnp.where(do_alloc, nports[best] | task_ports[tt], nports[best])
+        )
+        q_alloc = q_alloc.at[qj].add(radd)
+        assigned = s.assigned.at[tt].set(
+            jnp.where(do_alloc, best, s.assigned[tt])
+        )
+        alloc_cnt = s.alloc_cnt.at[jt_c].add(do_alloc.astype(jnp.int32))
+        job_ready = job_ready | (
+            do_alloc & (ready_base[jt_c] + alloc_cnt[jt_c] >= min_available[jt_c])
+        )
+
+        # Once ready, every allocation commits immediately: advance the
+        # checkpoint so later rollbacks are no-ops.
+        commit = do_alloc & job_ready
+        ckpt_idle = _sel(commit, idle, ckpt_idle)
+        ckpt_ntasks = _sel(commit, ntasks, ckpt_ntasks)
+        ckpt_nports = _sel(commit, nports, ckpt_nports)
+        ckpt_q_alloc = _sel(commit, q_alloc, ckpt_q_alloc)
+
+        # Pipeline-side updates (ssn.Pipeline; survive discard).
+        padd = jnp.where(do_pipeline, req[tt], jnp.zeros_like(req[tt]))
+        pip_extra = s.pip_extra.at[best].add(padd)
+        pip_ntasks = s.pip_ntasks.at[best].add(do_pipeline.astype(jnp.int32))
+        pip_nports = s.pip_nports.at[best].set(
+            jnp.where(
+                do_pipeline,
+                s.pip_nports[best] | task_ports[tt],
+                s.pip_nports[best],
+            )
+        )
+        q_pip = s.q_pip.at[qj].add(padd)
+        pipelined = s.pipelined.at[tt].set(
+            jnp.where(do_pipeline, best, s.pipelined[tt])
+        )
+
+        # Fit failure aborts the rest of the job (allocate.go:189-193).
+        fit_failed = s.fit_failed.at[jt_c].set(s.fit_failed[jt_c] | no_node)
+        job_skip = job_skip | no_node
+
+        return AllocState(
+            idle=idle,
+            pip_extra=pip_extra,
+            ntasks=ntasks,
+            pip_ntasks=pip_ntasks,
+            nports=nports,
+            pip_nports=pip_nports,
+            q_alloc=q_alloc,
+            q_pip=q_pip,
+            assigned=assigned,
+            pipelined=pipelined,
+            alloc_cnt=alloc_cnt,
+            never_ready=never_ready,
+            fit_failed=fit_failed,
+            ckpt_idle=ckpt_idle,
+            ckpt_ntasks=ckpt_ntasks,
+            ckpt_nports=ckpt_nports,
+            ckpt_q_alloc=ckpt_q_alloc,
+            prev_job=prev_job,
+            job_ready=job_ready,
+            job_skip=job_skip,
+        )
+
+    state = jax.lax.fori_loop(0, P + 1, step, state)
+
+    # Clear assignments of discarded jobs (their capacity was already
+    # restored in-scan at the job boundary).
+    jt = jnp.maximum(task_job, 0)
+    discarded = state.never_ready[jt] & task_real
+    assigned = jnp.where(discarded, -1, state.assigned)
+
+    return AllocResult(
+        assigned=assigned,
+        pipelined=state.pipelined,
+        never_ready=state.never_ready,
+        fit_failed=state.fit_failed,
+        idle=state.idle,
+        q_alloc=state.q_alloc + state.q_pip,
+    )

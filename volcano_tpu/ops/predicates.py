@@ -1,0 +1,68 @@
+"""Static predicate-mask kernels: the [P, N] boolean gate.
+
+Device replacement for the per-(task, node) predicate fan-out
+(``pkg/scheduler/util/scheduler_helper.go:43-118`` running the predicates
+plugin, ``pkg/scheduler/plugins/predicates/predicates.go:144-293``): node
+readiness/schedulability, node-selector and required node-affinity label
+matching, taint/toleration, host-port conflicts.  Resource fit and pod-count
+are *dynamic* (they change as the solver assigns) and live in the allocate
+kernel; everything here is constant within one session.
+
+All label/taint/port predicates are bitset algebra over the session
+dictionaries built by ``volcano_tpu.arrays.encode_cluster``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..arrays.schema import ClusterArrays
+
+
+def selector_mask(sel_bits, has_selector, node_label_bits):
+    """[P,N] node-selector match: task's required label pairs must be a
+    subset of the node's label pairs."""
+    # sel_bits [P, LW], node_label_bits [N, LW] -> [P, N]
+    missing = sel_bits[:, None, :] & ~node_label_bits[None, :, :]
+    ok = jnp.all(missing == 0, axis=-1)
+    return ok | ~has_selector[:, None]
+
+
+def affinity_mask(aff_bits, aff_terms, node_label_bits):
+    """[P,N] required node-affinity: node matches ANY of the task's
+    alternative terms (k8s nodeSelectorTerms OR semantics)."""
+    # aff_bits [P, A, LW], node_label_bits [N, LW] -> [P, A, N]
+    missing = aff_bits[:, :, None, :] & ~node_label_bits[None, None, :, :]
+    term_ok = jnp.all(missing == 0, axis=-1)  # [P, A, N]
+    A = aff_bits.shape[1]
+    term_real = jnp.arange(A)[None, :] < aff_terms[:, None]  # [P, A]
+    any_ok = jnp.any(term_ok & term_real[:, :, None], axis=1)  # [P, N]
+    return any_ok | (aff_terms == 0)[:, None]
+
+
+def taint_mask(tol_bits, node_taint_bits):
+    """[P,N] taint/toleration: every gating (NoSchedule/NoExecute) taint on
+    the node must be tolerated by the task."""
+    untolerated = node_taint_bits[None, :, :] & ~tol_bits[:, None, :]
+    return jnp.all(untolerated == 0, axis=-1)
+
+
+def port_mask(task_port_bits, node_port_bits):
+    """[P,N] host-port conflict: requested ports must be disjoint from the
+    ports already used on the node."""
+    clash = task_port_bits[:, None, :] & node_port_bits[None, :, :]
+    return jnp.all(clash == 0, axis=-1)
+
+
+def static_predicate_mask(arrays: ClusterArrays):
+    """Combine all static predicates into one [P, N] mask.
+
+    Port state is seeded from the snapshot; the allocate kernel keeps its own
+    dynamic copy for ports/pod-counts as it assigns.
+    """
+    t, n = arrays.tasks, arrays.nodes
+    mask = n.ready[None, :] & n.real[None, :] & t.real[:, None]
+    mask &= selector_mask(t.sel_bits, t.has_selector, n.label_bits)
+    mask &= affinity_mask(t.aff_bits, t.aff_terms, n.label_bits)
+    mask &= taint_mask(t.tol_bits, n.taint_bits)
+    return mask

@@ -1,0 +1,47 @@
+"""Vectorized resource-fit kernels.
+
+Device mirror of ``Resource.LessEqual`` / ``IsEmpty``
+(pkg/scheduler/api/resource_info.go:96-108,286-320).  These are the innermost
+predicates of the allocate/preempt hot loops; they must agree bit-for-bit with
+the host model in ``volcano_tpu.api.resource`` (cross-checked by
+tests/test_ops.py against randomized Resource pairs).
+
+Shapes follow the convention: ``l``/``r`` are [..., R] resource vectors,
+``eps`` is the [R] per-slot quantum, ``scalar_slot`` the [R] bool mask of
+extended-resource slots.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def less_equal(l, r, eps, scalar_slot):
+    """Epsilon-tolerant fit: per-slot ``l < r or |l-r| < eps``; extended
+    scalar slots requesting <= one quantum always pass.  Reduces over the
+    trailing resource axis.  Broadcasts l and r."""
+    per_slot = (l < r) | (jnp.abs(l - r) < eps)
+    per_slot = per_slot | (scalar_slot & (l <= eps))
+    return jnp.all(per_slot, axis=-1)
+
+
+def less_equal_strict(l, r):
+    """Plain elementwise <= reduction (LessEqualStrict)."""
+    return jnp.all(l <= r, axis=-1)
+
+
+def less(l, r, eps, scalar_slot):
+    """Strict elementwise < with the nil-scalar edge semantics folded in:
+    scalar slots where r is below one quantum cannot satisfy strict less
+    (resource_info.go:226-261 approximated on dense vectors: a zero slot in
+    l must still be strictly below r's slot unless both are zero-ish)."""
+    per_slot = l < r
+    # Slots where neither side has anything are vacuously fine for the
+    # cpu/mem-style dims only through the strict check; dense encoding keeps
+    # Go's behavior for real (nonzero) slots.
+    return jnp.all(per_slot | (scalar_slot & (l == 0) & (r == 0)), axis=-1)
+
+
+def is_empty(v, eps):
+    """All slots below their quantum (IsEmpty)."""
+    return jnp.all(v < eps, axis=-1)

@@ -1,0 +1,130 @@
+"""Node-scoring kernels: binpack, least-requested, balanced allocation.
+
+Device replacements for the NodeOrderFn score loops
+(``pkg/scheduler/util/scheduler_helper.go:121-183`` running
+``pkg/scheduler/plugins/binpack/binpack.go:200-260`` and
+``pkg/scheduler/plugins/nodeorder/nodeorder.go:172-235`` which wrap the
+upstream LeastRequested / BalancedResourceAllocation priorities).  Scores are
+additive across enabled scorers, exactly like Session.NodeOrderFn
+(session_plugins.go:448-468).
+
+Each scorer takes per-task request vectors and the *current* node state
+(used = allocatable - idle evolves as the solver assigns), returning [N]
+scores for a single task row; the allocate kernel evaluates them per step,
+and ``score_matrix`` vmaps them for batch uses (preempt node ranking).
+
+MAX_PRIORITY mirrors schedulerapi.MaxPriority (=10).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+MAX_PRIORITY = 10.0
+
+
+class ScoreWeights(NamedTuple):
+    """Enable/weight knobs for the additive scorers.
+
+    binpack_* mirrors binpack.go:94-151 (per-resource weights, [R] vector);
+    nodeorder weights mirror nodeorder.go:95-124.  A weight of 0 disables a
+    scorer.
+    """
+
+    binpack_weight: float  # BinPackingWeight
+    binpack_res: jnp.ndarray  # [R] per-resource weights (cpu=1, mem=1, ...)
+    least_req_weight: float  # leastrequested.weight (default 1)
+    most_req_weight: float  # mostrequested.weight (default 0)
+    balanced_weight: float  # balancedresource.weight (default 1)
+    node_affinity_weight: float  # nodeaffinity.weight (default 1)
+
+
+def binpack_score(req, allocatable, used, weights: ScoreWeights):
+    """Best-fit: sum_r weight_r * (used_r + req_r) / capacity_r over the
+    resources the task requests, normalized to [0, 10] * BinPackingWeight
+    (binpack.go:200-260)."""
+    requested = req[None, :]  # [1, R] vs [N, R] nodes
+    used_finally = used + requested
+    valid = (
+        (requested > 0)
+        & (allocatable > 0)
+        & (weights.binpack_res[None, :] > 0)
+        & (used_finally <= allocatable)
+    )
+    per_res = jnp.where(
+        valid,
+        used_finally * weights.binpack_res[None, :] / jnp.where(allocatable > 0, allocatable, 1.0),
+        0.0,
+    )
+    # weightSum counts every requested resource with a configured weight,
+    # even when the over-capacity guard zeroed its score (binpack.go:227-236).
+    counted = (requested > 0) & (weights.binpack_res[None, :] > 0)
+    weight_sum = jnp.sum(
+        jnp.where(counted, weights.binpack_res[None, :], 0.0), axis=-1
+    )
+    score = jnp.sum(per_res, axis=-1)
+    score = jnp.where(weight_sum > 0, score / weight_sum, score)
+    return score * MAX_PRIORITY * weights.binpack_weight
+
+
+def least_requested_score(req, allocatable, used, weights: ScoreWeights):
+    """((capacity - requested) * 10 / capacity) averaged over cpu+mem
+    (upstream LeastRequestedPriorityMap wrapped at nodeorder.go:188-194)."""
+    requested = used[:, :2] + req[None, :2]
+    cap = allocatable[:, :2]
+    per = jnp.where(
+        cap > 0, jnp.clip(cap - requested, min=0.0) * MAX_PRIORITY / jnp.where(cap > 0, cap, 1.0), 0.0
+    )
+    return per.mean(axis=-1) * weights.least_req_weight
+
+
+def most_requested_score(req, allocatable, used, weights: ScoreWeights):
+    """(requested * 10 / capacity) averaged over cpu+mem (upstream
+    MostRequestedPriorityMap; enabled when mostrequested.weight > 0)."""
+    requested = used[:, :2] + req[None, :2]
+    cap = allocatable[:, :2]
+    per = jnp.where(
+        (cap > 0) & (requested <= cap),
+        requested * MAX_PRIORITY / jnp.where(cap > 0, cap, 1.0),
+        0.0,
+    )
+    return per.mean(axis=-1) * weights.most_req_weight
+
+
+def balanced_score(req, allocatable, used, weights: ScoreWeights):
+    """10 - |cpuFraction - memFraction| * 10; zero when any fraction > 1
+    (upstream BalancedResourceAllocationMap wrapped at nodeorder.go:196-202)."""
+    requested = used[:, :2] + req[None, :2]
+    cap = allocatable[:, :2]
+    frac = jnp.where(cap > 0, requested / jnp.where(cap > 0, cap, 1.0), 1.0)
+    diff = jnp.abs(frac[:, 0] - frac[:, 1])
+    score = jnp.where(
+        jnp.any(frac > 1.0, axis=-1), 0.0, (1.0 - diff) * MAX_PRIORITY
+    )
+    return score * weights.balanced_weight
+
+
+def node_score(req, allocatable, idle, weights: ScoreWeights):
+    """Additive score for one task over all nodes ([N]); used = alloc-idle."""
+    used = allocatable - idle
+    s = binpack_score(req, allocatable, used, weights)
+    s = s + least_requested_score(req, allocatable, used, weights)
+    s = s + most_requested_score(req, allocatable, used, weights)
+    s = s + balanced_score(req, allocatable, used, weights)
+    return s
+
+
+def default_weights(width: int, binpack_enabled: bool = False,
+                    nodeorder_enabled: bool = True) -> ScoreWeights:
+    """Weights matching the reference defaults: nodeorder on (least=1,
+    balanced=1), binpack per helm config (cpu=1, mem=1, weight=1)."""
+    return ScoreWeights(
+        binpack_weight=1.0 if binpack_enabled else 0.0,
+        binpack_res=jnp.ones((width,), jnp.float32),
+        least_req_weight=1.0 if nodeorder_enabled else 0.0,
+        most_req_weight=0.0,
+        balanced_weight=1.0 if nodeorder_enabled else 0.0,
+        node_affinity_weight=1.0 if nodeorder_enabled else 0.0,
+    )
