@@ -101,21 +101,26 @@ def build_store(nodes, groups):
     return store
 
 
-def run_solver(store, job_ids=None, deserved_inf=True):
+def run_solver(store, job_ids=None, pending=None, weights=None,
+               task_key=None):
+    """Encode + solve; the single spelling of the 22-arg solve call."""
     snap = store.snapshot()
     job_ids = job_ids or sorted(snap.jobs.keys())
-    pending = []
-    for jid in job_ids:
-        job = snap.jobs[jid]
-        tasks = sorted(
-            job.task_status_index.get(TaskStatus.Pending, {}).values(),
-            key=lambda t: (-t.priority, t.pod.creation_timestamp),
+    if pending is None:
+        key = task_key or (
+            lambda t: (-t.priority, t.pod.creation_timestamp)
         )
-        pending.extend(t for t in tasks if not t.resreq.is_empty())
+        pending = []
+        for jid in job_ids:
+            job = snap.jobs[jid]
+            tasks = sorted(
+                job.task_status_index.get(TaskStatus.Pending, {}).values(),
+                key=key,
+            )
+            pending.extend(t for t in tasks if not t.resreq.is_empty())
     arrays, maps = encode_cluster(snap, pending, job_ids)
     mask = static_predicate_mask(arrays)
     Q, R = arrays.queues.capability.shape
-    deserved = np.full((Q, R), 3e38, np.float32) if deserved_inf else arrays.queues.deserved
     res = solve(
         arrays.nodes.idle,
         arrays.nodes.allocatable,
@@ -132,10 +137,11 @@ def run_solver(store, job_ids=None, deserved_inf=True):
         arrays.jobs.queue,
         arrays.jobs.min_available,
         arrays.jobs.ready_base,
-        jnp.asarray(deserved),
+        jnp.full((Q, R), 3e38, jnp.float32),
         arrays.queues.allocated,
         mask,
-        default_weights(maps.slots.width),
+        jnp.zeros(mask.shape, jnp.float32),
+        weights if weights is not None else default_weights(maps.slots.width),
         jnp.asarray(arrays.eps),
         jnp.asarray(arrays.scalar_slot),
     )
@@ -269,25 +275,7 @@ def test_fit_failure_aborts_rest_of_job():
         groups=[("pg1", 2, "default",
                  [("p0", "1", "1Gi"), ("p1", "100", "1Gi"), ("p2", "1", "1Gi")])],
     )
-    snap = store.snapshot()
-    job = snap.jobs["default/pg1"]
-    pending = sorted(
-        job.task_status_index[TaskStatus.Pending].values(),
-        key=lambda t: t.name,
-    )
-    arrays, maps = encode_cluster(snap, pending, ["default/pg1"])
-    mask = static_predicate_mask(arrays)
-    Q, R = arrays.queues.capability.shape
-    res = solve(
-        arrays.nodes.idle, arrays.nodes.allocatable, arrays.nodes.releasing,
-        arrays.nodes.pipelined, arrays.nodes.num_tasks, arrays.nodes.max_tasks,
-        arrays.nodes.port_bits, arrays.tasks.req, arrays.tasks.init_req,
-        arrays.tasks.job, arrays.tasks.real, arrays.tasks.port_bits,
-        arrays.jobs.queue, arrays.jobs.min_available, arrays.jobs.ready_base,
-        jnp.full((Q, R), 3e38, jnp.float32), arrays.queues.allocated, mask,
-        default_weights(maps.slots.width), jnp.asarray(arrays.eps),
-        jnp.asarray(arrays.scalar_slot),
-    )
+    res, maps = run_solver(store, task_key=lambda t: t.name)
     assert bool(res.fit_failed[0])
     assert bool(res.never_ready[0])
     assert all(int(x) == -1 for x in res.assigned[:3])
@@ -321,6 +309,41 @@ def test_binpack_prefers_used_node():
         nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")],
         groups=[("pg1", 2, "default", [("p0", "1", "1Gi"), ("p1", "1", "1Gi")])],
     )
+    res, maps = run_solver(
+        store,
+        task_key=lambda t: t.name,
+        weights=default_weights(2, binpack_enabled=True,
+                                nodeorder_enabled=False),
+    )
+    a = {maps.task_infos[i].name: int(res.assigned[i]) for i in range(2)}
+    assert a["p0"] == a["p1"]
+
+
+def test_less_matches_host_oracle():
+    from volcano_tpu.ops import less
+
+    rng = np.random.default_rng(7)
+    eps = np.array([10.0, 10 * 1024 * 1024, 10.0], np.float32)
+    scalar = np.array([False, False, True])
+    for _ in range(200):
+        l = rng.choice([0.0, 5.0, 100.0, 1000.0, 2.0e7], size=3)
+        r = rng.choice([0.0, 5.0, 10.0, 101.0, 1000.0, 3.0e7], size=3)
+        host_l = Resource(l[0], l[1], {"g": l[2]} if l[2] else None)
+        host_r = Resource(r[0], r[1], {"g": r[2]} if r[2] else None)
+        got = bool(
+            less(jnp.asarray(l, jnp.float32), jnp.asarray(r, jnp.float32),
+                 jnp.asarray(eps), jnp.asarray(scalar))
+        )
+        want = host_l.less(host_r)
+        assert got == want, f"l={l} r={r}: device={got} host={want}"
+
+
+def test_overused_skip_not_reported_as_gang_discard():
+    # A job skipped for queue overuse must not be flagged never_ready.
+    store = build_store(
+        nodes=[("n1", "8", "16Gi")],
+        groups=[("pg1", 1, "default", [("p0", "1", "1Gi")])],
+    )
     snap = store.snapshot()
     job = snap.jobs["default/pg1"]
     pending = sorted(
@@ -329,16 +352,21 @@ def test_binpack_prefers_used_node():
     arrays, maps = encode_cluster(snap, pending, ["default/pg1"])
     mask = static_predicate_mask(arrays)
     Q, R = arrays.queues.capability.shape
+    # deserved = 0 -> queue overused only when allocation > epsilon; force
+    # overuse by pre-charging the queue allocation.
+    deserved = np.zeros((Q, R), np.float32)
+    q_alloc0 = np.full((Q, R), 1.0e9, np.float32)
     res = solve(
         arrays.nodes.idle, arrays.nodes.allocatable, arrays.nodes.releasing,
         arrays.nodes.pipelined, arrays.nodes.num_tasks, arrays.nodes.max_tasks,
         arrays.nodes.port_bits, arrays.tasks.req, arrays.tasks.init_req,
         arrays.tasks.job, arrays.tasks.real, arrays.tasks.port_bits,
         arrays.jobs.queue, arrays.jobs.min_available, arrays.jobs.ready_base,
-        jnp.full((Q, R), 3e38, jnp.float32), arrays.queues.allocated, mask,
-        default_weights(maps.slots.width, binpack_enabled=True,
-                        nodeorder_enabled=False),
-        jnp.asarray(arrays.eps), jnp.asarray(arrays.scalar_slot),
+        jnp.asarray(deserved), jnp.asarray(q_alloc0), mask,
+        jnp.zeros(mask.shape, jnp.float32),
+        default_weights(maps.slots.width), jnp.asarray(arrays.eps),
+        jnp.asarray(arrays.scalar_slot),
     )
-    a = {maps.task_infos[i].name: int(res.assigned[i]) for i in range(2)}
-    assert a["p0"] == a["p1"]
+    assert int(res.assigned[0]) == -1  # skipped
+    assert not bool(res.never_ready[0])  # but not reported as gang discard
+    assert not bool(res.fit_failed[0])

@@ -1,0 +1,13 @@
+"""Scheduling actions, registered by name
+(pkg/scheduler/actions/factory.go)."""
+
+from ..framework.plugins import register_action
+from .allocate import AllocateAction
+from .backfill import BackfillAction
+from .enqueue import EnqueueAction
+
+register_action(EnqueueAction())
+register_action(AllocateAction())
+register_action(BackfillAction())
+
+__all__ = ["AllocateAction", "BackfillAction", "EnqueueAction"]

@@ -1,0 +1,322 @@
+"""Allocate action: the device-backed hot path.
+
+Replaces ``pkg/scheduler/actions/allocate/allocate.go:40-250``.  The
+namespace -> queue -> job hierarchy is flattened host-side into a static
+processing order (round-robin across namespaces, queues by share, jobs by
+tier order, tasks by task order — the same orderings the reference applies
+via its PriorityQueues), the snapshot is encoded into ``ClusterArrays``, and
+one jitted solver call (``volcano_tpu.ops.allocate.solve``) performs the
+predicate/score/select/capacity loop with gang commit/discard on device.
+The returned assignment matrix is replayed through the Session so host
+state, event handlers (DRF/proportion shares), and bind dispatch stay
+consistent; a fit re-check guards against host/device divergence.
+
+Because the fused order is fixed at encode time while the reference re-sorts
+by live shares after every job, the action supports multiple solver rounds
+(action argument ``rounds``, default 1): each round re-sorts by the updated
+shares and solves the remaining pending tasks.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..api import FitErrors, JobInfo, PodGroupPhase, Resource, TaskInfo, TaskStatus
+from ..arrays import ResourceSlots, encode_cluster
+from ..framework.arguments import get_action_args
+from ..metrics import metrics
+from ..utils.priority_queue import PriorityQueue
+
+log = logging.getLogger(__name__)
+
+ROUNDS_ARG = "rounds"
+
+
+class AllocateAction:
+    name = "allocate"
+
+    def initialize(self):
+        pass
+
+    def un_initialize(self):
+        pass
+
+    # ------------------------------------------------------------- ordering
+
+    def _schedulable_jobs(self, ssn) -> List[JobInfo]:
+        jobs = []
+        for job in ssn.jobs.values():
+            if (
+                job.pod_group is not None
+                and job.pod_group.status.phase == PodGroupPhase.Pending.value
+            ):
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.pass_:
+                continue
+            if job.queue not in ssn.queues:
+                log.warning(
+                    "Skip job %s/%s: queue %s not found",
+                    job.namespace, job.name, job.queue,
+                )
+                continue
+            jobs.append(job)
+        return jobs
+
+    def _job_order(self, ssn, jobs: List[JobInfo]) -> List[JobInfo]:
+        """Flatten namespace round-robin x queue share x job order into a
+        static sequence (allocate.go:107-153 with shares frozen at sort
+        time)."""
+        by_namespace: Dict[str, Dict[str, PriorityQueue]] = {}
+        for job in jobs:
+            by_namespace.setdefault(job.namespace, {}).setdefault(
+                job.queue, PriorityQueue(ssn.job_order_fn)
+            ).push(job)
+
+        namespaces = sorted(
+            by_namespace.keys(),
+            key=lambda ns: 0,
+        )
+        # Order namespaces with the tiered comparator.
+        ns_pq = PriorityQueue(ssn.namespace_order_fn)
+        for ns in by_namespace:
+            ns_pq.push(ns)
+        namespaces = []
+        while not ns_pq.empty():
+            namespaces.append(ns_pq.pop())
+
+        ordered: List[JobInfo] = []
+        # Round-robin namespaces; within a namespace pick the best queue by
+        # queue_order_fn among queues that still have jobs, pop one job.
+        active = {ns: by_namespace[ns] for ns in namespaces}
+        while active:
+            for ns in list(namespaces):
+                queues = active.get(ns)
+                if not queues:
+                    active.pop(ns, None)
+                    continue
+                best_q = None
+                for qid in list(queues.keys()):
+                    if queues[qid].empty():
+                        del queues[qid]
+                        continue
+                    q = ssn.queues[qid]
+                    if ssn.overused(q):
+                        # Skip overused queues at sort time; the kernel
+                        # re-checks with live allocation.
+                        del queues[qid]
+                        continue
+                    if best_q is None or ssn.queue_order_fn(q, ssn.queues[best_q]):
+                        best_q = qid
+                if best_q is None:
+                    active.pop(ns, None)
+                    continue
+                ordered.append(queues[best_q].pop())
+            if not any(active.values()):
+                break
+        return ordered
+
+    def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:
+        tasks = PriorityQueue(ssn.task_order_fn)
+        for task in job.task_status_index.get(TaskStatus.Pending, {}).values():
+            # Skip BestEffort tasks in allocate (backfill handles them).
+            if task.resreq.is_empty():
+                continue
+            tasks.push(task)
+        out = []
+        while not tasks.empty():
+            out.append(tasks.pop())
+        return out
+
+    # ------------------------------------------------------------- execute
+
+    def execute(self, ssn) -> None:
+        import jax.numpy as jnp
+
+        from ..ops import solve, static_predicate_mask
+
+        args = get_action_args(ssn.configurations, self.name)
+        rounds = args.get_int(ROUNDS_ARG, 1) if args else 1
+
+        slots = None
+        for rnd in range(max(rounds, 1)):
+            jobs = self._schedulable_jobs(ssn)
+            ordered_jobs = self._job_order(ssn, jobs)
+            pending: List[TaskInfo] = []
+            job_ids: List[str] = []
+            job_tasks: Dict[str, List[TaskInfo]] = {}
+            for job in ordered_jobs:
+                tasks = self._pending_tasks(ssn, job)
+                if not tasks:
+                    continue
+                job_ids.append(job.uid)
+                job_tasks[job.uid] = tasks
+                pending.extend(tasks)
+            if not pending:
+                return
+
+            cluster = _SessionView(ssn)
+            if slots is None:
+                slots = ResourceSlots.for_cluster(cluster)
+            arrays, maps = encode_cluster(cluster, pending, job_ids, slots)
+            mask = np.asarray(static_predicate_mask(arrays))
+
+            # Host-evaluated predicate columns for pod-(anti)affinity tasks
+            # (the one predicate family that needs cross-pod state).
+            node_list = [cluster.nodes[n] for n in maps.node_names]
+            for i, ti in enumerate(pending):
+                if not (ti.pod.affinity or ti.pod.anti_affinity):
+                    continue
+                for ni, node in enumerate(node_list):
+                    if not mask[i, ni]:
+                        continue
+                    try:
+                        ssn.predicate_fn(ti, node)
+                    except Exception:
+                        mask[i, ni] = False
+
+            weights = ssn.score_weights(slots)
+
+            # Static per-(task,node) score: preferred node affinity
+            # (CalculateNodeAffinityPriority), computed once per cycle.
+            P_pad, N_pad = mask.shape
+            static_score = np.zeros((P_pad, N_pad), np.float32)
+            if weights.node_affinity_weight:
+                for i, ti in enumerate(pending):
+                    prefs = ti.pod.preferred_node_affinity
+                    if not prefs:
+                        continue
+                    total = sum(w for _, w in prefs)
+                    if total <= 0:
+                        continue
+                    for ni, node in enumerate(node_list):
+                        labels = node.node.labels if node.node else {}
+                        got = sum(
+                            w for sel, w in prefs
+                            if all(labels.get(k) == v for k, v in sel.items())
+                        )
+                        static_score[i, ni] = (
+                            got / total * 10.0 * weights.node_affinity_weight
+                        )
+
+            Q, R = arrays.queues.capability.shape
+            deserved = np.full((Q, R), 3.0e38, np.float32)
+            q_alloc0 = np.zeros((Q, R), np.float32)
+            for qid, res in ssn.queue_deserved.items():
+                qi = maps.queue_index.get(qid)
+                if qi is not None:
+                    deserved[qi] = slots.vec(res)
+            for qid, res in ssn.queue_allocated_open.items():
+                qi = maps.queue_index.get(qid)
+                if qi is not None:
+                    q_alloc0[qi] = slots.vec(res)
+
+            t0 = time.perf_counter()
+            result = solve(
+                arrays.nodes.idle,
+                arrays.nodes.allocatable,
+                arrays.nodes.releasing,
+                arrays.nodes.pipelined,
+                arrays.nodes.num_tasks,
+                arrays.nodes.max_tasks,
+                arrays.nodes.port_bits,
+                arrays.tasks.req,
+                arrays.tasks.init_req,
+                arrays.tasks.job,
+                arrays.tasks.real,
+                arrays.tasks.port_bits,
+                arrays.jobs.queue,
+                arrays.jobs.min_available,
+                arrays.jobs.ready_base,
+                jnp.asarray(deserved),
+                jnp.asarray(q_alloc0),
+                jnp.asarray(mask),
+                jnp.asarray(static_score),
+                weights,
+                jnp.asarray(arrays.eps),
+                jnp.asarray(arrays.scalar_slot),
+            )
+            assigned = np.asarray(result.assigned)
+            pipelined = np.asarray(result.pipelined)
+            never_ready = np.asarray(result.never_ready)
+            fit_failed = np.asarray(result.fit_failed)
+            metrics.device_solve_latency.observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+            metrics.snapshot_transfer_bytes.set(
+                sum(a.nbytes for grp in (arrays.nodes, arrays.tasks,
+                                         arrays.jobs, arrays.queues)
+                    for a in grp)
+            )
+
+            made_progress = self._replay(
+                ssn, maps, pending, assigned, pipelined, never_ready,
+                fit_failed,
+            )
+            if not made_progress:
+                return
+
+    # --------------------------------------------------------------- replay
+
+    def _replay(self, ssn, maps, pending, assigned, pipelined, never_ready,
+                fit_failed) -> bool:
+        """Apply the solver's decisions to host session state in task order.
+
+        Committed-job allocations go through session Allocate (status,
+        node accounting, share events, bind dispatch once ready); pipelines
+        apply unconditionally (session-level Pipeline semantics); discarded
+        jobs get fit-error conditions.
+        """
+        progress = False
+        for i, task in enumerate(pending):
+            job = ssn.jobs.get(task.job)
+            if job is None:
+                continue
+            ji = maps.job_index[task.job]
+            node_idx = int(assigned[i])
+            pipe_idx = int(pipelined[i])
+            if node_idx >= 0 and not never_ready[ji]:
+                node_name = maps.node_names[node_idx]
+                node = ssn.nodes[node_name]
+                # Divergence guard: host re-check of the fit decision.
+                if not task.init_resreq.less_equal(node.idle):
+                    log.error(
+                        "Device/host divergence: task %s does not fit %s; "
+                        "skipping", task.name, node_name,
+                    )
+                    continue
+                ssn.allocate_task(task, node_name)
+                progress = True
+            elif pipe_idx >= 0:
+                node_name = maps.node_names[pipe_idx]
+                ssn.pipeline(task, node_name)
+                progress = True
+
+        # Record fit errors for jobs that failed (gang.OnSessionClose reads
+        # these to build Unschedulable conditions).
+        for jid, ji in maps.job_index.items():
+            job = ssn.jobs.get(jid)
+            if job is None:
+                continue
+            if fit_failed[ji]:
+                fe = FitErrors()
+                fe.set_error("no feasible node for task")
+                for task in job.task_status_index.get(
+                    TaskStatus.Pending, {}
+                ).values():
+                    job.nodes_fit_errors[task.uid] = fe
+        return progress
+
+
+class _SessionView:
+    """Adapter presenting a Session as a ClusterInfo for the encoder."""
+
+    def __init__(self, ssn):
+        self.jobs = ssn.jobs
+        self.nodes = ssn.nodes
+        self.queues = ssn.queues
+        self.namespace_info = ssn.namespace_info

@@ -1,0 +1,147 @@
+"""Scheduler YAML configuration schema.
+
+Same YAML shape as the reference (``pkg/scheduler/conf/scheduler_conf.go``)
+so existing ``volcano-scheduler.conf`` files work unchanged: an ``actions``
+string, plugin ``tiers`` with 11 per-plugin enable flags and free-form
+``arguments``, and per-action ``configurations``.  Defaults mirror
+``pkg/scheduler/plugins/defaults.go:20-55`` (every flag defaults to enabled
+except ``enableBestNode``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+
+@dataclass
+class PluginOption:
+    name: str
+    enabled_job_order: Optional[bool] = None
+    enabled_namespace_order: Optional[bool] = None
+    enabled_job_ready: Optional[bool] = None
+    enabled_job_pipelined: Optional[bool] = None
+    enabled_task_order: Optional[bool] = None
+    enabled_preemptable: Optional[bool] = None
+    enabled_reclaimable: Optional[bool] = None
+    enabled_queue_order: Optional[bool] = None
+    enabled_predicate: Optional[bool] = None
+    enabled_best_node: Optional[bool] = None
+    enabled_node_order: Optional[bool] = None
+    arguments: Dict[str, str] = field(default_factory=dict)
+
+    def apply_defaults(self) -> None:
+        """Nil flags default to enabled (defaults.go:20-55); best-node
+        stays opt-in."""
+        for f in (
+            "enabled_job_order",
+            "enabled_namespace_order",
+            "enabled_job_ready",
+            "enabled_job_pipelined",
+            "enabled_task_order",
+            "enabled_preemptable",
+            "enabled_reclaimable",
+            "enabled_queue_order",
+            "enabled_predicate",
+            "enabled_node_order",
+        ):
+            if getattr(self, f) is None:
+                setattr(self, f, True)
+
+
+@dataclass
+class Tier:
+    plugins: List[PluginOption] = field(default_factory=list)
+
+
+@dataclass
+class Configuration:
+    name: str
+    arguments: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfiguration:
+    actions: str = ""
+    tiers: List[Tier] = field(default_factory=list)
+    configurations: List[Configuration] = field(default_factory=list)
+
+
+_YAML_FLAGS = {
+    "enableJobOrder": "enabled_job_order",
+    "enableNamespaceOrder": "enabled_namespace_order",
+    "enableJobReady": "enabled_job_ready",
+    "enableJobPipelined": "enabled_job_pipelined",
+    "enableTaskOrder": "enabled_task_order",
+    "enablePreemptable": "enabled_preemptable",
+    "enableReclaimable": "enabled_reclaimable",
+    "enableQueueOrder": "enabled_queue_order",
+    "enablePredicate": "enabled_predicate",
+    "enableBestNode": "enabled_best_node",
+    "enableNodeOrder": "enabled_node_order",
+}
+
+
+def parse_scheduler_conf(conf_str: str) -> SchedulerConfiguration:
+    """Parse the YAML config and apply plugin defaults
+    (pkg/scheduler/util.go loadSchedulerConf)."""
+    raw = yaml.safe_load(conf_str) or {}
+    conf = SchedulerConfiguration(actions=raw.get("actions", ""))
+    for tier_raw in raw.get("tiers") or []:
+        tier = Tier()
+        for p in tier_raw.get("plugins") or []:
+            opt = PluginOption(name=p["name"])
+            for yaml_key, attr in _YAML_FLAGS.items():
+                if yaml_key in p:
+                    setattr(opt, attr, bool(p[yaml_key]))
+            opt.arguments = {
+                str(k): str(v) for k, v in (p.get("arguments") or {}).items()
+            }
+            opt.apply_defaults()
+            tier.plugins.append(opt)
+        conf.tiers.append(tier)
+    for c in raw.get("configurations") or []:
+        conf.configurations.append(
+            Configuration(
+                name=c.get("name", ""),
+                arguments={
+                    str(k): str(v)
+                    for k, v in (c.get("arguments") or {}).items()
+                },
+            )
+        )
+    return conf
+
+
+# In-binary default configuration (pkg/scheduler/util.go:31-42).
+DEFAULT_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+# Shipped deployment default (installer helm chart config
+# volcano-scheduler.conf: adds conformance + binpack).
+DEPLOYED_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""

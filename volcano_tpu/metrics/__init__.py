@@ -1,0 +1,12 @@
+"""Metrics registry (pkg/scheduler/metrics).
+
+Same metric names as the reference so dashboards carry over
+(metrics.go:38-110, queue.go, job.go, namespace.go), implemented as an
+in-process registry with a Prometheus text-format exposition endpoint
+(``volcano_tpu.metrics.http``) instead of the Go prometheus client.
+TPU-native additions: device solve time and host<->device transfer bytes.
+"""
+
+from .metrics import Metrics, metrics
+
+__all__ = ["Metrics", "metrics"]

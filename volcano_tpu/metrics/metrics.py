@@ -1,0 +1,243 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+Implements the reference's metric set under the same ``volcano`` namespace
+(``pkg/scheduler/metrics/metrics.go:38-110``, ``queue.go:25-124``,
+``job.go:25-36``, ``namespace.go:25-44``) plus TPU-native series for device
+solve latency and snapshot transfer volume.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+# Buckets follow prometheus.DefBuckets spirit; values recorded in the unit
+# named by the metric (ms / us).
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
+    250, 500, 1000, 2500, 5000, 10000,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+class _Histogram:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.data: Dict[LabelKey, List[float]] = {}
+
+    def observe(self, value: float, **labels):
+        self.data.setdefault(_labels_key(labels), []).append(value)
+
+
+class _Gauge:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.data: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels):
+        self.data[_labels_key(labels)] = value
+
+
+class _Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self.data: Dict[LabelKey, float] = {}
+
+    def inc(self, value: float = 1.0, **labels):
+        key = _labels_key(labels)
+        self.data[key] = self.data.get(key, 0.0) + value
+
+
+class Metrics:
+    """The volcano metric family (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        ns = "volcano"
+        self.e2e_scheduling_latency = _Histogram(
+            f"{ns}_e2e_scheduling_latency_milliseconds",
+            "E2e scheduling latency in milliseconds",
+        )
+        self.plugin_scheduling_latency = _Histogram(
+            f"{ns}_plugin_scheduling_latency_microseconds",
+            "Plugin scheduling latency in microseconds",
+        )
+        self.action_scheduling_latency = _Histogram(
+            f"{ns}_action_scheduling_latency_microseconds",
+            "Action scheduling latency in microseconds",
+        )
+        self.task_scheduling_latency = _Histogram(
+            f"{ns}_task_scheduling_latency_microseconds",
+            "Task scheduling latency in microseconds",
+        )
+        self.schedule_attempts = _Counter(
+            f"{ns}_schedule_attempts_total",
+            "Number of attempts to schedule pods, by the result",
+        )
+        self.pod_preemption_victims = _Gauge(
+            f"{ns}_pod_preemption_victims", "Number of selected preemption victims"
+        )
+        self.total_preemption_attempts = _Counter(
+            f"{ns}_total_preemption_attempts",
+            "Total preemption attempts in the cluster till now",
+        )
+        self.unschedule_task_count = _Gauge(
+            f"{ns}_unschedule_task_count", "Number of tasks could not be scheduled"
+        )
+        self.unschedule_job_count = _Gauge(
+            f"{ns}_unschedule_job_count", "Number of jobs could not be scheduled"
+        )
+        self.job_retry_counts = _Counter(
+            f"{ns}_job_retry_counts", "Number of retry counts for one job"
+        )
+        self.job_share = _Gauge(f"{ns}_job_share", "Share for one job")
+        self.queue_allocated_milli_cpu = _Gauge(
+            f"{ns}_queue_allocated_milli_cpu",
+            "Allocated CPU count for one queue",
+        )
+        self.queue_allocated_memory_bytes = _Gauge(
+            f"{ns}_queue_allocated_memory_bytes",
+            "Allocated memory for one queue",
+        )
+        self.queue_request_milli_cpu = _Gauge(
+            f"{ns}_queue_request_milli_cpu", "Request CPU count for one queue"
+        )
+        self.queue_request_memory_bytes = _Gauge(
+            f"{ns}_queue_request_memory_bytes", "Request memory for one queue"
+        )
+        self.queue_deserved_milli_cpu = _Gauge(
+            f"{ns}_queue_deserved_milli_cpu", "Deserved CPU count for one queue"
+        )
+        self.queue_deserved_memory_bytes = _Gauge(
+            f"{ns}_queue_deserved_memory_bytes", "Deserved memory for one queue"
+        )
+        self.queue_share = _Gauge(f"{ns}_queue_share", "Share for one queue")
+        self.queue_weight = _Gauge(f"{ns}_queue_weight", "Weight for one queue")
+        self.queue_overused = _Gauge(
+            f"{ns}_queue_overused", "If one queue is overused"
+        )
+        self.queue_pod_group_inqueue_count = _Gauge(
+            f"{ns}_queue_pod_group_inqueue_count",
+            "Number of Inqueue PodGroup in this queue",
+        )
+        self.queue_pod_group_pending_count = _Gauge(
+            f"{ns}_queue_pod_group_pending_count",
+            "Number of pending PodGroup in this queue",
+        )
+        self.queue_pod_group_running_count = _Gauge(
+            f"{ns}_queue_pod_group_running_count",
+            "Number of running PodGroup in this queue",
+        )
+        self.queue_pod_group_unknown_count = _Gauge(
+            f"{ns}_queue_pod_group_unknown_count",
+            "Number of unknown PodGroup in this queue",
+        )
+        self.namespace_share = _Gauge(
+            f"{ns}_namespace_share", "Share for one namespace"
+        )
+        self.namespace_weight = _Gauge(
+            f"{ns}_namespace_weight", "Weight for one namespace"
+        )
+        self.namespace_weighted_share = _Gauge(
+            f"{ns}_namespace_weighted_share", "Weighted share for one namespace"
+        )
+        # TPU-native additions.
+        self.device_solve_latency = _Histogram(
+            f"{ns}_device_solve_latency_milliseconds",
+            "Device allocate-solver latency in milliseconds",
+        )
+        self.snapshot_transfer_bytes = _Gauge(
+            f"{ns}_snapshot_transfer_bytes",
+            "Bytes transferred host->device for the session snapshot",
+        )
+
+    # ------------------------------------------------------------- helpers
+
+    @contextmanager
+    def plugin_timer(self, plugin: str, on_session: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.plugin_scheduling_latency.observe(
+                (time.perf_counter() - t0) * 1e6,
+                plugin=plugin, OnSession=on_session,
+            )
+
+    @contextmanager
+    def action_timer(self, action: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.action_scheduling_latency.observe(
+                (time.perf_counter() - t0) * 1e6, action=action
+            )
+
+    @contextmanager
+    def e2e_timer(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.e2e_scheduling_latency.observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+
+    def register_preemption_attempt(self):
+        self.total_preemption_attempts.inc()
+
+    def update_preemption_victim_count(self, count: int):
+        self.pod_preemption_victims.set(count)
+
+    # ----------------------------------------------------------- exposition
+
+    def expose_text(self) -> str:
+        """Prometheus text format 0.0.4."""
+        out: List[str] = []
+        with self._lock:
+            for attr in vars(self).values():
+                if isinstance(attr, _Gauge):
+                    out.append(f"# HELP {attr.name} {attr.help}")
+                    out.append(f"# TYPE {attr.name} gauge")
+                    for key, v in attr.data.items():
+                        lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                        out.append(f"{attr.name}{{{lbl}}} {v}")
+                elif isinstance(attr, _Counter):
+                    out.append(f"# HELP {attr.name} {attr.help}")
+                    out.append(f"# TYPE {attr.name} counter")
+                    for key, v in attr.data.items():
+                        lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                        out.append(f"{attr.name}{{{lbl}}} {v}")
+                elif isinstance(attr, _Histogram):
+                    out.append(f"# HELP {attr.name} {attr.help}")
+                    out.append(f"# TYPE {attr.name} histogram")
+                    for key, values in attr.data.items():
+                        lbl_items = [f'{k}="{val}"' for k, val in key]
+                        for b in _DEFAULT_BUCKETS:
+                            cnt = sum(1 for v in values if v <= b)
+                            items = lbl_items + [f'le="{b}"']
+                            out.append(
+                                f"{attr.name}_bucket{{{','.join(items)}}} {cnt}"
+                            )
+                        items = lbl_items + ['le="+Inf"']
+                        out.append(
+                            f"{attr.name}_bucket{{{','.join(items)}}} {len(values)}"
+                        )
+                        lbl = ",".join(lbl_items)
+                        out.append(f"{attr.name}_sum{{{lbl}}} {sum(values)}")
+                        out.append(f"{attr.name}_count{{{lbl}}} {len(values)}")
+        return "\n".join(out) + "\n"
+
+
+metrics = Metrics()

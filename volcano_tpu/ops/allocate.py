@@ -76,7 +76,8 @@ class AllocState(NamedTuple):
     ckpt_q_alloc: jnp.ndarray
     prev_job: jnp.ndarray  # scalar int32
     job_ready: jnp.ndarray  # scalar bool
-    job_skip: jnp.ndarray  # scalar bool
+    job_skip: jnp.ndarray  # scalar bool (overused-skip OR fit-failure abort)
+    job_overskip: jnp.ndarray  # scalar bool: skipped for queue overuse only
 
 
 class AllocResult(NamedTuple):
@@ -118,6 +119,8 @@ def solve(
     q_alloc0,  # [Q, R] allocated at session open
     # predicate + scoring
     static_mask,  # [P, N]
+    static_score,  # [P, N] per-(task,node) score computed at encode time
+    # (preferred node affinity, topology bonuses); added to the dynamic score
     weights: ScoreWeights,
     eps,  # [R]
     scalar_slot,  # [R]
@@ -146,6 +149,7 @@ def solve(
         prev_job=jnp.int32(-1),
         job_ready=jnp.bool_(True),
         job_skip=jnp.bool_(True),
+        job_overskip=jnp.bool_(True),
     )
 
     def step(t, s: AllocState) -> AllocState:
@@ -158,9 +162,10 @@ def solve(
         new_job = jt != s.prev_job
         # Discard when the previous job never reached ready — including
         # jobs aborted mid-way by a fit failure (Go breaks the task loop,
-        # then commit/discard still runs; allocate.go:189-245).  Rollback
-        # restores allocation-side state to the last commit point.
-        discard = new_job & (s.prev_job >= 0) & ~s.job_ready
+        # then commit/discard still runs; allocate.go:189-245).  Jobs that
+        # were only *skipped* for queue overuse were never processed: no
+        # statement existed, so no discard is reported for them.
+        discard = new_job & (s.prev_job >= 0) & ~s.job_ready & ~s.job_overskip
         pj_c = jnp.maximum(s.prev_job, 0)
 
         idle = _sel(discard, s.ckpt_idle, s.idle)
@@ -179,9 +184,8 @@ def solve(
         qj = job_queue[jt_c]
         q_total = q_alloc[qj] + s.q_pip[qj]
         overused = ~less_equal(q_total, deserved[qj], eps, scalar_slot)
-        job_skip = _sel(
-            new_job, (jt < 0) | overused, s.job_skip
-        )
+        job_skip = _sel(new_job, (jt < 0) | overused, s.job_skip)
+        job_overskip = _sel(new_job, (jt < 0) | overused, s.job_overskip)
         job_ready = _sel(
             new_job,
             (jt >= 0) & (ready_base[jt_c] >= min_available[jt_c]),
@@ -203,7 +207,7 @@ def solve(
         feasible = static_mask[tt] & fit_future & pods_ok & ports_ok
         any_feasible = jnp.any(feasible)
 
-        score = node_score(req[tt], allocatable, idle, weights)
+        score = node_score(req[tt], allocatable, idle, weights) + static_score[tt]
         score = jnp.where(feasible, score, NEG)
         best = jnp.argmax(score).astype(jnp.int32)
         fits_idle = less_equal(init_req[tt], idle[best], eps, scalar_slot)
@@ -277,6 +281,7 @@ def solve(
             prev_job=prev_job,
             job_ready=job_ready,
             job_skip=job_skip,
+            job_overskip=job_overskip,
         )
 
     state = jax.lax.fori_loop(0, P + 1, step, state)

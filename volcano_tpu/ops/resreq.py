@@ -31,15 +31,17 @@ def less_equal_strict(l, r):
 
 
 def less(l, r, eps, scalar_slot):
-    """Strict elementwise < with the nil-scalar edge semantics folded in:
-    scalar slots where r is below one quantum cannot satisfy strict less
-    (resource_info.go:226-261 approximated on dense vectors: a zero slot in
-    l must still be strictly below r's slot unless both are zero-ish)."""
+    """Strict elementwise < (resource_info.go:226-261) on dense vectors.
+
+    The host model maps empty scalars to zero slots, so the Go nil-map edge
+    becomes: a zero scalar slot on the left passes only when the right side
+    exceeds one quantum (mirrors "if rrQuant <= min: return false" for a
+    nil-scalar receiver); nonzero slots use plain strict less."""
     per_slot = l < r
-    # Slots where neither side has anything are vacuously fine for the
-    # cpu/mem-style dims only through the strict check; dense encoding keeps
-    # Go's behavior for real (nonzero) slots.
-    return jnp.all(per_slot | (scalar_slot & (l == 0) & (r == 0)), axis=-1)
+    # Absent-vs-absent is vacuously fine; absent-vs-sub-quantum fails.
+    zero_left_ok = scalar_slot & (l == 0) & ((r == 0) | (r > eps))
+    nonzero = ~scalar_slot | (l > 0)
+    return jnp.all((per_slot & nonzero) | zero_left_ok, axis=-1)
 
 
 def is_empty(v, eps):

@@ -1,0 +1,113 @@
+"""Scheduler driver: the per-period session loop
+(pkg/scheduler/scheduler.go).
+
+Every ``schedule_period`` (default 1 s): re-read the YAML config (hot
+reload, scheduler.go:77,89-106), open a session, execute the configured
+action list, close the session.  Config parsing failures keep the last good
+config.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from . import actions as _actions  # noqa: F401  (registers actions)
+from . import plugins as _plugins  # noqa: F401  (registers plugins)
+from .cache import ClusterStore
+from .framework import (
+    DEFAULT_SCHEDULER_CONF,
+    close_session,
+    get_action,
+    open_session,
+    parse_scheduler_conf,
+)
+from .metrics import metrics
+
+log = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: ClusterStore,
+        conf_path: Optional[str] = None,
+        conf_str: Optional[str] = None,
+        schedule_period: float = 1.0,
+    ):
+        self.store = store
+        self.conf_path = conf_path
+        self.conf_str = conf_str
+        self.schedule_period = schedule_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_conf = None
+
+    # --------------------------------------------------------------- config
+
+    def _load_conf(self):
+        conf_str = self.conf_str
+        if self.conf_path:
+            try:
+                conf_str = Path(self.conf_path).read_text()
+            except OSError as err:
+                log.error("Failed to read scheduler conf %s: %s",
+                          self.conf_path, err)
+                conf_str = None
+        if conf_str is None:
+            conf_str = DEFAULT_SCHEDULER_CONF
+        try:
+            conf = parse_scheduler_conf(conf_str)
+        except Exception:
+            log.exception("Failed to parse scheduler conf; keeping last")
+            if self._last_conf is not None:
+                return self._last_conf
+            conf = parse_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        self._last_conf = conf
+        return conf
+
+    # ---------------------------------------------------------------- cycle
+
+    def run_once(self) -> None:
+        """One scheduling cycle (scheduler.go:71-87)."""
+        conf = self._load_conf()
+        action_names = [
+            a.strip() for a in conf.actions.split(",") if a.strip()
+        ]
+        with metrics.e2e_timer():
+            ssn = open_session(self.store, conf.tiers, conf.configurations)
+            try:
+                for name in action_names:
+                    action = get_action(name)
+                    if action is None:
+                        log.warning("Unknown action %s", name)
+                        continue
+                    with metrics.action_timer(name):
+                        action.execute(ssn)
+            finally:
+                close_session(ssn)
+
+    # ----------------------------------------------------------------- loop
+
+    def run(self) -> None:
+        """Start the periodic loop in a background thread."""
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            t0 = time.time()
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("Scheduling cycle failed")
+            elapsed = time.time() - t0
+            self._stop.wait(max(self.schedule_period - elapsed, 0.0))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,5 @@
+"""Shared utilities."""
+
+from .priority_queue import PriorityQueue
+
+__all__ = ["PriorityQueue"]
