@@ -5,9 +5,19 @@ from ..framework.plugins import register_action
 from .allocate import AllocateAction
 from .backfill import BackfillAction
 from .enqueue import EnqueueAction
+from .preempt import PreemptAction
+from .reclaim import ReclaimAction
 
 register_action(EnqueueAction())
 register_action(AllocateAction())
 register_action(BackfillAction())
+register_action(PreemptAction())
+register_action(ReclaimAction())
 
-__all__ = ["AllocateAction", "BackfillAction", "EnqueueAction"]
+__all__ = [
+    "AllocateAction",
+    "BackfillAction",
+    "EnqueueAction",
+    "PreemptAction",
+    "ReclaimAction",
+]

@@ -284,13 +284,15 @@ class Session:
         return False
 
     def _victims(self, registry, flag_attr, arg, candidates) -> List[TaskInfo]:
-        """Tier semantics for victim selection: intersect within a tier;
-        first tier that produced a (possibly empty-after-intersection but
-        initialized) set wins (session_plugins.go:110-193)."""
-        victims: Optional[List[TaskInfo]] = None
-        for ti, tier in enumerate(self.tiers):
-            init = False
-            tier_victims: Optional[List[TaskInfo]] = None
+        """Tier semantics for victim selection (session_plugins.go:110-193):
+        the victim set and its initialized flag persist ACROSS tiers — every
+        enabled plugin intersects the carried set — and the walk stops at the
+        first tier boundary where the set is non-empty.  (Go's empty slices
+        are nil, so `victims != nil` only fires on a populated set, and an
+        earlier tier's empty result keeps poisoning later intersections.)"""
+        victims: List[TaskInfo] = []
+        init = False
+        for tier in self.tiers:
             for opt in tier.plugins:
                 if not getattr(opt, flag_attr, None):
                     continue
@@ -299,16 +301,14 @@ class Session:
                     continue
                 cand = fn(arg, candidates) or []
                 if not init:
-                    tier_victims = list(cand)
+                    victims = list(cand)
                     init = True
                 else:
                     cand_uids = {c.uid for c in cand}
-                    tier_victims = [
-                        v for v in (tier_victims or []) if v.uid in cand_uids
-                    ]
-            if tier_victims is not None:
-                return tier_victims
-        return victims or []
+                    victims = [v for v in victims if v.uid in cand_uids]
+            if victims:
+                return victims
+        return victims
 
     def preemptable(self, preemptor: TaskInfo, preemptees) -> List[TaskInfo]:
         return self._victims(
