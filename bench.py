@@ -1,0 +1,101 @@
+"""Benchmark: full scheduling cycle (OpenSession -> Bind) on synthetic
+clusters.
+
+Default configuration is BASELINE.md config 2 (1k nodes x 10k pending pods,
+binpack + predicates, single queue), overridable via BENCH_NODES/BENCH_PODS/
+BENCH_GANG.  The north-star budget is 100 ms OpenSession->Bind at 10k x 100k
+on one TPU chip (BASELINE.json); vs_baseline reports budget/measured scaled
+by problem size relative to the north-star config (so >= 1.0 means on track
+at the measured scale).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", 1000))
+    n_pods = int(os.environ.get("BENCH_PODS", 10000))
+    gang = int(os.environ.get("BENCH_GANG", 4))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+
+    from volcano_tpu.cache import FakeBinder
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    conf = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+    build_t0 = time.perf_counter()
+    store = synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods, gang_size=gang)
+    build_s = time.perf_counter() - build_t0
+    binder = store.binder  # FakeBinder by default
+
+    sched = Scheduler(store, conf_str=conf)
+
+    # Warm-up cycle: compiles the solver and binds the pods.
+    t0 = time.perf_counter()
+    sched.run_once()
+    warm_s = time.perf_counter() - t0
+    bound_first = len(binder.binds)
+
+    # Steady-state cycles on fresh stores (rebinding the same snapshot shape
+    # hits the jit cache).
+    times = []
+    for r in range(repeats):
+        store_r = synthetic_cluster(
+            n_nodes=n_nodes, n_pods=n_pods, gang_size=gang, seed=r + 1
+        )
+        sched_r = Scheduler(store_r, conf_str=conf)
+        t0 = time.perf_counter()
+        sched_r.run_once()
+        times.append(time.perf_counter() - t0)
+        del store_r, sched_r
+
+    e2e_ms = min(times) * 1e3
+    pods_per_sec = bound_first / (e2e_ms / 1e3) if e2e_ms > 0 else 0.0
+
+    # Budget scaling: north star is 100 ms at 10k x 100k; scale the budget
+    # linearly with task count (the dominant dimension of the sequential
+    # scan) for smaller configs.
+    budget_ms = 100.0 * (n_pods / 100000.0)
+    vs_baseline = budget_ms / e2e_ms if e2e_ms > 0 else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"OpenSession->Bind e2e @ {n_nodes} nodes x "
+                    f"{n_pods} pending pods (gang {gang})"
+                ),
+                "value": round(e2e_ms, 2),
+                "unit": "ms",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+    print(
+        f"# details: warmup={warm_s:.2f}s bound={bound_first} "
+        f"pods/s={pods_per_sec:.0f} build={build_s:.2f}s "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -308,6 +308,10 @@ class Session:
                     victims = [v for v in victims if v.uid in cand_uids]
             if victims:
                 return victims
+            if init:
+                # The carried set is empty and can only shrink under further
+                # intersection — short-circuit the remaining tiers.
+                return victims
         return victims
 
     def preemptable(self, preemptor: TaskInfo, preemptees) -> List[TaskInfo]:

@@ -1,0 +1,5 @@
+"""Multi-chip scaling: device mesh + sharded solve."""
+
+from .mesh import make_mesh, shard_solve_args, sharded_solve
+
+__all__ = ["make_mesh", "shard_solve_args", "sharded_solve"]

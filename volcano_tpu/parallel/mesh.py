@@ -1,0 +1,76 @@
+"""Device mesh + sharded allocate solve.
+
+The framework's scale axis is the NODES dimension of the cluster arrays
+(the reference scales with goroutine fan-out + adaptive node sampling,
+scheduler_helper.go:43-118; we scale by sharding nodes over chips).  The
+solver is pure SPMD-friendly: per-step work is elementwise over [N, R] with
+one argmax reduction, so annotating the N-axis sharding lets GSPMD partition
+the fori_loop body and insert the cross-chip reductions (the argmax becomes
+a pmax tree over ICI).
+
+Task/job/queue state stays replicated — it is tiny (O(P + J + Q) scalars)
+next to the [N, R] node state, and every chip needs the winner of each step
+anyway.
+
+``dryrun_multichip`` in __graft_entry__.py drives this on a virtual CPU mesh;
+the same code runs unchanged on a real multi-chip TPU slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODES_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = NODES_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
+    """Place solve() positional args on the mesh: node-major arrays sharded
+    on the nodes axis, everything else replicated.
+
+    solve()'s signature (ops/allocate.py): the first 7 args are node state
+    ([N, R] / [N] / [N, PW]), then task/job/queue arrays (replicated), the
+    [P, N] static mask and static score (sharded on their N axis), weights,
+    eps, scalar_slot.
+    """
+    node_sharded = NamedSharding(mesh, P(axis))  # leading dim = N
+    replicated = NamedSharding(mesh, P())
+    mask_sharded = NamedSharding(mesh, P(None, axis))  # [P, N]
+
+    out = []
+    n_node_args = 7
+    for i, arg in enumerate(solve_args):
+        if i < n_node_args:
+            out.append(jax.device_put(arg, node_sharded))
+        elif i in (17, 18):  # static_mask, static_score [P, N]
+            out.append(jax.device_put(arg, mask_sharded))
+        elif i == 19:  # ScoreWeights NamedTuple
+            out.append(
+                type(arg)(*[
+                    jax.device_put(np.asarray(x, np.float32), replicated)
+                    for x in arg
+                ])
+            )
+        else:
+            out.append(jax.device_put(arg, replicated))
+    return out
+
+
+def sharded_solve(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
+    """Run the allocate solver with node state sharded over the mesh."""
+    from ..ops.allocate import solve
+
+    # Input shardings drive GSPMD partitioning; no explicit mesh context is
+    # needed for jit with device_put-committed arguments.
+    args = shard_solve_args(mesh, solve_args, axis)
+    return solve(*args)

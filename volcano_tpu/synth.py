@@ -1,0 +1,120 @@
+"""Synthetic cluster generators + solver-arg builder.
+
+Drives the BASELINE benchmark configurations (BASELINE.md: 1k x 10k binpack,
+5k DRF multi-queue, 10k preempt, 50k x 500k hyperscale) and the graft
+entry's example inputs.  This is the rebuild's equivalent of the reference's
+e2e fixture builders (test/e2e/util.go) at synthetic scale.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue, TaskStatus
+from .arrays import ResourceSlots, encode_cluster
+from .cache import ClusterStore
+
+
+def synthetic_cluster(
+    n_nodes: int = 1000,
+    n_pods: int = 10000,
+    gang_size: int = 4,
+    n_queues: int = 1,
+    node_cpu: str = "64",
+    node_mem: str = "256Gi",
+    pod_cpu_choices: Sequence[str] = ("1", "2", "4"),
+    pod_mem_choices: Sequence[str] = ("2Gi", "4Gi", "8Gi"),
+    seed: int = 0,
+) -> ClusterStore:
+    """A cluster of identical nodes and gang jobs with mixed pod sizes."""
+    rng = np.random.default_rng(seed)
+    store = ClusterStore()
+    for i in range(n_nodes):
+        store.add_node(
+            Node(
+                name=f"node-{i:06d}",
+                allocatable={"cpu": node_cpu, "memory": node_mem, "pods": 256},
+            )
+        )
+    for q in range(1, n_queues):
+        store.add_queue(Queue(name=f"queue-{q}", weight=int(rng.integers(1, 9))))
+    queues = ["default"] + [f"queue-{q}" for q in range(1, n_queues)]
+
+    n_gangs = n_pods // gang_size
+    for g in range(n_gangs):
+        queue = queues[g % len(queues)]
+        pg = PodGroup(name=f"pg-{g:06d}", min_member=gang_size, queue=queue)
+        store.add_pod_group(pg)
+        cpu = str(rng.choice(pod_cpu_choices))
+        mem = str(rng.choice(pod_mem_choices))
+        for k in range(gang_size):
+            store.add_pod(
+                Pod(
+                    name=f"pg-{g:06d}-{k}",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[{"cpu": cpu, "memory": mem}],
+                )
+            )
+    return store
+
+
+def solve_args_from_store(
+    store: ClusterStore,
+    binpack: bool = True,
+    nodeorder: bool = False,
+) -> Tuple[tuple, object]:
+    """Encode a store snapshot into the positional args of ops.allocate.solve.
+
+    Returns (args, maps).  Orders jobs by id and tasks by creation; applies
+    infinite deserved shares (no proportion gating).
+    """
+    import jax.numpy as jnp
+
+    from .ops import default_weights, static_predicate_mask
+
+    snap = store.snapshot()
+    job_ids = sorted(snap.jobs.keys())
+    pending = []
+    kept_job_ids = []
+    for jid in job_ids:
+        job = snap.jobs[jid]
+        tasks = sorted(
+            job.task_status_index.get(TaskStatus.Pending, {}).values(),
+            key=lambda t: (-t.priority, t.pod.creation_timestamp),
+        )
+        tasks = [t for t in tasks if not t.resreq.is_empty()]
+        if not tasks:
+            continue
+        kept_job_ids.append(jid)
+        pending.extend(tasks)
+    arrays, maps = encode_cluster(snap, pending, kept_job_ids)
+    mask = static_predicate_mask(arrays)
+    Q, R = arrays.queues.capability.shape
+    args = (
+        arrays.nodes.idle,
+        arrays.nodes.allocatable,
+        arrays.nodes.releasing,
+        arrays.nodes.pipelined,
+        arrays.nodes.num_tasks,
+        arrays.nodes.max_tasks,
+        arrays.nodes.port_bits,
+        arrays.tasks.req,
+        arrays.tasks.init_req,
+        arrays.tasks.job,
+        arrays.tasks.real,
+        arrays.tasks.port_bits,
+        arrays.jobs.queue,
+        arrays.jobs.min_available,
+        arrays.jobs.ready_base,
+        jnp.full((Q, R), 3.0e38, jnp.float32),
+        arrays.queues.allocated,
+        mask,
+        jnp.zeros(mask.shape, jnp.float32),
+        default_weights(maps.slots.width, binpack_enabled=binpack,
+                        nodeorder_enabled=nodeorder),
+        jnp.asarray(arrays.eps),
+        jnp.asarray(arrays.scalar_slot),
+    )
+    return args, maps
