@@ -1,0 +1,240 @@
+"""Admission validator tests (table-driven, mirroring
+admit_job_test.go) + service/CLI end-to-end."""
+
+import json
+import urllib.request
+
+import pytest
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, Queue, QueueState
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.controllers import Action, Event, Job, LifecyclePolicy, TaskSpec
+from volcano_tpu.webhooks import (
+    AdmissionError,
+    AdmittedStore,
+    validate_job_create,
+    validate_job_update,
+    validate_queue_delete,
+)
+
+
+def ok_job(**kw):
+    defaults = dict(
+        name="j1",
+        min_available=2,
+        tasks=[TaskSpec(name="worker", replicas=2,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+    )
+    defaults.update(kw)
+    return Job(**defaults)
+
+
+@pytest.fixture
+def store():
+    return ClusterStore()
+
+
+class TestJobValidation:
+    def test_valid_job_passes(self, store):
+        validate_job_create(ok_job(), store)
+
+    def test_min_available_zero(self, store):
+        with pytest.raises(AdmissionError, match="minAvailable"):
+            validate_job_create(ok_job(min_available=0), store)
+
+    def test_min_available_exceeds_replicas(self, store):
+        with pytest.raises(AdmissionError, match="total replicas"):
+            validate_job_create(ok_job(min_available=5), store)
+
+    def test_duplicate_task_names(self, store):
+        tasks = [
+            TaskSpec(name="worker", replicas=1,
+                     containers=[{"cpu": "1", "memory": "1Gi"}]),
+            TaskSpec(name="worker", replicas=1,
+                     containers=[{"cpu": "1", "memory": "1Gi"}]),
+        ]
+        with pytest.raises(AdmissionError, match="duplicated task name"):
+            validate_job_create(ok_job(tasks=tasks, min_available=1), store)
+
+    def test_invalid_task_name(self, store):
+        tasks = [TaskSpec(name="Not_DNS", replicas=2,
+                          containers=[{"cpu": "1", "memory": "1Gi"}])]
+        with pytest.raises(AdmissionError, match="DNS-1123"):
+            validate_job_create(ok_job(tasks=tasks), store)
+
+    def test_no_tasks(self, store):
+        with pytest.raises(AdmissionError, match="No task"):
+            validate_job_create(ok_job(tasks=[]), store)
+
+    def test_negative_max_retry(self, store):
+        with pytest.raises(AdmissionError, match="maxRetry"):
+            validate_job_create(ok_job(max_retry=-1), store)
+
+    def test_policy_event_and_exitcode_exclusive(self, store):
+        job = ok_job(policies=[
+            LifecyclePolicy(action=Action.RestartJob.value,
+                            event=Event.PodFailed.value, exit_code=3)
+        ])
+        with pytest.raises(AdmissionError, match="simultaneously"):
+            validate_job_create(job, store)
+
+    def test_policy_exit_code_zero(self, store):
+        job = ok_job(policies=[
+            LifecyclePolicy(action=Action.AbortJob.value, exit_code=0)
+        ])
+        with pytest.raises(AdmissionError, match="not a valid error code"):
+            validate_job_create(job, store)
+
+    def test_policy_internal_event_rejected(self, store):
+        job = ok_job(policies=[
+            LifecyclePolicy(action=Action.RestartJob.value,
+                            event=Event.OutOfSync.value)
+        ])
+        with pytest.raises(AdmissionError, match="invalid policy event"):
+            validate_job_create(job, store)
+
+    def test_duplicate_policy_events(self, store):
+        job = ok_job(policies=[
+            LifecyclePolicy(action=Action.RestartJob.value,
+                            event=Event.PodFailed.value),
+            LifecyclePolicy(action=Action.AbortJob.value,
+                            event=Event.PodFailed.value),
+        ])
+        with pytest.raises(AdmissionError, match="duplicate event"):
+            validate_job_create(job, store)
+
+    def test_unknown_queue(self, store):
+        with pytest.raises(AdmissionError, match="queue"):
+            validate_job_create(ok_job(queue="nope"), store)
+
+    def test_closed_queue(self, store):
+        store.add_queue(Queue(name="closed", state=QueueState.Closed.value))
+        with pytest.raises(AdmissionError, match="Open"):
+            validate_job_create(ok_job(queue="closed"), store)
+
+    def test_unknown_plugin(self, store):
+        with pytest.raises(AdmissionError, match="job plugin"):
+            validate_job_create(ok_job(plugins={"nope": []}), store)
+
+    def test_update_replicas_allowed(self):
+        old, new = ok_job(), ok_job()
+        new.tasks[0].replicas = 4
+        validate_job_update(old, new)
+
+    def test_update_task_add_rejected(self):
+        old, new = ok_job(), ok_job()
+        new.tasks = new.tasks + [
+            TaskSpec(name="x", replicas=1,
+                     containers=[{"cpu": "1", "memory": "1Gi"}])
+        ]
+        with pytest.raises(AdmissionError, match="add or remove"):
+            validate_job_update(old, new)
+
+    def test_update_queue_change_rejected(self):
+        old, new = ok_job(), ok_job()
+        new.queue = "other"
+        with pytest.raises(AdmissionError, match="may not change"):
+            validate_job_update(old, new)
+
+
+class TestQueueAndPodAdmission:
+    def test_default_queue_undeletable(self):
+        with pytest.raises(AdmissionError, match="can not be deleted"):
+            validate_queue_delete("default")
+
+    def test_pod_gated_until_podgroup_leaves_pending(self, store):
+        from volcano_tpu.api import PodGroup
+
+        admitted = AdmittedStore(store)
+        store.add_pod_group(PodGroup(name="pg1", min_member=1))
+        pod = Pod(name="p0", annotations={GROUP_NAME_ANNOTATION: "pg1"},
+                  containers=[{"cpu": "1", "memory": "1Gi"}])
+        with pytest.raises(AdmissionError, match="podgroup phase"):
+            admitted.add_pod(pod)
+        store.pod_groups["default/pg1"].status.phase = "Inqueue"
+        admitted.add_pod(pod)  # passes now
+
+
+class TestServiceAndCli:
+    @pytest.fixture
+    def service(self):
+        from volcano_tpu.service import Service
+
+        svc = Service(simulate=True, schedule_period=0.05,
+                      controller_period=0.05)
+        svc.store.add_node(
+            Node(name="n1", allocatable={"cpu": "8", "memory": "16Gi",
+                                         "pods": 110})
+        )
+        port = svc.start(http_port=0)
+        yield svc, f"http://127.0.0.1:{port}"
+        svc.stop()
+
+    def test_submit_job_over_http_and_cli_flow(self, service):
+        import time
+
+        from volcano_tpu.cli.main import main
+
+        svc, server = service
+        # Submit via CLI.
+        assert main(["--server", server, "job", "run", "--name", "cj",
+                     "--replicas", "2", "--min-available", "2"]) == 0
+        # Wait for it to run.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            job = svc.store.batch_jobs.get("default/cj")
+            if job and job.status.state.phase == "Running":
+                break
+            time.sleep(0.1)
+        assert svc.store.batch_jobs["default/cj"].status.state.phase == "Running"
+        # job list / view via CLI (stdout not asserted, must not raise).
+        assert main(["--server", server, "job", "list"]) == 0
+        assert main(["--server", server, "job", "view", "--name", "cj"]) == 0
+        # Suspend -> Aborted.
+        assert main(["--server", server, "job", "suspend",
+                     "--name", "cj"]) == 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (svc.store.batch_jobs["default/cj"].status.state.phase
+                    == "Aborted"):
+                break
+            time.sleep(0.1)
+        assert (svc.store.batch_jobs["default/cj"].status.state.phase
+                == "Aborted")
+
+    def test_queue_cli(self, service):
+        from volcano_tpu.cli.main import main
+
+        svc, server = service
+        assert main(["--server", server, "queue", "create", "--name", "q9",
+                     "--weight", "4"]) == 0
+        assert "q9" in svc.store.raw_queues
+        assert main(["--server", server, "queue", "list"]) == 0
+        assert main(["--server", server, "queue", "operate", "--name", "q9",
+                     "-a", "close"]) == 0
+        assert main(["--server", server, "queue", "delete",
+                     "--name", "q9"]) == 0
+        assert "q9" not in svc.store.raw_queues
+
+    def test_rejected_job_returns_error(self, service):
+        svc, server = service
+        req = urllib.request.Request(
+            server + "/apis/jobs",
+            data=json.dumps({"name": "bad", "minAvailable": 0,
+                             "tasks": []}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 400
+
+    def test_metrics_and_healthz(self, service):
+        svc, server = service
+        with urllib.request.urlopen(server + "/healthz") as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(server + "/metrics") as r:
+            text = r.read().decode()
+        assert "volcano_e2e_scheduling_latency_milliseconds" in text
+
+
+import urllib.error  # noqa: E402

@@ -1,0 +1,384 @@
+"""Controller-plane lifecycle tests.
+
+Mirrors the reference's controller tests (job_state_test.go table style +
+e2e lifecycle flows from test/e2e/job_error_handling.go) against the
+simulated cluster: submit -> enqueue gate -> pods -> bind -> run ->
+policies/commands -> terminal phases.
+"""
+
+import pytest
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroupPhase, PodPhase
+from volcano_tpu.cache import ClusterStore, FakeBinder
+from volcano_tpu.controllers import (
+    Action,
+    Command,
+    ControllerManager,
+    Event,
+    Job,
+    JobPhase,
+    LifecyclePolicy,
+    TaskSpec,
+)
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.sim import ClusterSimulator
+
+
+def make_env(n_nodes=2):
+    store = ClusterStore()
+    for i in range(n_nodes):
+        store.add_node(
+            Node(name=f"n{i}", allocatable={"cpu": "8", "memory": "16Gi",
+                                            "pods": 110})
+        )
+    cm = ControllerManager(store)
+    sched = Scheduler(store)
+    sim = ClusterSimulator(store)
+    return store, cm, sched, sim
+
+
+def simple_job(name="j1", replicas=2, min_available=2, policies=None,
+               task_policies=None, plugins=None):
+    return Job(
+        name=name,
+        min_available=min_available,
+        tasks=[
+            TaskSpec(
+                name="worker",
+                replicas=replicas,
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+                policies=task_policies or [],
+            )
+        ],
+        policies=policies or [],
+        plugins=plugins or {},
+    )
+
+
+def converge(cm, sched, sim, cycles=4, complete=None):
+    for _ in range(cycles):
+        cm.process()
+        sched.run_once()
+        sim.step(complete=complete)
+        cm.process()
+
+
+def test_job_lifecycle_to_running():
+    store, cm, sched, sim = make_env()
+    job = simple_job()
+    store.add_batch_job(job)
+
+    cm.process()
+    # PodGroup created; pod creation gated until Inqueue.
+    assert "default/j1" in store.pod_groups
+    assert not [p for p in store.pods.values() if p.owner_job == "default/j1"]
+
+    converge(cm, sched, sim)
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Running.value
+    assert job.status.running == 2
+
+
+def test_job_completes_when_all_succeed():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(simple_job())
+    converge(cm, sched, sim)
+    # All pods succeed.
+    converge(cm, sched, sim, complete=lambda pod: 0)
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Completed.value
+
+
+def test_pod_failure_restart_policy():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(
+        simple_job(
+            policies=[LifecyclePolicy(action=Action.RestartJob.value,
+                                      event=Event.PodFailed.value)]
+        )
+    )
+    converge(cm, sched, sim)
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Running.value
+    )
+    # Fail one pod.
+    uid = next(
+        p.uid for p in store.pods.values() if p.owner_job == "default/j1"
+    )
+    sim.fail_pod(uid, exit_code=137)
+    cm.process()
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Restarting.value
+    assert job.status.retry_count == 1
+    # Let terminations drain and the job re-run.
+    converge(cm, sched, sim, cycles=6)
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Running.value
+
+
+def test_pod_failure_default_is_sync():
+    # Without a policy, PodFailed just syncs; job keeps running with a
+    # failed count.
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(simple_job(min_available=1))
+    converge(cm, sched, sim)
+    uid = next(
+        p.uid for p in store.pods.values() if p.owner_job == "default/j1"
+    )
+    sim.fail_pod(uid)
+    cm.process()
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Running.value
+    assert job.status.failed == 1
+
+
+def test_exit_code_policy():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(
+        simple_job(
+            policies=[LifecyclePolicy(action=Action.AbortJob.value,
+                                      exit_code=137)]
+        )
+    )
+    converge(cm, sched, sim)
+    uid = next(
+        p.uid for p in store.pods.values() if p.owner_job == "default/j1"
+    )
+    sim.fail_pod(uid, exit_code=137)
+    cm.process()
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Aborting.value
+    )
+    converge(cm, sched, sim, cycles=3)
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Aborted.value
+    )
+
+
+def test_task_level_policy_overrides_job_level():
+    store, cm, sched, sim = make_env()
+    job = simple_job(
+        policies=[LifecyclePolicy(action=Action.AbortJob.value,
+                                  event=Event.PodFailed.value)],
+        task_policies=[LifecyclePolicy(action=Action.RestartJob.value,
+                                       event=Event.PodFailed.value)],
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+    uid = next(
+        p.uid for p in store.pods.values() if p.owner_job == "default/j1"
+    )
+    sim.fail_pod(uid)
+    cm.process()
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Restarting.value
+    )
+
+
+def test_command_abort_and_resume():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(simple_job())
+    converge(cm, sched, sim)
+
+    store.add_command(Command(action=Action.AbortJob.value,
+                              target_kind="Job", target_name="j1"))
+    cm.process()
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Aborting.value
+    )
+    converge(cm, sched, sim, cycles=3)
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Aborted.value
+    )
+
+    store.add_command(Command(action=Action.ResumeJob.value,
+                              target_kind="Job", target_name="j1"))
+    cm.process()
+    converge(cm, sched, sim, cycles=6)
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Running.value
+
+
+def test_max_retry_leads_to_failed():
+    store, cm, sched, sim = make_env()
+    job = simple_job(
+        policies=[LifecyclePolicy(action=Action.RestartJob.value,
+                                  event=Event.PodFailed.value)],
+    )
+    job.max_retry = 1
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+
+    uid = next(
+        p.uid for p in store.pods.values() if p.owner_job == "default/j1"
+    )
+    sim.fail_pod(uid)
+    # retry_count becomes 1 == max_retry, so the restarting state goes
+    # straight to Failed (restarting.go: retryCount >= maxRetry).
+    converge(cm, sched, sim, cycles=4)
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Failed.value
+
+
+def test_scale_up_and_down():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(simple_job(replicas=2, min_available=2))
+    converge(cm, sched, sim)
+    assert len([p for p in store.pods.values()
+                if p.owner_job == "default/j1"]) == 2
+
+    job = store.batch_jobs["default/j1"]
+    job.tasks[0].replicas = 4
+    store.update_batch_job(job)
+    converge(cm, sched, sim)
+    assert len([p for p in store.pods.values()
+                if p.owner_job == "default/j1"]) == 4
+
+    job.tasks[0].replicas = 1
+    store.update_batch_job(job)
+    converge(cm, sched, sim, cycles=3)
+    alive = [
+        p for p in store.pods.values()
+        if p.owner_job == "default/j1" and not p.deleting
+    ]
+    assert len(alive) == 1
+
+
+def test_podgroup_controller_wraps_bare_pod():
+    store, cm, sched, sim = make_env()
+    store.add_pod(Pod(name="bare", containers=[{"cpu": "1",
+                                                "memory": "1Gi"}]))
+    cm.process()
+    pod = next(p for p in store.pods.values() if p.name == "bare")
+    group = pod.annotations.get(GROUP_NAME_ANNOTATION)
+    assert group
+    pg = store.pod_groups[f"default/{group}"]
+    assert pg.min_member == 1
+    # It now schedules.
+    sched.run_once()
+    assert store.binder.binds.get("default/bare")
+
+
+def test_ttl_garbage_collection():
+    store, cm, sched, sim = make_env()
+    job = simple_job()
+    job.ttl_seconds_after_finished = 0.0
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+    converge(cm, sched, sim, complete=lambda pod: 0)
+    # ttl=0: eligible for deletion immediately after finishing; the GC
+    # sweep inside the reconcile pump collects it.
+    cm.gc.sweep()
+    assert "default/j1" not in store.batch_jobs
+    # Cascading cleanup removed the pods and PodGroup too.
+    cm.process()
+    sim.step()
+    assert not [p for p in store.pods.values()
+                if p.owner_job == "default/j1" and not p.deleting]
+    assert "default/j1" not in store.pod_groups
+
+
+def test_rendezvous_plugins_inject_env():
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="mpi",
+        min_available=3,
+        tasks=[
+            TaskSpec(name="master", replicas=1,
+                     containers=[{"cpu": "1", "memory": "1Gi"}]),
+            TaskSpec(name="worker", replicas=2,
+                     containers=[{"cpu": "1", "memory": "1Gi"}]),
+        ],
+        plugins={"svc": [], "ssh": [], "env": []},
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+
+    pods = [p for p in store.pods.values() if p.owner_job == "default/mpi"]
+    assert len(pods) == 3
+    worker = next(p for p in pods if p.task_name == "worker")
+    assert worker.env["MASTER_HOSTS"] == "mpi-master-0.mpi"
+    assert worker.env["WORKER_NUM"] == "2"
+    assert worker.env["VC_PROCESS_COUNT"] == "3"
+    assert worker.env["VC_COORDINATOR_ADDRESS"].startswith("mpi-master-0.mpi:")
+    assert "VK_TASK_INDEX" in worker.env
+    # Hosts ConfigMap + ssh secret exist.
+    assert "worker.host" in store.config_maps["default/mpi-svc"]
+    assert "id_rsa" in store.secrets["default/mpi-ssh"]
+    # Distinct process ids across the gang.
+    ids = sorted(p.env["VC_PROCESS_ID"] for p in pods)
+    assert ids == ["0", "1", "2"]
+
+
+def test_policies_survive_version_bump():
+    # After a restart (version bump), a second PodFailed must still fire
+    # the RestartJob policy (pods carry the job-version annotation).
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(
+        simple_job(
+            policies=[LifecyclePolicy(action=Action.RestartJob.value,
+                                      event=Event.PodFailed.value)]
+        )
+    )
+    converge(cm, sched, sim)
+    uid = next(p.uid for p in store.pods.values()
+               if p.owner_job == "default/j1")
+    sim.fail_pod(uid)
+    converge(cm, sched, sim, cycles=6)
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Running.value
+    )
+    assert store.batch_jobs["default/j1"].status.retry_count == 1
+    # Second failure after restart: policy must fire again.
+    uid = next(p.uid for p in store.pods.values()
+               if p.owner_job == "default/j1"
+               and p.phase == PodPhase.Running)
+    sim.fail_pod(uid)
+    cm.process()
+    assert store.batch_jobs["default/j1"].status.retry_count == 2
+
+
+def test_ssh_keys_stable_across_syncs():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(simple_job(plugins={"ssh": []}))
+    converge(cm, sched, sim)
+    key1 = store.secrets["default/j1-ssh"]["id_rsa"]
+    converge(cm, sched, sim, cycles=3)
+    assert store.secrets["default/j1-ssh"]["id_rsa"] == key1
+
+
+def test_device_unhealthy_policy():
+    store, cm, sched, sim = make_env()
+    store.add_batch_job(
+        simple_job(
+            policies=[LifecyclePolicy(action=Action.RestartJob.value,
+                                      event=Event.DeviceUnhealthy.value)]
+        )
+    )
+    converge(cm, sched, sim)
+    assert store.batch_jobs["default/j1"].status.state.phase == (
+        JobPhase.Running.value
+    )
+    node = next(p.node_name for p in store.pods.values()
+                if p.owner_job == "default/j1")
+    sim.fail_node(node)
+    cm.process()
+    job = store.batch_jobs["default/j1"]
+    assert job.status.state.phase == JobPhase.Restarting.value
+
+
+def test_min_resources_include_scalars():
+    from volcano_tpu.controllers.job_controller import JobController
+
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="tj",
+        min_available=2,
+        tasks=[TaskSpec(name="w", replicas=2,
+                        containers=[{"cpu": "1", "memory": "1Gi",
+                                     "tpu.dev/chips": 4}])],
+    )
+    store.add_batch_job(job)
+    cm.process()
+    pg = store.pod_groups["default/tj"]
+    assert "tpu.dev/chips" in pg.min_resources

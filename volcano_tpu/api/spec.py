@@ -93,8 +93,10 @@ class Pod:
         default_factory=list
     )  # (required labels, weight) soft terms
     required_node_affinity: List[Dict[str, str]] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    exit_code: int = 0
     creation_timestamp: float = 0.0
-    # Volcano job bookkeeping (set by the job controller):
+    # Batch-job bookkeeping (set by the job controller):
     owner_job: str = ""
     task_name: str = ""
 

@@ -75,6 +75,13 @@ class ClusterStore:
         self.pods: Dict[str, Pod] = {}
         self.pod_groups: Dict[str, PodGroup] = {}
         self.raw_queues: Dict[str, Queue] = {}
+        # Controller-plane records (the reference stores these as CRDs /
+        # core objects in the API server).
+        self.batch_jobs: Dict[str, object] = {}  # key -> controllers.apis.Job
+        self.commands: Dict[str, object] = {}  # name -> Command
+        self.config_maps: Dict[str, Dict[str, str]] = {}  # ns/name -> data
+        self.secrets: Dict[str, Dict[str, bytes]] = {}  # ns/name -> data
+        self.services: Dict[str, Dict[str, object]] = {}  # ns/name -> spec
 
         self.binder: Binder = binder or FakeBinder()
         self.evictor: Evictor = evictor or FakeEvictor()
@@ -269,6 +276,57 @@ class ClusterStore:
                 except ValueError:
                     pass
             self._notify("ResourceQuota", "add", quota)
+
+    # ---------------------------------------------------- controller plane
+
+    def add_batch_job(self, job) -> None:
+        with self._lock:
+            self.batch_jobs[job.key] = job
+            self._notify("Job", "add", job)
+
+    def update_batch_job(self, job) -> None:
+        with self._lock:
+            self.batch_jobs[job.key] = job
+            self._notify("Job", "update", job)
+
+    def delete_batch_job(self, key: str) -> None:
+        with self._lock:
+            job = self.batch_jobs.pop(key, None)
+            if job is not None:
+                self._notify("Job", "delete", job)
+
+    def add_command(self, command) -> None:
+        with self._lock:
+            self.commands[command.name] = command
+            self._notify("Command", "add", command)
+
+    def delete_command(self, name: str) -> None:
+        with self._lock:
+            self.commands.pop(name, None)
+
+    def put_config_map(self, ns: str, name: str, data: Dict[str, str]) -> None:
+        with self._lock:
+            self.config_maps[f"{ns}/{name}"] = dict(data)
+
+    def delete_config_map(self, ns: str, name: str) -> None:
+        with self._lock:
+            self.config_maps.pop(f"{ns}/{name}", None)
+
+    def put_secret(self, ns: str, name: str, data) -> None:
+        with self._lock:
+            self.secrets[f"{ns}/{name}"] = dict(data)
+
+    def delete_secret(self, ns: str, name: str) -> None:
+        with self._lock:
+            self.secrets.pop(f"{ns}/{name}", None)
+
+    def put_service(self, ns: str, name: str, spec) -> None:
+        with self._lock:
+            self.services[f"{ns}/{name}"] = spec
+
+    def delete_service(self, ns: str, name: str) -> None:
+        with self._lock:
+            self.services.pop(f"{ns}/{name}", None)
 
     # -------------------------------------------------------------- snapshot
 

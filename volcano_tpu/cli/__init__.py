@@ -1,0 +1,5 @@
+"""vtpuctl: the framework CLI (pkg/cli + cmd/cli in the reference)."""
+
+from .main import main
+
+__all__ = ["main"]

@@ -1,0 +1,167 @@
+"""Job plugins: distributed-workload rendezvous injection.
+
+The reference's entire "distributed training support" is pod discovery
+wiring (SURVEY.md 2.4 item 2): the **svc** plugin publishes a headless
+service + per-task hosts ConfigMap mounted at /etc/volcano and
+``<TASK>_HOSTS``/``<TASK>_NUM`` env (svc/svc.go:306-340), **ssh** generates a
+per-job RSA keypair secret for passwordless MPI (ssh/ssh.go:76-199), and
+**env** injects the task index (env/env.go:45).
+
+The TPU-native analog adds JAX distributed bootstrap info: every pod gets
+``VC_COORDINATOR_ADDRESS`` (task-0's stable DNS name), ``VC_PROCESS_COUNT``
+and ``VC_PROCESS_ID`` — exactly what ``jax.distributed.initialize`` needs —
+so a multi-host JAX workload scheduled by this framework can rendezvous over
+ICI/DCN the way MPI jobs rendezvous via the reference's hostfiles.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List
+
+from ..api import Pod
+
+log = logging.getLogger(__name__)
+
+CONFIG_MAP_MOUNT = "/etc/volcano"  # svc/const.go:28
+TASK_INDEX_ENV = "VK_TASK_INDEX"  # env/env.go
+SSH_SECRET_SUFFIX = "-ssh"
+
+
+def _host_name(job, task_name: str, index: int) -> str:
+    # Stable per-pod DNS-style name under the job's headless service.
+    return f"{job.name}-{task_name}-{index}.{job.name}"
+
+
+class EnvPlugin:
+    """Task index env injection (plugins/env)."""
+
+    name = "env"
+
+    def __init__(self, arguments: List[str]):
+        self.arguments = arguments
+
+    def on_pod_create(self, pod: Pod, job) -> None:
+        idx = pod.annotations.get("volcano-tpu/task-index", "0")
+        pod.env[TASK_INDEX_ENV] = idx
+
+    def on_job_add(self, job, store) -> None:
+        pass
+
+    def on_job_delete(self, job, store) -> None:
+        pass
+
+
+class SvcPlugin:
+    """Headless service + hosts ConfigMap + rendezvous env (plugins/svc)."""
+
+    name = "svc"
+
+    def __init__(self, arguments: List[str]):
+        self.arguments = arguments
+
+    def _hosts(self, job) -> Dict[str, str]:
+        data = {}
+        for task in job.tasks:
+            hosts = [
+                _host_name(job, task.name, i) for i in range(task.replicas)
+            ]
+            data[f"{task.name}.host"] = "\n".join(hosts)
+        return data
+
+    def on_job_add(self, job, store) -> None:
+        store.put_config_map(job.namespace, f"{job.name}-svc", self._hosts(job))
+        store.put_service(
+            job.namespace,
+            job.name,
+            {"headless": True, "selector": {"volcano-tpu/job-name": job.name}},
+        )
+        job.status.controlled_resources["plugin-svc"] = "svc"
+
+    def on_job_delete(self, job, store) -> None:
+        store.delete_config_map(job.namespace, f"{job.name}-svc")
+        store.delete_service(job.namespace, job.name)
+
+    def on_pod_create(self, pod: Pod, job) -> None:
+        total = job.total_tasks()
+        # <TASK>_HOSTS / <TASK>_NUM for every task group (svc.go:306-340).
+        for task in job.tasks:
+            env_name = task.name.upper().replace("-", "_")
+            pod.env[f"{env_name}_HOSTS"] = ",".join(
+                _host_name(job, task.name, i) for i in range(task.replicas)
+            )
+            pod.env[f"{env_name}_NUM"] = str(task.replicas)
+        # TPU-native rendezvous: jax.distributed.initialize inputs.
+        if job.tasks:
+            first = job.tasks[0]
+            pod.env["VC_COORDINATOR_ADDRESS"] = (
+                _host_name(job, first.name, 0) + ":8476"
+            )
+        pod.env["VC_PROCESS_COUNT"] = str(total)
+        # Process id = global index across task groups in spec order.
+        idx = int(pod.annotations.get("volcano-tpu/global-index", "0"))
+        pod.env["VC_PROCESS_ID"] = str(idx)
+
+
+class SshPlugin:
+    """Per-job SSH keypair secret for passwordless MPI (plugins/ssh)."""
+
+    name = "ssh"
+
+    def __init__(self, arguments: List[str]):
+        self.arguments = arguments
+
+    def on_job_add(self, job, store) -> None:
+        try:
+            from cryptography.hazmat.primitives import serialization
+            from cryptography.hazmat.primitives.asymmetric import rsa
+
+            key = rsa.generate_private_key(
+                public_exponent=65537, key_size=2048
+            )
+            private = key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+            public = key.public_key().public_bytes(
+                serialization.Encoding.OpenSSH,
+                serialization.PublicFormat.OpenSSH,
+            )
+        except Exception:  # pragma: no cover - crypto unavailable
+            import secrets as pysecrets
+
+            private = pysecrets.token_bytes(32)
+            public = pysecrets.token_bytes(32)
+        store.put_secret(
+            job.namespace,
+            job.name + SSH_SECRET_SUFFIX,
+            {
+                "id_rsa": private,
+                "id_rsa.pub": public,
+                "authorized_keys": public,
+            },
+        )
+        job.status.controlled_resources["plugin-ssh"] = "ssh"
+
+    def on_job_delete(self, job, store) -> None:
+        store.delete_secret(job.namespace, job.name + SSH_SECRET_SUFFIX)
+
+    def on_pod_create(self, pod: Pod, job) -> None:
+        # Mount marker: the runtime mounts the secret at ~/.ssh.
+        pod.annotations["volcano-tpu/ssh-secret"] = job.name + SSH_SECRET_SUFFIX
+
+
+PLUGIN_BUILDERS: Dict[str, Callable] = {
+    "env": EnvPlugin,
+    "svc": SvcPlugin,
+    "ssh": SshPlugin,
+}
+
+
+def get_job_plugin(name: str, arguments: List[str]):
+    builder = PLUGIN_BUILDERS.get(name)
+    if builder is None:
+        log.warning("Unknown job plugin %s", name)
+        return None
+    return builder(arguments)

@@ -1,0 +1,91 @@
+"""Queue controller (pkg/controllers/queue).
+
+Reconciles Queue status (PodGroup phase counts,
+queue_controller_action.go:34-82) and the open/close lifecycle driven by
+commands (queue_controller.go:268-330; 5-state machine in queue/state/):
+Open/Closed/Closing with CloseQueue draining to Closed once no PodGroups
+remain, OpenQueue reopening.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict
+
+from ..api import PodGroupPhase, QueueState
+from ..cache import ClusterStore
+from .apis import Action
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class QueueStatus:
+    state: str = QueueState.Open.value
+    pending: int = 0
+    running: int = 0
+    unknown: int = 0
+    inqueue: int = 0
+
+
+class QueueController:
+    def __init__(self, store: ClusterStore):
+        self.store = store
+        self.queue = deque()
+        self.status: Dict[str, QueueStatus] = {}
+        store.watch(self._on_store_event)
+
+    def _on_store_event(self, kind: str, event: str, obj) -> None:
+        if kind == "Queue":
+            name = obj if isinstance(obj, str) else obj.name
+            self.queue.append((Action.SyncQueue.value, name))
+        elif kind == "PodGroup":
+            pg = obj
+            if hasattr(pg, "queue"):
+                self.queue.append((Action.SyncQueue.value, pg.queue))
+        elif kind == "Command" and event == "add":
+            if obj.target_kind == "Queue":
+                self.store.delete_command(obj.name)
+                action = (
+                    Action.OpenQueue.value
+                    if obj.action == Action.OpenQueue.value
+                    else Action.CloseQueue.value
+                    if obj.action == Action.CloseQueue.value
+                    else Action.SyncQueue.value
+                )
+                self.queue.append((action, obj.target_name))
+
+    # ------------------------------------------------------------- process
+
+    def process_all(self) -> None:
+        while self.queue:
+            action, name = self.queue.popleft()
+            queue = self.store.raw_queues.get(name)
+            if queue is None:
+                self.status.pop(name, None)
+                continue
+            status = self.status.setdefault(name, QueueStatus(state=queue.state))
+            if action == Action.OpenQueue.value:
+                queue.state = QueueState.Open.value
+            elif action == Action.CloseQueue.value:
+                queue.state = QueueState.Closing.value
+            self._sync(queue, status)
+
+    def _sync(self, queue, status: QueueStatus) -> None:
+        counts = {"Pending": 0, "Running": 0, "Unknown": 0, "Inqueue": 0}
+        total = 0
+        for pg in self.store.pod_groups.values():
+            if pg.queue != queue.name:
+                continue
+            total += 1
+            counts[pg.status.phase] = counts.get(pg.status.phase, 0) + 1
+        status.pending = counts["Pending"]
+        status.running = counts["Running"]
+        status.unknown = counts["Unknown"]
+        status.inqueue = counts["Inqueue"]
+        # Closing drains to Closed once empty (queue/state machine).
+        if queue.state == QueueState.Closing.value and total == 0:
+            queue.state = QueueState.Closed.value
+        status.state = queue.state

@@ -34,22 +34,12 @@ def open_session(cache, tiers: Sequence[Tier],
                  configurations: Sequence[Configuration] = ()) -> Session:
     ssn = Session(cache, tiers, configurations)
 
-    # Instantiate + open plugins (framework.go:36-50).
-    for tier in ssn.tiers:
-        for opt in tier.plugins:
-            builder = get_plugin_builder(opt.name)
-            if builder is None:
-                log.warning("Failed to get plugin %s", opt.name)
-                continue
-            if opt.name not in ssn.plugins:
-                plugin = builder(Arguments(opt.arguments))
-                ssn.plugins[opt.name] = plugin
-    for name, plugin in ssn.plugins.items():
-        with metrics.plugin_timer(name, "OnSessionOpen"):
-            plugin.on_session_open(ssn)
-
-    # Remove invalid jobs from the session, recording conditions
-    # (session.go:107-131).
+    # Session-open job validation sweep (session.go:107-131).  NOTE: this
+    # runs BEFORE plugins register their validators — exactly like the
+    # reference, where openSession() precedes plugin.OnSessionOpen — so
+    # plugin JobValid checks only gate actions (allocate/preempt/...), not
+    # session membership.  Enqueue deliberately sees pod-less Pending
+    # PodGroups (delay-pod-creation design).
     for job in list(ssn.jobs.values()):
         if job.pod_group is not None and job.pod_group.status.conditions:
             ssn.pod_group_status[job.uid] = job.pod_group.status
@@ -67,6 +57,20 @@ def open_session(cache, tiers: Sequence[Tier],
                     ),
                 )
             del ssn.jobs[job.uid]
+
+    # Instantiate + open plugins (framework.go:36-50).
+    for tier in ssn.tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                log.warning("Failed to get plugin %s", opt.name)
+                continue
+            if opt.name not in ssn.plugins:
+                plugin = builder(Arguments(opt.arguments))
+                ssn.plugins[opt.name] = plugin
+    for name, plugin in ssn.plugins.items():
+        with metrics.plugin_timer(name, "OnSessionOpen"):
+            plugin.on_session_open(ssn)
 
     log.debug(
         "Open session %s with %d jobs and %d queues",

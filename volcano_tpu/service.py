@@ -1,0 +1,285 @@
+"""The framework daemon: store + scheduler + controllers + HTTP API.
+
+Bundles what the reference deploys as three binaries (vc-scheduler,
+vc-controller-manager, vc-webhook-manager) into one service for
+single-process deployments: the admission-wrapped store is the API surface,
+the scheduler loop and controller pump run on threads, and a small HTTP
+server exposes the job/queue API (consumed by the vtpuctl CLI), the
+Prometheus metrics endpoint (:8080/metrics in the reference), and healthz
+(:11251).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .api import Node, Queue
+from .cache import ClusterStore
+from .controllers import Action, Command, ControllerManager, Job, LifecyclePolicy, TaskSpec
+from .metrics import metrics
+from .scheduler import Scheduler
+from .sim import ClusterSimulator
+from .webhooks import AdmissionError, AdmittedStore
+
+log = logging.getLogger(__name__)
+
+
+def job_from_dict(data: dict) -> Job:
+    from .api import Toleration
+
+    tasks = [
+        TaskSpec(
+            name=t["name"],
+            replicas=int(t.get("replicas", 1)),
+            containers=t.get("containers", []),
+            init_containers=t.get("initContainers", []),
+            labels=t.get("labels", {}),
+            node_selector=t.get("nodeSelector", {}),
+            tolerations=[
+                Toleration(
+                    key=tol.get("key", ""),
+                    operator=tol.get("operator", "Equal"),
+                    value=tol.get("value", ""),
+                    effect=tol.get("effect", ""),
+                )
+                for tol in t.get("tolerations", [])
+            ],
+            host_ports=t.get("hostPorts", []),
+            env=t.get("env", {}),
+            policies=[_policy_from_dict(p) for p in t.get("policies", [])],
+        )
+        for t in data.get("tasks", [])
+    ]
+    return Job(
+        name=data["name"],
+        namespace=data.get("namespace", "default"),
+        min_available=int(data.get("minAvailable", 0)),
+        tasks=tasks,
+        policies=[_policy_from_dict(p) for p in data.get("policies", [])],
+        plugins=data.get("plugins", {}),
+        queue=data.get("queue", "default"),
+        max_retry=int(data.get("maxRetry", 3)),
+        ttl_seconds_after_finished=data.get("ttlSecondsAfterFinished"),
+        priority_class=data.get("priorityClassName", ""),
+    )
+
+
+def _policy_from_dict(p: dict) -> LifecyclePolicy:
+    return LifecyclePolicy(
+        action=p.get("action", ""),
+        event=p.get("event", ""),
+        events=p.get("events", []),
+        exit_code=p.get("exitCode"),
+        timeout_seconds=p.get("timeout"),
+    )
+
+
+def job_to_dict(job: Job) -> dict:
+    return {
+        "name": job.name,
+        "namespace": job.namespace,
+        "minAvailable": job.min_available,
+        "queue": job.queue,
+        "tasks": [
+            {"name": t.name, "replicas": t.replicas} for t in job.tasks
+        ],
+        "status": {
+            "phase": job.status.state.phase,
+            "pending": job.status.pending,
+            "running": job.status.running,
+            "succeeded": job.status.succeeded,
+            "failed": job.status.failed,
+            "terminating": job.status.terminating,
+            "version": job.status.version,
+            "retryCount": job.status.retry_count,
+            "minAvailable": job.status.min_available,
+        },
+    }
+
+
+class Service:
+    def __init__(
+        self,
+        store: Optional[ClusterStore] = None,
+        conf_path: Optional[str] = None,
+        schedule_period: float = 1.0,
+        controller_period: float = 0.2,
+        simulate: bool = False,
+    ):
+        self.store = store or ClusterStore()
+        self.admitted = AdmittedStore(self.store)
+        self.controllers = ControllerManager(self.store)
+        self.scheduler = Scheduler(
+            self.store, conf_path=conf_path, schedule_period=schedule_period
+        )
+        self.simulator = ClusterSimulator(self.store) if simulate else None
+        self.controller_period = controller_period
+        self._stop = threading.Event()
+        self._threads = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ----------------------------------------------------------------- loops
+
+    def start(self, http_port: int = 11250) -> int:
+        self.scheduler.run()
+        t = threading.Thread(target=self._controller_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        port = self._start_http(http_port)
+        return port
+
+    def _controller_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.controllers.process()
+                if self.simulator is not None:
+                    self.simulator.step()
+            except Exception:
+                log.exception("controller pump failed")
+            self._stop.wait(self.controller_period)
+
+    def stop(self):
+        self._stop.set()
+        self.scheduler.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    # ------------------------------------------------------------------ http
+
+    def _start_http(self, port: int) -> int:
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug(fmt, *args)
+
+            def _send(self, code: int, body: str,
+                      content_type: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _json(self, code: int, obj):
+                self._send(code, json.dumps(obj))
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if url.path == "/healthz":
+                        self._send(200, "ok", "text/plain")
+                    elif url.path == "/metrics":
+                        self._send(200, metrics.expose_text(), "text/plain")
+                    elif parts[:2] == ["apis", "jobs"] and len(parts) == 2:
+                        ns = parse_qs(url.query).get("namespace", [None])[0]
+                        jobs = [
+                            job_to_dict(j)
+                            for j in service.store.batch_jobs.values()
+                            if ns is None or j.namespace == ns
+                        ]
+                        self._json(200, jobs)
+                    elif parts[:2] == ["apis", "jobs"] and len(parts) == 4:
+                        job = service.store.batch_jobs.get(
+                            f"{parts[2]}/{parts[3]}"
+                        )
+                        if job is None:
+                            self._json(404, {"error": "not found"})
+                        else:
+                            self._json(200, job_to_dict(job))
+                    elif parts[:2] == ["apis", "queues"]:
+                        self._json(
+                            200,
+                            [
+                                {"name": q.name, "weight": q.weight,
+                                 "state": q.state,
+                                 "reclaimable": q.reclaimable}
+                                for q in service.store.raw_queues.values()
+                            ],
+                        )
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except Exception as err:  # pragma: no cover
+                    self._json(500, {"error": str(err)})
+
+            def do_POST(self):
+                url = urlparse(self.path)
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if parts[:2] == ["apis", "jobs"]:
+                        job = job_from_dict(body)
+                        service.admitted.add_batch_job(job)
+                        self._json(201, job_to_dict(job))
+                    elif parts[:2] == ["apis", "commands"]:
+                        service.store.add_command(
+                            Command(
+                                action=body["action"],
+                                target_kind=body.get("targetKind", "Job"),
+                                target_name=body["targetName"],
+                                target_namespace=body.get(
+                                    "targetNamespace", "default"
+                                ),
+                            )
+                        )
+                        self._json(201, {"ok": True})
+                    elif parts[:2] == ["apis", "queues"]:
+                        service.admitted.add_queue(
+                            Queue(
+                                name=body["name"],
+                                weight=int(body.get("weight", 1)),
+                                capability=body.get("capability", {}),
+                                reclaimable=body.get("reclaimable", True),
+                            )
+                        )
+                        self._json(201, {"ok": True})
+                    elif parts[:2] == ["apis", "nodes"]:
+                        service.store.add_node(
+                            Node(
+                                name=body["name"],
+                                allocatable=body.get("allocatable", {}),
+                                labels=body.get("labels", {}),
+                            )
+                        )
+                        self._json(201, {"ok": True})
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except AdmissionError as err:
+                    self._json(400, {"error": str(err)})
+                except Exception as err:  # pragma: no cover
+                    self._json(500, {"error": str(err)})
+
+            def do_DELETE(self):
+                url = urlparse(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                try:
+                    if parts[:2] == ["apis", "jobs"] and len(parts) == 4:
+                        service.store.delete_batch_job(
+                            f"{parts[2]}/{parts[3]}"
+                        )
+                        self._json(200, {"ok": True})
+                    elif parts[:2] == ["apis", "queues"] and len(parts) == 3:
+                        service.admitted.delete_queue(parts[2])
+                        self._json(200, {"ok": True})
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except AdmissionError as err:
+                    self._json(400, {"error": str(err)})
+                except Exception as err:  # pragma: no cover
+                    self._json(500, {"error": str(err)})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        actual_port = self._httpd.server_address[1]
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return actual_port

@@ -1,0 +1,89 @@
+"""Cluster simulator: the framework's "kind".
+
+The reference tests multi-node behavior on kind (Kubernetes-in-Docker,
+hack/run-e2e-kind.sh); this simulator plays the kubelet's role against the
+in-memory store so full job lifecycles (submit -> enqueue -> bind -> run ->
+complete/fail -> policies) can be exercised hermetically at any scale
+(SURVEY.md 4.3).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Callable, Dict, Optional
+
+from .api import Pod, PodPhase
+from .cache import ClusterStore
+
+log = logging.getLogger(__name__)
+
+
+class ClusterSimulator:
+    """Steps pod lifecycles: bound pods start running; deleting pods
+    terminate; optional completion/failure injection."""
+
+    def __init__(self, store: ClusterStore):
+        self.store = store
+
+    def step(
+        self,
+        complete: Optional[Callable[[Pod], Optional[int]]] = None,
+    ) -> Dict[str, int]:
+        """One kubelet tick.
+
+        ``complete(pod)`` may return an exit code for running pods: 0 ->
+        Succeeded, nonzero -> Failed, None -> keep running.
+        Returns counts of transitions applied.
+        """
+        started = finished = deleted = 0
+        for pod in list(self.store.pods.values()):
+            if pod.deleting:
+                # Termination completes: the pod object goes away.
+                self.store.delete_pod(pod)
+                deleted += 1
+                continue
+            if pod.phase == PodPhase.Pending and pod.node_name:
+                updated = copy.copy(pod)
+                updated.phase = PodPhase.Running
+                self.store.update_pod(updated)
+                started += 1
+                continue
+            if pod.phase == PodPhase.Running and complete is not None:
+                code = complete(pod)
+                if code is None:
+                    continue
+                updated = copy.copy(pod)
+                updated.exit_code = int(code)
+                updated.phase = (
+                    PodPhase.Succeeded if code == 0 else PodPhase.Failed
+                )
+                self.store.update_pod(updated)
+                finished += 1
+        return {"started": started, "finished": finished, "deleted": deleted}
+
+    def fail_pod(self, uid: str, exit_code: int = 1) -> None:
+        """Inject a pod failure (fault injection; the reference's e2e kills
+        pods to trigger policies, job_error_handling.go:145-276)."""
+        pod = self.store.pods[uid]
+        updated = copy.copy(pod)
+        updated.exit_code = exit_code
+        updated.phase = PodPhase.Failed
+        self.store.update_pod(updated)
+
+    def fail_node(self, name: str) -> None:
+        """Mark a node NotReady (device-unhealthy / node-failure injection).
+
+        The update flows through the store so the job controller raises
+        DeviceUnhealthy requests for resident pods; the pods themselves then
+        fail on the next tick (the kubelet on a dead device cannot report
+        success)."""
+        node_info = self.store.nodes.get(name)
+        if node_info is None or node_info.node is None:
+            return
+        spec = node_info.node
+        spec.ready = False
+        self.store.update_node(spec)
+        for pod in list(self.store.pods.values()):
+            if pod.node_name == name and pod.phase == PodPhase.Running:
+                self.fail_pod(pod.uid, exit_code=255)
