@@ -1,0 +1,168 @@
+"""Solver-vs-oracle parity: the CPU-reference harness of SURVEY.md M5.
+
+The JAX solver (``ops/allocate.solve``) and the NumPy Go-semantics oracle
+(``volcano_tpu/oracle.py``) consume the same dense snapshot; on every
+randomized cluster they must produce identical assignment matrices.  Also
+checks the invariants the reference enforces structurally: gang atomicity
+(all-or-nothing vs min_available) and resource conservation (no node gives
+out more than the assigned tasks' requests).
+"""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue, Taint, Toleration
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.oracle import solve_oracle
+from volcano_tpu.ops.allocate import solve
+from volcano_tpu.synth import solve_args_from_store, synthetic_cluster
+
+
+def _random_store(seed: int) -> ClusterStore:
+    """A messy randomized cluster: heterogeneous nodes, labels, taints,
+    host ports, selectors, gangs of varied min_member, several queues."""
+    rng = np.random.default_rng(seed)
+    store = ClusterStore()
+    n_nodes = int(rng.integers(4, 24))
+    zones = ["zone-a", "zone-b", "zone-c"]
+    for i in range(n_nodes):
+        labels = {"zone": zones[i % len(zones)]}
+        if rng.random() < 0.3:
+            labels["disk"] = "ssd"
+        taints = []
+        if rng.random() < 0.25:
+            taints.append(Taint(key="dedicated", value="batch", effect="NoSchedule"))
+        store.add_node(
+            Node(
+                name=f"node-{i:03d}",
+                allocatable={
+                    "cpu": str(int(rng.integers(4, 33))),
+                    "memory": f"{int(rng.integers(8, 65))}Gi",
+                    "pods": int(rng.integers(4, 64)),
+                },
+                labels=labels,
+                taints=taints,
+            )
+        )
+    for q in range(1, int(rng.integers(1, 4))):
+        store.add_queue(Queue(name=f"queue-{q}", weight=int(rng.integers(1, 5))))
+    queues = ["default"] + [q for q in store.snapshot().queues if q != "default"]
+
+    n_gangs = int(rng.integers(2, 14))
+    for g in range(n_gangs):
+        size = int(rng.integers(1, 6))
+        min_member = int(rng.integers(1, size + 1))
+        pg = PodGroup(
+            name=f"pg-{g:03d}",
+            min_member=min_member,
+            queue=str(rng.choice(queues)),
+        )
+        store.add_pod_group(pg)
+        for k in range(size):
+            selector = {}
+            if rng.random() < 0.3:
+                selector["zone"] = str(rng.choice(zones))
+            tolerations = []
+            if rng.random() < 0.4:
+                tolerations.append(
+                    Toleration(key="dedicated", operator="Equal",
+                               value="batch", effect="NoSchedule")
+                )
+            ports = []
+            if rng.random() < 0.25:
+                ports.append(int(rng.choice([8080, 9090, 9100])))
+            store.add_pod(
+                Pod(
+                    name=f"pg-{g:03d}-{k}",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[{
+                        "cpu": str(int(rng.integers(1, 9))),
+                        "memory": f"{int(rng.integers(1, 17))}Gi",
+                    }],
+                    node_selector=selector,
+                    tolerations=tolerations,
+                    host_ports=ports,
+                    priority=int(rng.integers(0, 3)),
+                )
+            )
+    return store
+
+
+def _compare(args):
+    got = solve(*args)
+    want = solve_oracle(*args)
+    np.testing.assert_array_equal(np.asarray(got.assigned), want.assigned)
+    np.testing.assert_array_equal(np.asarray(got.pipelined), want.pipelined)
+    np.testing.assert_array_equal(np.asarray(got.never_ready), want.never_ready)
+    np.testing.assert_array_equal(np.asarray(got.fit_failed), want.fit_failed)
+    np.testing.assert_allclose(
+        np.asarray(got.idle), want.idle, rtol=1e-5, atol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.q_alloc), want.q_alloc, rtol=1e-5, atol=1e-2
+    )
+    return got, want
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_parity_random_clusters(seed):
+    args, _ = solve_args_from_store(_random_store(seed))
+    _compare(args)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_parity_synthetic_uniform(seed):
+    store = synthetic_cluster(n_nodes=32, n_pods=96, gang_size=3,
+                              n_queues=2, seed=seed)
+    args, _ = solve_args_from_store(store)
+    _compare(args)
+
+
+def test_parity_oversubscribed_gangs():
+    """Cluster too small for all gangs: discard paths must agree."""
+    store = synthetic_cluster(
+        n_nodes=4, n_pods=64, gang_size=8,
+        pod_cpu_choices=("8", "16"), pod_mem_choices=("16Gi", "32Gi"),
+    )
+    args, _ = solve_args_from_store(store)
+    got, want = _compare(args)
+    assert np.asarray(got.never_ready).any() or np.asarray(got.fit_failed).any()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_invariants(seed):
+    args, maps = solve_args_from_store(_random_store(seed))
+    res = solve(*args)
+    assigned = np.asarray(res.assigned)
+    idle_final = np.asarray(res.idle)
+    (idle0, _alloc, _rel, _pip, _nt, _mt, _np_, req, _init, task_job,
+     task_real, _tp, job_queue, min_available, ready_base, *_rest) = args
+    idle0 = np.asarray(idle0)
+    req = np.asarray(req)
+    task_job = np.asarray(task_job)
+    task_real = np.asarray(task_real)
+    min_available = np.asarray(min_available)
+    ready_base = np.asarray(ready_base)
+
+    # Resource conservation: node idle decreases exactly by the sum of
+    # committed requests.
+    expect = idle0.copy()
+    for t, n in enumerate(assigned):
+        if n >= 0:
+            expect[n] -= req[t]
+    np.testing.assert_allclose(idle_final, expect, rtol=1e-5, atol=1e-2)
+
+    # Gang atomicity: a job either reaches min_available or commits nothing.
+    J = min_available.shape[0]
+    counts = np.zeros((J,), int)
+    for t, n in enumerate(assigned):
+        if n >= 0 and task_real[t]:
+            counts[task_job[t]] += 1
+    for j in range(J):
+        if counts[j] > 0:
+            assert counts[j] + ready_base[j] >= min_available[j], (
+                f"job {j}: committed {counts[j]} < min {min_available[j]}"
+            )
+
+    # No node oversubscription beyond the epsilon quantum per task.
+    assert (idle_final >= -1e-2 * max(1, len(assigned))).all()

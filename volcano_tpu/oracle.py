@@ -1,0 +1,254 @@
+"""Pure-NumPy Go-semantics oracle for the allocate solver.
+
+This is the rebuild's CPU-reference parity harness (SURVEY.md section 7 /
+M5): an *independent*, deliberately naive reimplementation of the reference
+allocate loop (``pkg/scheduler/actions/allocate/allocate.go:40-250``) written
+the way the Go code is written — object-at-a-time, explicit statement
+rollback — over the exact same dense arrays the JAX solver consumes
+(``volcano_tpu.ops.allocate.solve``).  Tests feed randomized snapshots to
+both and require identical assignment matrices; any divergence is a solver
+bug (or a documented deviation).
+
+Semantics mirrored, with allocate.go anchors:
+- queue-overuse skip at job open (allocate.go:126-133)
+- per-task: static predicates AND InitResreq <= FutureIdle (allocate.go:98-105)
+  AND pod-count AND host-port availability; no feasible node aborts the
+  remaining tasks of the job (allocate.go:189-193)
+- additive node scoring on live node state, best node = lowest index among
+  maxima (deterministic stand-in for SelectBestNode's random-among-max,
+  scheduler_helper.go:201-212)
+- fits Idle -> stmt.Allocate; else -> ssn.Pipeline (session-level: survives
+  statement discard, allocate.go:224-232)
+- gang commit/discard at job end: roll back allocation-side effects iff the
+  job never reached ready (statement.go:324-367; allocate.go:241-245)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+MAX_PRIORITY = 10.0
+
+
+def np_less_equal(l, r, eps, scalar_slot):
+    """Epsilon-tolerant Resource.LessEqual (resource_info.go:286-320)."""
+    l = np.asarray(l, np.float32)
+    r = np.asarray(r, np.float32)
+    per_slot = (l < r) | (np.abs(l - r) < eps)
+    per_slot = per_slot | (scalar_slot & (l <= eps))
+    return bool(np.all(per_slot, axis=-1)) if per_slot.ndim == 1 else np.all(
+        per_slot, axis=-1
+    )
+
+
+def _binpack(req, allocatable, used, w):
+    requested = req[None, :]
+    used_finally = used + requested
+    valid = (
+        (requested > 0)
+        & (allocatable > 0)
+        & (np.asarray(w.binpack_res)[None, :] > 0)
+        & (used_finally <= allocatable)
+    )
+    safe_alloc = np.where(allocatable > 0, allocatable, 1.0)
+    per_res = np.where(
+        valid, used_finally * np.asarray(w.binpack_res)[None, :] / safe_alloc, 0.0
+    )
+    counted = (requested > 0) & (np.asarray(w.binpack_res)[None, :] > 0)
+    weight_sum = np.sum(
+        np.where(counted, np.asarray(w.binpack_res)[None, :], 0.0), axis=-1
+    )
+    score = np.sum(per_res, axis=-1)
+    score = np.where(weight_sum > 0, score / np.where(weight_sum > 0, weight_sum, 1.0), score)
+    return score * MAX_PRIORITY * w.binpack_weight
+
+
+def _least_requested(req, allocatable, used, w):
+    requested = used[:, :2] + req[None, :2]
+    cap = allocatable[:, :2]
+    safe = np.where(cap > 0, cap, 1.0)
+    per = np.where(cap > 0, np.clip(cap - requested, 0.0, None) * MAX_PRIORITY / safe, 0.0)
+    return per.mean(axis=-1) * w.least_req_weight
+
+
+def _most_requested(req, allocatable, used, w):
+    requested = used[:, :2] + req[None, :2]
+    cap = allocatable[:, :2]
+    safe = np.where(cap > 0, cap, 1.0)
+    per = np.where((cap > 0) & (requested <= cap), requested * MAX_PRIORITY / safe, 0.0)
+    return per.mean(axis=-1) * w.most_req_weight
+
+
+def _balanced(req, allocatable, used, w):
+    requested = used[:, :2] + req[None, :2]
+    cap = allocatable[:, :2]
+    safe = np.where(cap > 0, cap, 1.0)
+    frac = np.where(cap > 0, requested / safe, 1.0)
+    diff = np.abs(frac[:, 0] - frac[:, 1])
+    score = np.where(np.any(frac > 1.0, axis=-1), 0.0, (1.0 - diff) * MAX_PRIORITY)
+    return score * w.balanced_weight
+
+
+def _node_score(req, allocatable, idle, w):
+    used = allocatable - idle
+    return (
+        _binpack(req, allocatable, used, w)
+        + _least_requested(req, allocatable, used, w)
+        + _most_requested(req, allocatable, used, w)
+        + _balanced(req, allocatable, used, w)
+    )
+
+
+class OracleResult(NamedTuple):
+    assigned: np.ndarray  # [P] node index or -1 (committed only)
+    pipelined: np.ndarray  # [P]
+    never_ready: np.ndarray  # [J] bool
+    fit_failed: np.ndarray  # [J] bool
+    idle: np.ndarray  # [N, R]
+    q_alloc: np.ndarray  # [Q, R] allocated + pipelined
+
+
+def solve_oracle(
+    idle0,
+    allocatable,
+    releasing,
+    pipelined0,
+    ntasks0,
+    max_tasks,
+    nports0,
+    req,
+    init_req,
+    task_job,
+    task_real,
+    task_ports,
+    job_queue,
+    min_available,
+    ready_base,
+    deserved,
+    q_alloc0,
+    static_mask,
+    static_score,
+    weights,
+    eps,
+    scalar_slot,
+) -> OracleResult:
+    """Run the Go-shaped sequential loop over the dense snapshot."""
+    to_np = lambda a: np.array(a, copy=True)
+    idle = to_np(idle0).astype(np.float32)
+    allocatable = to_np(allocatable).astype(np.float32)
+    releasing = to_np(releasing).astype(np.float32)
+    pipelined0 = to_np(pipelined0).astype(np.float32)
+    ntasks = to_np(ntasks0).astype(np.int64)
+    max_tasks = to_np(max_tasks).astype(np.int64)
+    nports = to_np(nports0).astype(np.uint32)
+    req = to_np(req).astype(np.float32)
+    init_req = to_np(init_req).astype(np.float32)
+    task_job = to_np(task_job).astype(np.int64)
+    task_real = to_np(task_real).astype(bool)
+    task_ports = to_np(task_ports).astype(np.uint32)
+    job_queue = to_np(job_queue).astype(np.int64)
+    min_available = to_np(min_available).astype(np.int64)
+    ready_base = to_np(ready_base).astype(np.int64)
+    deserved = to_np(deserved).astype(np.float32)
+    q_alloc = to_np(q_alloc0).astype(np.float32)
+    static_mask = to_np(static_mask).astype(bool)
+    static_score = to_np(static_score).astype(np.float32)
+    eps = np.asarray(eps, np.float32)
+    scalar_slot = np.asarray(scalar_slot, bool)
+
+    P = req.shape[0]
+    J = min_available.shape[0]
+
+    pip_extra = np.zeros_like(idle)
+    pip_ntasks = np.zeros_like(ntasks)
+    pip_nports = np.zeros_like(nports)
+    q_pip = np.zeros_like(q_alloc)
+
+    assigned = np.full((P,), -1, np.int32)
+    pipelined = np.full((P,), -1, np.int32)
+    never_ready = np.zeros((J,), bool)
+    fit_failed = np.zeros((J,), bool)
+
+    # Group task rows by job preserving encode order (jobs are contiguous).
+    job_rows = []
+    cur_job, cur = None, []
+    for t in range(P):
+        if not task_real[t]:
+            continue
+        j = int(task_job[t])
+        if j != cur_job:
+            if cur:
+                job_rows.append((cur_job, cur))
+            cur_job, cur = j, []
+        cur.append(t)
+    if cur:
+        job_rows.append((cur_job, cur))
+
+    for j, rows in job_rows:
+        qj = int(job_queue[j])
+        q_total = q_alloc[qj] + q_pip[qj]
+        if not np_less_equal(q_total, deserved[qj], eps, scalar_slot):
+            continue  # overused queue: job skipped, no statement opened
+
+        # Open a statement: checkpoint allocation-side state.
+        ck_idle = idle.copy()
+        ck_ntasks = ntasks.copy()
+        ck_nports = nports.copy()
+        ck_q_alloc = q_alloc.copy()
+        ck_assigned = assigned.copy()
+        job_ready = ready_base[j] >= min_available[j]
+        alloc_cnt = 0
+
+        for t in rows:
+            future_idle = idle + releasing - pipelined0 - pip_extra
+            fit_future = np_less_equal(
+                init_req[t][None, :], future_idle, eps, scalar_slot
+            )
+            total_ntasks = ntasks + pip_ntasks
+            pods_ok = (max_tasks <= 0) | (total_ntasks < max_tasks)
+            ports_used = nports | pip_nports
+            ports_ok = np.all((task_ports[t][None, :] & ports_used) == 0, axis=-1)
+            feasible = static_mask[t] & fit_future & pods_ok & ports_ok
+            if not feasible.any():
+                fit_failed[j] = True
+                break  # abort the rest of this job's tasks
+
+            score = _node_score(req[t], allocatable, idle, weights) + static_score[t]
+            score = np.where(feasible, score, np.float32(-3.0e38))
+            best = int(np.argmax(score))
+
+            if np_less_equal(init_req[t], idle[best], eps, scalar_slot):
+                idle[best] -= req[t]
+                ntasks[best] += 1
+                nports[best] |= task_ports[t]
+                q_alloc[qj] += req[t]
+                assigned[t] = best
+                alloc_cnt += 1
+                if ready_base[j] + alloc_cnt >= min_available[j]:
+                    job_ready = True
+            else:
+                pip_extra[best] += req[t]
+                pip_ntasks[best] += 1
+                pip_nports[best] |= task_ports[t]
+                q_pip[qj] += req[t]
+                pipelined[t] = best
+
+        if not job_ready:
+            # stmt.Discard: roll back allocation-side effects; pipelines stay.
+            idle = ck_idle
+            ntasks = ck_ntasks
+            nports = ck_nports
+            q_alloc = ck_q_alloc
+            assigned = ck_assigned
+            never_ready[j] = True
+
+    return OracleResult(
+        assigned=assigned,
+        pipelined=pipelined,
+        never_ready=never_ready,
+        fit_failed=fit_failed,
+        idle=idle,
+        q_alloc=q_alloc + q_pip,
+    )
