@@ -20,7 +20,7 @@ from volcano_tpu.api import (
     Resource,
     TaskStatus,
 )
-from volcano_tpu.arrays import encode_cluster
+from volcano_tpu.arrays import encode_affinity, encode_cluster
 from volcano_tpu.cache import ClusterStore
 from volcano_tpu.ops import (
     default_weights,
@@ -144,6 +144,8 @@ def run_solver(store, job_ids=None, pending=None, weights=None,
         weights if weights is not None else default_weights(maps.slots.width),
         jnp.asarray(arrays.eps),
         jnp.asarray(arrays.scalar_slot),
+        encode_affinity(snap, pending, maps.node_names,
+                        mask.shape[1], mask.shape[0]),
     )
     return res, maps
 
@@ -366,6 +368,8 @@ def test_overused_skip_not_reported_as_gang_discard():
         jnp.zeros(mask.shape, jnp.float32),
         default_weights(maps.slots.width), jnp.asarray(arrays.eps),
         jnp.asarray(arrays.scalar_slot),
+        encode_affinity(snap, pending, maps.node_names,
+                        mask.shape[1], mask.shape[0]),
     )
     assert int(res.assigned[0]) == -1  # skipped
     assert not bool(res.never_ready[0])  # but not reported as gang discard

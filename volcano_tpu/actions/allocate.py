@@ -26,7 +26,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..api import FitErrors, JobInfo, PodGroupPhase, Resource, TaskInfo, TaskStatus
-from ..arrays import ResourceSlots, encode_cluster
+from ..arrays import ResourceSlots, encode_affinity, encode_cluster
 from ..framework.arguments import get_action_args
 from ..metrics import metrics
 from ..utils.priority_queue import PriorityQueue
@@ -164,20 +164,15 @@ class AllocateAction:
                 slots = ResourceSlots.for_cluster(cluster)
             arrays, maps = encode_cluster(cluster, pending, job_ids, slots)
             mask = np.asarray(static_predicate_mask(arrays))
-
-            # Host-evaluated predicate columns for pod-(anti)affinity tasks
-            # (the one predicate family that needs cross-pod state).
             node_list = [cluster.nodes[n] for n in maps.node_names]
-            for i, ti in enumerate(pending):
-                if not (ti.pod.affinity or ti.pod.anti_affinity):
-                    continue
-                for ni, node in enumerate(node_list):
-                    if not mask[i, ni]:
-                        continue
-                    try:
-                        ssn.predicate_fn(ti, node)
-                    except Exception:
-                        mask[i, ni] = False
+
+            # Inter-pod (anti)affinity + spread: per-(term, domain) count
+            # tensors, checked and updated live inside the solver (replaces
+            # the former host-evaluated [P, N] fallback columns).
+            aff = encode_affinity(
+                cluster, pending, maps.node_names,
+                mask.shape[1], mask.shape[0],
+            )
 
             weights = ssn.score_weights(slots)
 
@@ -239,6 +234,7 @@ class AllocateAction:
                 weights,
                 jnp.asarray(arrays.eps),
                 jnp.asarray(arrays.scalar_slot),
+                aff,
             )
             assigned = np.asarray(result.assigned)
             pipelined = np.asarray(result.pipelined)

@@ -93,6 +93,17 @@ class Pod:
         default_factory=list
     )  # (required labels, weight) soft terms
     required_node_affinity: List[Dict[str, str]] = field(default_factory=list)
+    # Soft inter-pod terms (upstream preferredDuringScheduling...): scored,
+    # not gating (nodeorder.go:217-235 InterPodAffinity analog).
+    preferred_affinity: List[Tuple["AffinityTerm", int]] = field(
+        default_factory=list
+    )
+    preferred_anti_affinity: List[Tuple["AffinityTerm", int]] = field(
+        default_factory=list
+    )
+    # Topology spread: (topology_key, weight) — softly prefer domains with
+    # fewer pods of this pod's own job/PodGroup.
+    topology_spread: List[Tuple[str, int]] = field(default_factory=list)
     env: Dict[str, str] = field(default_factory=dict)
     exit_code: int = 0
     creation_timestamp: float = 0.0

@@ -1,5 +1,6 @@
 """Dense array schema + snapshot encoder for the device-side data plane."""
 
+from .affinity import AffinityArgs, empty_affinity, encode_affinity
 from .schema import (
     ClusterArrays,
     IndexMaps,
@@ -13,6 +14,9 @@ from .schema import (
 )
 
 __all__ = [
+    "AffinityArgs",
+    "empty_affinity",
+    "encode_affinity",
     "ClusterArrays",
     "IndexMaps",
     "JobArrays",

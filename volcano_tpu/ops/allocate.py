@@ -7,8 +7,10 @@ with a single sequential scan over pre-ordered tasks carrying dense cluster
 state.  Semantics preserved per task step:
 
 - predicate  = static mask (labels/taints/ports/ready) AND InitResreq fits
-  FutureIdle (allocate.go:98-105) AND pod-count fits AND no port clash
-  (the dynamic parts of the predicates plugin, updated as the solver assigns)
+  FutureIdle (allocate.go:98-105) AND pod-count fits AND no port clash AND
+  inter-pod (anti)affinity on live per-(term, domain) count tensors
+  (the dynamic parts of the predicates plugin, updated as the solver assigns;
+  predicates.go:111-136,272-291)
 - score      = additive scorers on current node state (allocate.go:202)
 - selection  = masked argmax (SelectBestNode; first-index tie-break instead
   of random-among-max)
@@ -46,6 +48,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..arrays.affinity import AffinityArgs
 from .resreq import less_equal
 from .scoring import ScoreWeights, node_score
 
@@ -54,8 +57,9 @@ NEG = jnp.float32(-3.0e38)
 
 class AllocState(NamedTuple):
     """Carry of the sequential scan.  Allocation-side state (idle, ntasks,
-    nports, q_alloc) is checkpointed at job boundaries for gang rollback;
-    pipeline-side state (pip_*) survives rollback (session-level Pipeline)."""
+    nports, q_alloc, cnt_alloc) is checkpointed at job boundaries for gang
+    rollback; pipeline-side state (pip_*) survives rollback (session-level
+    Pipeline)."""
 
     idle: jnp.ndarray  # [N, R]
     pip_extra: jnp.ndarray  # [N, R] pipelined additions this cycle
@@ -63,6 +67,8 @@ class AllocState(NamedTuple):
     pip_ntasks: jnp.ndarray  # [N]
     nports: jnp.ndarray  # [N, PW] uint32
     pip_nports: jnp.ndarray  # [N, PW]
+    cnt_alloc: jnp.ndarray  # [E, D] affinity-term counts from allocations
+    cnt_pip: jnp.ndarray  # [E, D] affinity-term counts from pipelines
     q_alloc: jnp.ndarray  # [Q, R]
     q_pip: jnp.ndarray  # [Q, R]
     assigned: jnp.ndarray  # [P] node index or -1
@@ -73,6 +79,7 @@ class AllocState(NamedTuple):
     ckpt_idle: jnp.ndarray
     ckpt_ntasks: jnp.ndarray
     ckpt_nports: jnp.ndarray
+    ckpt_cnt: jnp.ndarray
     ckpt_q_alloc: jnp.ndarray
     prev_job: jnp.ndarray  # scalar int32
     job_ready: jnp.ndarray  # scalar bool
@@ -124,9 +131,13 @@ def solve(
     weights: ScoreWeights,
     eps,  # [R]
     scalar_slot,  # [R]
+    aff: AffinityArgs,  # inter-pod affinity/spread count block
 ) -> AllocResult:
     P, _ = req.shape
     J = min_available.shape[0]
+    E, _D = aff.cnt0.shape
+    cnt0 = aff.cnt0.astype(jnp.int32)
+    term_arange = jnp.arange(E)
 
     state = AllocState(
         idle=idle0,
@@ -135,6 +146,8 @@ def solve(
         pip_ntasks=jnp.zeros_like(ntasks0),
         nports=nports0,
         pip_nports=jnp.zeros_like(nports0),
+        cnt_alloc=cnt0,
+        cnt_pip=jnp.zeros_like(cnt0),
         q_alloc=q_alloc0,
         q_pip=jnp.zeros_like(q_alloc0),
         assigned=jnp.full((P,), -1, jnp.int32),
@@ -145,6 +158,7 @@ def solve(
         ckpt_idle=idle0,
         ckpt_ntasks=ntasks0,
         ckpt_nports=nports0,
+        ckpt_cnt=cnt0,
         ckpt_q_alloc=q_alloc0,
         prev_job=jnp.int32(-1),
         job_ready=jnp.bool_(True),
@@ -171,6 +185,7 @@ def solve(
         idle = _sel(discard, s.ckpt_idle, s.idle)
         ntasks = _sel(discard, s.ckpt_ntasks, s.ntasks)
         nports = _sel(discard, s.ckpt_nports, s.nports)
+        cnt_alloc = _sel(discard, s.ckpt_cnt, s.cnt_alloc)
         q_alloc = _sel(discard, s.ckpt_q_alloc, s.q_alloc)
         never_ready = s.never_ready.at[pj_c].set(
             s.never_ready[pj_c] | discard
@@ -180,6 +195,7 @@ def solve(
         ckpt_idle = _sel(new_job, idle, s.ckpt_idle)
         ckpt_ntasks = _sel(new_job, ntasks, s.ckpt_ntasks)
         ckpt_nports = _sel(new_job, nports, s.ckpt_nports)
+        ckpt_cnt = _sel(new_job, cnt_alloc, s.ckpt_cnt)
         ckpt_q_alloc = _sel(new_job, q_alloc, s.ckpt_q_alloc)
         qj = job_queue[jt_c]
         q_total = q_alloc[qj] + s.q_pip[qj]
@@ -204,10 +220,31 @@ def solve(
         pods_ok = (max_tasks <= 0) | (total_ntasks < max_tasks)
         ports_used = nports | s.pip_nports
         ports_ok = jnp.all((task_ports[tt][None, :] & ports_used) == 0, axis=-1)
+
+        # Inter-pod affinity/anti-affinity + soft spread on the live counts.
+        # cval[N, E]: matching-pod count in each node's domain for each term;
+        # -1 domains (node lacks the topology label) read as 0.
+        cnt = cnt_alloc + s.cnt_pip  # [E, D]
+        dome = aff.node_dom[:, aff.term_key]  # [N, E]
+        cval = cnt[term_arange[None, :], jnp.maximum(dome, 0)]
+        cval = jnp.where(dome >= 0, cval, 0)
+        total = jnp.sum(cnt, axis=-1)  # [E]
+        req_a = aff.t_req_aff[tt]  # [E]
+        req_n = aff.t_req_anti[tt]
+        # Upstream self-match rule: an affinity term with no matching pod
+        # anywhere passes iff the incoming pod matches its own selector.
+        aff_term_ok = (cval > 0) | ((total == 0) & aff.t_matches[tt])[None, :]
+        aff_ok = jnp.all(~req_a[None, :] | aff_term_ok, axis=-1)
+        anti_ok = jnp.all(~req_n[None, :] | (cval == 0), axis=-1)
+
         feasible = static_mask[tt] & fit_future & pods_ok & ports_ok
+        feasible = feasible & aff_ok & anti_ok
         any_feasible = jnp.any(feasible)
 
         score = node_score(req[tt], allocatable, idle, weights) + static_score[tt]
+        score = score + jnp.sum(
+            aff.t_soft[tt][None, :] * cval.astype(jnp.float32), axis=-1
+        )
         score = jnp.where(feasible, score, NEG)
         best = jnp.argmax(score).astype(jnp.int32)
         fits_idle = less_equal(init_req[tt], idle[best], eps, scalar_slot)
@@ -224,6 +261,13 @@ def solve(
             jnp.where(do_alloc, nports[best] | task_ports[tt], nports[best])
         )
         q_alloc = q_alloc.at[qj].add(radd)
+        # Affinity-count update: the placed pod becomes "resident" for every
+        # term its labels/job match (predicates plugin Allocate event).
+        dom_t = aff.node_dom[best, aff.term_key]  # [E]
+        inc_base = aff.t_matches[tt] & (dom_t >= 0)
+        cnt_alloc = cnt_alloc.at[term_arange, jnp.maximum(dom_t, 0)].add(
+            (inc_base & do_alloc).astype(jnp.int32)
+        )
         assigned = s.assigned.at[tt].set(
             jnp.where(do_alloc, best, s.assigned[tt])
         )
@@ -238,6 +282,7 @@ def solve(
         ckpt_idle = _sel(commit, idle, ckpt_idle)
         ckpt_ntasks = _sel(commit, ntasks, ckpt_ntasks)
         ckpt_nports = _sel(commit, nports, ckpt_nports)
+        ckpt_cnt = _sel(commit, cnt_alloc, ckpt_cnt)
         ckpt_q_alloc = _sel(commit, q_alloc, ckpt_q_alloc)
 
         # Pipeline-side updates (ssn.Pipeline; survive discard).
@@ -250,6 +295,9 @@ def solve(
                 s.pip_nports[best] | task_ports[tt],
                 s.pip_nports[best],
             )
+        )
+        cnt_pip = s.cnt_pip.at[term_arange, jnp.maximum(dom_t, 0)].add(
+            (inc_base & do_pipeline).astype(jnp.int32)
         )
         q_pip = s.q_pip.at[qj].add(padd)
         pipelined = s.pipelined.at[tt].set(
@@ -267,6 +315,8 @@ def solve(
             pip_ntasks=pip_ntasks,
             nports=nports,
             pip_nports=pip_nports,
+            cnt_alloc=cnt_alloc,
+            cnt_pip=cnt_pip,
             q_alloc=q_alloc,
             q_pip=q_pip,
             assigned=assigned,
@@ -277,6 +327,7 @@ def solve(
             ckpt_idle=ckpt_idle,
             ckpt_ntasks=ckpt_ntasks,
             ckpt_nports=ckpt_nports,
+            ckpt_cnt=ckpt_cnt,
             ckpt_q_alloc=ckpt_q_alloc,
             prev_job=prev_job,
             job_ready=job_ready,

@@ -133,6 +133,7 @@ def solve_oracle(
     weights,
     eps,
     scalar_slot,
+    aff=None,
 ) -> OracleResult:
     """Run the Go-shaped sequential loop over the dense snapshot."""
     to_np = lambda a: np.array(a, copy=True)
@@ -160,6 +161,21 @@ def solve_oracle(
 
     P = req.shape[0]
     J = min_available.shape[0]
+
+    if aff is None:
+        from .arrays.affinity import empty_affinity
+
+        aff = empty_affinity(idle.shape[0], P)
+    node_dom = np.asarray(aff.node_dom, np.int64)
+    term_key = np.asarray(aff.term_key, np.int64)
+    cnt_alloc = np.array(aff.cnt0, np.int64, copy=True)
+    cnt_pip = np.zeros_like(cnt_alloc)
+    t_req_aff = np.asarray(aff.t_req_aff, bool)
+    t_req_anti = np.asarray(aff.t_req_anti, bool)
+    t_matches = np.asarray(aff.t_matches, bool)
+    t_soft = np.asarray(aff.t_soft, np.float32)
+    E = cnt_alloc.shape[0]
+    term_ar = np.arange(E)
 
     pip_extra = np.zeros_like(idle)
     pip_ntasks = np.zeros_like(ntasks)
@@ -196,6 +212,7 @@ def solve_oracle(
         ck_idle = idle.copy()
         ck_ntasks = ntasks.copy()
         ck_nports = nports.copy()
+        ck_cnt = cnt_alloc.copy()
         ck_q_alloc = q_alloc.copy()
         ck_assigned = assigned.copy()
         job_ready = ready_base[j] >= min_available[j]
@@ -210,19 +227,37 @@ def solve_oracle(
             pods_ok = (max_tasks <= 0) | (total_ntasks < max_tasks)
             ports_used = nports | pip_nports
             ports_ok = np.all((task_ports[t][None, :] & ports_used) == 0, axis=-1)
+
+            cnt = cnt_alloc + cnt_pip  # [E, D]
+            dome = node_dom[:, term_key]  # [N, E]
+            cval = cnt[term_ar[None, :], np.maximum(dome, 0)]
+            cval = np.where(dome >= 0, cval, 0)
+            total = cnt.sum(axis=-1)  # [E]
+            aff_term_ok = (cval > 0) | ((total == 0) & t_matches[t])[None, :]
+            aff_ok = np.all(~t_req_aff[t][None, :] | aff_term_ok, axis=-1)
+            anti_ok = np.all(~t_req_anti[t][None, :] | (cval == 0), axis=-1)
+
             feasible = static_mask[t] & fit_future & pods_ok & ports_ok
+            feasible = feasible & aff_ok & anti_ok
             if not feasible.any():
                 fit_failed[j] = True
                 break  # abort the rest of this job's tasks
 
             score = _node_score(req[t], allocatable, idle, weights) + static_score[t]
+            score = score + np.sum(
+                t_soft[t][None, :] * cval.astype(np.float32), axis=-1
+            )
             score = np.where(feasible, score, np.float32(-3.0e38))
             best = int(np.argmax(score))
 
+            dom_t = node_dom[best, term_key]  # [E]
+            inc = t_matches[t] & (dom_t >= 0)
             if np_less_equal(init_req[t], idle[best], eps, scalar_slot):
                 idle[best] -= req[t]
                 ntasks[best] += 1
                 nports[best] |= task_ports[t]
+                np.add.at(cnt_alloc, (term_ar, np.maximum(dom_t, 0)),
+                          inc.astype(np.int64))
                 q_alloc[qj] += req[t]
                 assigned[t] = best
                 alloc_cnt += 1
@@ -232,6 +267,8 @@ def solve_oracle(
                 pip_extra[best] += req[t]
                 pip_ntasks[best] += 1
                 pip_nports[best] |= task_ports[t]
+                np.add.at(cnt_pip, (term_ar, np.maximum(dom_t, 0)),
+                          inc.astype(np.int64))
                 q_pip[qj] += req[t]
                 pipelined[t] = best
 
@@ -240,6 +277,7 @@ def solve_oracle(
             idle = ck_idle
             ntasks = ck_ntasks
             nports = ck_nports
+            cnt_alloc = ck_cnt
             q_alloc = ck_q_alloc
             assigned = ck_assigned
             never_ready[j] = True

@@ -61,6 +61,18 @@ def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
                     for x in arg
                 ])
             )
+        elif i == 22:  # AffinityArgs: node_dom is [N, K], rest replicated
+            out.append(
+                type(arg)(
+                    node_dom=jax.device_put(arg.node_dom, node_sharded),
+                    term_key=jax.device_put(arg.term_key, replicated),
+                    cnt0=jax.device_put(arg.cnt0, replicated),
+                    t_req_aff=jax.device_put(arg.t_req_aff, replicated),
+                    t_req_anti=jax.device_put(arg.t_req_anti, replicated),
+                    t_matches=jax.device_put(arg.t_matches, replicated),
+                    t_soft=jax.device_put(arg.t_soft, replicated),
+                )
+            )
         else:
             out.append(jax.device_put(arg, replicated))
     return out

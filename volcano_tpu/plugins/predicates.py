@@ -34,6 +34,23 @@ def _tolerates(task: TaskInfo, taint) -> bool:
     return False
 
 
+def _term_matches_anywhere(term: AffinityTerm, task: TaskInfo,
+                           all_nodes) -> bool:
+    """True when any resident pod in the term's namespaces matches its
+    selector (used by the upstream self-match rule: a required affinity term
+    with no match anywhere passes iff the incoming pod matches itself)."""
+    namespaces = term.namespaces or [task.namespace]
+    for other in all_nodes.values():
+        for resident in other.tasks.values():
+            if resident.namespace not in namespaces:
+                continue
+            if resident.uid == task.uid:
+                continue
+            if _labels_match(term.match_labels, resident.pod.labels):
+                return True
+    return False
+
+
 def _affinity_domain_match(term: AffinityTerm, task: TaskInfo,
                            node: NodeInfo, all_nodes) -> bool:
     """True when some pod matching ``term`` runs in the same topology domain
@@ -120,9 +137,19 @@ class PredicatesPlugin:
                     raise FitError(task.name, node.name, "host port conflict")
             # Inter-pod affinity / anti-affinity (topology-domain matching).
             for term in task.pod.affinity:
-                if not _affinity_domain_match(term, task, node, all_nodes):
-                    raise FitError(task.name, node.name,
-                                   "pod affinity not satisfied")
+                if _affinity_domain_match(term, task, node, all_nodes):
+                    continue
+                # Self-match rule (upstream InterPodAffinityMatches): a term
+                # with no matching pod anywhere passes iff the incoming pod
+                # matches its own selector.
+                self_ns = term.namespaces or [task.namespace]
+                if not _term_matches_anywhere(term, task, all_nodes) and (
+                    task.namespace in self_ns
+                    and _labels_match(term.match_labels, task.pod.labels)
+                ):
+                    continue
+                raise FitError(task.name, node.name,
+                               "pod affinity not satisfied")
             for term in task.pod.anti_affinity:
                 if _affinity_domain_match(term, task, node, all_nodes):
                     raise FitError(task.name, node.name,

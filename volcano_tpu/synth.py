@@ -72,6 +72,7 @@ def solve_args_from_store(
     """
     import jax.numpy as jnp
 
+    from .arrays.affinity import encode_affinity
     from .ops import default_weights, static_predicate_mask
 
     snap = store.snapshot()
@@ -91,6 +92,10 @@ def solve_args_from_store(
         pending.extend(tasks)
     arrays, maps = encode_cluster(snap, pending, kept_job_ids)
     mask = static_predicate_mask(arrays)
+    aff = encode_affinity(
+        snap, pending, maps.node_names,
+        arrays.nodes.idle.shape[0], arrays.tasks.req.shape[0],
+    )
     Q, R = arrays.queues.capability.shape
     args = (
         arrays.nodes.idle,
@@ -116,5 +121,6 @@ def solve_args_from_store(
                         nodeorder_enabled=nodeorder),
         jnp.asarray(arrays.eps),
         jnp.asarray(arrays.scalar_slot),
+        aff,
     )
     return args, maps
