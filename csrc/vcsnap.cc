@@ -1,0 +1,131 @@
+// vcsnap: native snapshot serializer for the volcano_tpu scheduler.
+//
+// This is the rebuild's C++ side of the host<->device bridge (SURVEY.md
+// section 2.1 "Scheduler cache" / BASELINE north star): the hot marshalling
+// loops that flatten the session snapshot (Tasks x Nodes x Queues) into the
+// dense arrays consumed by the JAX solver.  The reference relies on compiled
+// Go for its cache/snapshot path (pkg/scheduler/cache/cache.go:652-730);
+// here the per-row packing/scatter loops run as C++ over columnar CSR
+// buffers prepared by the Python store, parallelized over row chunks.
+//
+// Exposed as a plain C ABI consumed via ctypes (volcano_tpu/native.py);
+// every function writes into caller-allocated NumPy buffers, so no memory
+// management crosses the boundary.
+//
+// Build: make -C csrc          (produces libvcsnap.so next to this file)
+//        make -C csrc asan     (AddressSanitizer build, libvcsnap_asan.so)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(begin, end) over [0, n) in parallel chunks.  Small inputs stay
+// single-threaded to avoid thread-spawn overhead dominating.
+void parallel_for(int64_t n, int64_t grain,
+                  const std::function<void(int64_t, int64_t)>& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 1;
+  int64_t chunks = std::min<int64_t>(hw, (n + grain - 1) / grain);
+  if (chunks <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = (n + chunks - 1) / chunks;
+  threads.reserve(static_cast<size_t>(chunks));
+  for (int64_t c = 0; c < chunks; ++c) {
+    int64_t b = c * per;
+    int64_t e = std::min(n, b + per);
+    if (b >= e) break;
+    threads.emplace_back(fn, b, e);
+  }
+  for (auto& t : threads) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+int vcsnap_version() { return 1; }
+
+// CSR bitset pack: for each row i, set bits idx[off[i]..off[i+1]) in
+// out[i * words .. (i+1) * words).  `out` must be zero-initialized by the
+// caller (NumPy zeros).  Indices >= words*32 are ignored defensively.
+void vcsnap_pack_bits(const int32_t* idx, const int64_t* off, int64_t rows,
+                      int32_t words, uint32_t* out) {
+  const int64_t max_bit = static_cast<int64_t>(words) * 32;
+  parallel_for(rows, 4096, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      uint32_t* row = out + i * words;
+      for (int64_t k = off[i]; k < off[i + 1]; ++k) {
+        int64_t bit = idx[k];
+        if (bit < 0 || bit >= max_bit) continue;
+        row[bit >> 5] |= (1u << (bit & 31));
+      }
+    }
+  });
+}
+
+// CSR slot scatter: for each row i, out[i * r + slot[k]] = val[k] for
+// k in off[i]..off[i+1).  `out` zero-initialized by the caller.
+void vcsnap_scatter_f32(const int32_t* slot, const float* val,
+                        const int64_t* off, int64_t rows, int32_t r,
+                        float* out) {
+  parallel_for(rows, 4096, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      float* row = out + static_cast<int64_t>(i) * r;
+      for (int64_t k = off[i]; k < off[i + 1]; ++k) {
+        int32_t s = slot[k];
+        if (s < 0 || s >= r) continue;
+        row[s] = val[k];
+      }
+    }
+  });
+}
+
+// Row gather with padding: out[i] = src[order[i]] for i < n; rows with
+// order[i] < 0 are left zeroed.  Row width r floats.
+void vcsnap_gather_rows_f32(const float* src, const int32_t* order, int64_t n,
+                            int32_t r, float* out) {
+  parallel_for(n, 8192, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      int32_t s = order[i];
+      if (s < 0) continue;
+      std::memcpy(out + i * r, src + static_cast<int64_t>(s) * r,
+                  sizeof(float) * static_cast<size_t>(r));
+    }
+  });
+}
+
+// Epsilon-tolerant Resource.LessEqual over row pairs
+// (resource_info.go:286-320): per slot `l < r or |l-r| < eps`, extended
+// scalar slots requesting <= one quantum always pass.  l is [rows, r],
+// rhs a single [r] row (the common fit-check shape); out[i] in {0,1}.
+void vcsnap_less_equal(const float* l, const float* rhs, const float* eps,
+                       const uint8_t* scalar_slot, int64_t rows, int32_t r,
+                       uint8_t* out) {
+  parallel_for(rows, 8192, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      const float* row = l + i * r;
+      uint8_t ok = 1;
+      for (int32_t s = 0; s < r; ++s) {
+        float lv = row[s], rv = rhs[s];
+        bool slot_ok = (lv < rv) || (std::abs(lv - rv) < eps[s]);
+        if (scalar_slot[s] && lv <= eps[s]) slot_ok = true;
+        if (!slot_ok) {
+          ok = 0;
+          break;
+        }
+      }
+      out[i] = ok;
+    }
+  });
+}
+
+}  // extern "C"

@@ -29,6 +29,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import native
 from ..api import (
     CPU,
     MEMORY,
@@ -83,6 +84,23 @@ class ResourceSlots:
                 if idx is not None:
                     v[idx] = quant
         return v
+
+    def csr_append(self, r: Resource, slot_buf: list, val_buf: list) -> None:
+        """Append the (slot, value) pairs of ``r`` to CSR buffers (consumed
+        by the native scatter kernel, csrc/vcsnap.cc)."""
+        if r.milli_cpu:
+            slot_buf.append(0)
+            val_buf.append(r.milli_cpu)
+        if r.memory:
+            slot_buf.append(1)
+            val_buf.append(r.memory)
+        if r.scalars:
+            index = self.index
+            for name, quant in r.scalars.items():
+                idx = index.get(name)
+                if idx is not None and quant:
+                    slot_buf.append(idx)
+                    val_buf.append(quant)
 
     @classmethod
     def for_cluster(cls, cluster: ClusterInfo) -> "ResourceSlots":
@@ -271,58 +289,84 @@ def encode_cluster(
             q_cap[i] = slots.vec(Resource.from_resource_list(q.queue.capability))
 
     # ---------------------------------------------------------------- nodes
+    # Columnar CSR assembly; the heavy scatter/pack loops run in the native
+    # serializer (csrc/vcsnap.cc) when available.
     node_names = sorted(cluster.nodes.keys())
     maps.node_names = node_names
     maps.node_index = {n: i for i, n in enumerate(node_names)}
-    N = pad_dim(len(node_names))
-    n_alloc = np.zeros((N, R), F)
-    n_idle = np.zeros((N, R), F)
-    n_used = np.zeros((N, R), F)
-    n_rel = np.zeros((N, R), F)
-    n_pip = np.zeros((N, R), F)
+    n_nodes = len(node_names)
+    N = pad_dim(n_nodes)
+    res_bufs = {k: ([], [], [0]) for k in
+                ("alloc", "idle", "used", "rel", "pip")}
+    lbl_idx: List[int] = []
+    lbl_off = [0]
+    tnt_idx: List[int] = []
+    tnt_off = [0]
+    prt_idx: List[int] = []
+    prt_off = [0]
     n_ready = np.zeros((N,), bool)
     n_real = np.zeros((N,), bool)
     n_maxtasks = np.zeros((N,), I)
     n_numtasks = np.zeros((N,), I)
-    n_labels = np.zeros((N, LW), np.uint32)
-    n_taints = np.zeros((N, TW), np.uint32)
-    n_ports = np.zeros((N, PW), np.uint32)
+    label_dict = maps.label_dict
+    taint_dict = maps.taint_dict
+    port_dict = maps.port_dict
     for i, name in enumerate(node_names):
         node = cluster.nodes[name]
-        n_alloc[i] = slots.vec(node.allocatable)
-        n_idle[i] = slots.vec(node.idle)
-        n_used[i] = slots.vec(node.used)
-        n_rel[i] = slots.vec(node.releasing)
-        n_pip[i] = slots.vec(node.pipelined)
+        for key, res in (
+            ("alloc", node.allocatable), ("idle", node.idle),
+            ("used", node.used), ("rel", node.releasing),
+            ("pip", node.pipelined),
+        ):
+            sb, vb, ob = res_bufs[key]
+            slots.csr_append(res, sb, vb)
+            ob.append(len(sb))
         n_ready[i] = node.ready()
         n_real[i] = True
         n_maxtasks[i] = node.allocatable.max_task_num
         n_numtasks[i] = len(node.tasks)
         if node.node is not None:
-            n_labels[i] = _pack_bits(
-                [maps.label_dict[kv] for kv in node.node.labels.items()
-                 if kv in maps.label_dict],
-                LW,
+            lbl_idx.extend(
+                label_dict[kv] for kv in node.node.labels.items()
+                if kv in label_dict
             )
             # Only NoSchedule/NoExecute taints gate placement
             # (PreferNoSchedule is a soft preference).
-            n_taints[i] = _pack_bits(
-                [
-                    maps.taint_dict[(t.key, t.value, t.effect)]
-                    for t in node.node.taints
-                    if t.effect in ("NoSchedule", "NoExecute")
-                ],
-                TW,
+            tnt_idx.extend(
+                taint_dict[(t.key, t.value, t.effect)]
+                for t in node.node.taints
+                if t.effect in ("NoSchedule", "NoExecute")
             )
             if node.node.unschedulable:
                 n_ready[i] = False
-        ports = [
-            maps.port_dict[p]
+        lbl_off.append(len(lbl_idx))
+        tnt_off.append(len(tnt_idx))
+        prt_idx.extend(
+            port_dict[p]
             for ti in node.tasks.values()
             for p in ti.pod.host_ports
-            if p in maps.port_dict
-        ]
-        n_ports[i] = _pack_bits(ports, PW)
+            if p in port_dict
+        )
+        prt_off.append(len(prt_idx))
+
+    def _res_rows(key: str, rows: int) -> np.ndarray:
+        sb, vb, ob = res_bufs[key]
+        ob = ob + [ob[-1]] * (rows - (len(ob) - 1))
+        return native.scatter_rows_f32(sb, vb, ob, rows, R)
+
+    def _bit_rows(idx: List[int], off: List[int], rows: int,
+                  words: int) -> np.ndarray:
+        off = off + [off[-1]] * (rows - (len(off) - 1))
+        return native.pack_bits_rows(idx, off, rows, words)
+
+    n_alloc = _res_rows("alloc", N)
+    n_idle = _res_rows("idle", N)
+    n_used = _res_rows("used", N)
+    n_rel = _res_rows("rel", N)
+    n_pip = _res_rows("pip", N)
+    n_labels = _bit_rows(lbl_idx, lbl_off, N, LW)
+    n_taints = _bit_rows(tnt_idx, tnt_off, N, TW)
+    n_ports = _bit_rows(prt_idx, prt_off, N, PW)
 
     # ----------------------------------------------------------------- jobs
     maps.job_ids = list(job_order)
@@ -352,32 +396,46 @@ def encode_cluster(
     maps.task_uids = [t.uid for t in pending_tasks]
     maps.task_infos = list(pending_tasks)
     P = pad_dim(max(1, len(pending_tasks)), 8)
-    t_req = np.zeros((P, R), F)
-    t_init = np.zeros((P, R), F)
     t_job = np.zeros((P,), I)
     t_pri = np.zeros((P,), I)
     t_real = np.zeros((P,), bool)
     A = max(1, max((len(t.pod.required_node_affinity) for t in pending_tasks),
                    default=1))
-    t_sel = np.zeros((P, LW), np.uint32)
-    t_hassel = np.zeros((P,), bool)
     t_aff = np.zeros((P, A, LW), np.uint32)
     t_affn = np.zeros((P,), I)
-    t_tol = np.zeros((P, TW), np.uint32)
-    t_ports = np.zeros((P, PW), np.uint32)
+    t_hassel = np.zeros((P,), bool)
+    req_sb: List[int] = []
+    req_vb: List[float] = []
+    req_ob = [0]
+    init_sb: List[int] = []
+    init_vb: List[float] = []
+    init_ob = [0]
+    sel_idx: List[int] = []
+    sel_off = [0]
+    tol_idxs: List[int] = []
+    tol_off = [0]
+    tprt_idx: List[int] = []
+    tprt_off = [0]
+    # Distinct toleration lists are few; memoize their taint-bit matches.
+    tol_cache: Dict[tuple, List[int]] = {}
+    taint_items = list(maps.taint_dict.items())
+    job_index = maps.job_index
     for i, ti in enumerate(pending_tasks):
-        t_req[i] = slots.vec(ti.resreq)
-        t_init[i] = slots.vec(ti.init_resreq)
-        t_job[i] = maps.job_index[ti.job]
+        slots.csr_append(ti.resreq, req_sb, req_vb)
+        req_ob.append(len(req_sb))
+        slots.csr_append(ti.init_resreq, init_sb, init_vb)
+        init_ob.append(len(init_sb))
+        t_job[i] = job_index[ti.job]
         t_pri[i] = ti.priority
         t_real[i] = True
-        sel_pairs = list(ti.pod.node_selector.items())
+        sel_pairs = ti.pod.node_selector
         if sel_pairs:
             t_hassel[i] = True
-            t_sel[i] = _pack_bits(
-                [maps.label_dict[kv] for kv in sel_pairs if kv in maps.label_dict],
-                LW,
+            sel_idx.extend(
+                label_dict[kv] for kv in sel_pairs.items()
+                if kv in label_dict
             )
+        sel_off.append(len(sel_idx))
         # Node-affinity terms are OR-alternatives: one bitset per term.
         t_affn[i] = len(ti.pod.required_node_affinity)
         for a, req_term in enumerate(ti.pod.required_node_affinity[:A]):
@@ -388,24 +446,42 @@ def encode_cluster(
             )
         # Tolerations: a task tolerates a taint bit when any toleration
         # matches key(/value)(/effect) (predicates.go taint check).
-        tol_idx = []
-        for key, idx in maps.taint_dict.items():
-            tkey, tval, teff = key
-            for tol in ti.pod.tolerations:
-                key_ok = tol.operator == "Exists" and (
-                    tol.key == "" or tol.key == tkey
-                )
-                if tol.operator == "Equal":
-                    key_ok = tol.key == tkey and tol.value == tval
-                eff_ok = tol.effect == "" or tol.effect == teff
-                if key_ok and eff_ok:
-                    tol_idx.append(idx)
-                    break
-        t_tol[i] = _pack_bits(tol_idx, TW)
-        t_ports[i] = _pack_bits(
-            [maps.port_dict[p] for p in ti.pod.host_ports if p in maps.port_dict],
-            PW,
-        )
+        if ti.pod.tolerations:
+            ckey = tuple(
+                (t.key, t.operator, t.value, t.effect)
+                for t in ti.pod.tolerations
+            )
+            hit = tol_cache.get(ckey)
+            if hit is None:
+                hit = []
+                for key, idx in taint_items:
+                    tkey, tval, teff = key
+                    for tol in ti.pod.tolerations:
+                        key_ok = tol.operator == "Exists" and (
+                            tol.key == "" or tol.key == tkey
+                        )
+                        if tol.operator == "Equal":
+                            key_ok = tol.key == tkey and tol.value == tval
+                        eff_ok = tol.effect == "" or tol.effect == teff
+                        if key_ok and eff_ok:
+                            hit.append(idx)
+                            break
+                tol_cache[ckey] = hit
+            tol_idxs.extend(hit)
+        tol_off.append(len(tol_idxs))
+        if ti.pod.host_ports:
+            tprt_idx.extend(
+                port_dict[p] for p in ti.pod.host_ports if p in port_dict
+            )
+        tprt_off.append(len(tprt_idx))
+
+    req_ob += [req_ob[-1]] * (P - (len(req_ob) - 1))
+    init_ob += [init_ob[-1]] * (P - (len(init_ob) - 1))
+    t_req = native.scatter_rows_f32(req_sb, req_vb, req_ob, P, R)
+    t_init = native.scatter_rows_f32(init_sb, init_vb, init_ob, P, R)
+    t_sel = _bit_rows(sel_idx, sel_off, P, LW)
+    t_tol = _bit_rows(tol_idxs, tol_off, P, TW)
+    t_ports = _bit_rows(tprt_idx, tprt_off, P, PW)
 
     arrays = ClusterArrays(
         nodes=NodeArrays(

@@ -1,0 +1,176 @@
+"""ctypes bridge to the native snapshot serializer (csrc/vcsnap.cc).
+
+The C++ library owns the hot marshalling loops of the snapshot encoder —
+CSR bitset packing, CSR resource-slot scatter, padded row gather, and the
+epsilon LessEqual row check (resource_info.go:286-320).  When the shared
+library is absent it is built on first use with g++ (cached), and if that
+fails every entry point falls back to a vectorized NumPy implementation
+with identical semantics (cross-checked by tests/test_native.py).
+
+Set VOLCANO_TPU_NO_NATIVE=1 to force the NumPy fallback;
+VOLCANO_TPU_VCSNAP=/path/to/libvcsnap.so to use a prebuilt library (e.g.
+the ASAN build from `make -C csrc asan`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_CSRC = Path(__file__).resolve().parent.parent / "csrc"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.vcsnap_version.restype = ctypes.c_int
+    lib.vcsnap_pack_bits.argtypes = [
+        _i32p, _i64p, ctypes.c_int64, ctypes.c_int32, _u32p,
+    ]
+    lib.vcsnap_scatter_f32.argtypes = [
+        _i32p, _f32p, _i64p, ctypes.c_int64, ctypes.c_int32, _f32p,
+    ]
+    lib.vcsnap_gather_rows_f32.argtypes = [
+        _f32p, _i32p, ctypes.c_int64, ctypes.c_int32, _f32p,
+    ]
+    lib.vcsnap_less_equal.argtypes = [
+        _f32p, _f32p, _f32p, _u8p, ctypes.c_int64, ctypes.c_int32, _u8p,
+    ]
+    return lib
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
+            return None
+        override = os.environ.get("VOLCANO_TPU_VCSNAP")
+        candidates = [Path(override)] if override else []
+        candidates.append(_CSRC / "libvcsnap.so")
+        for path in candidates:
+            if path.is_file():
+                try:
+                    _LIB = _bind(ctypes.CDLL(str(path)))
+                    return _LIB
+                except OSError as err:
+                    log.warning("vcsnap load failed (%s): %s", path, err)
+        # Build on first use.
+        try:
+            subprocess.run(
+                ["make", "-s", "-C", str(_CSRC)],
+                check=True, capture_output=True, timeout=120,
+            )
+            _LIB = _bind(ctypes.CDLL(str(_CSRC / "libvcsnap.so")))
+            log.info("built native vcsnap serializer")
+        except (OSError, subprocess.SubprocessError) as err:
+            log.warning("vcsnap build failed, using NumPy fallback: %s", err)
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# --------------------------------------------------------------------- API
+
+
+def _csr(indices, offsets) -> Tuple[np.ndarray, np.ndarray]:
+    idx = np.ascontiguousarray(indices, np.int32)
+    off = np.ascontiguousarray(offsets, np.int64)
+    return idx, off
+
+
+def pack_bits_rows(indices, offsets, rows: int, words: int) -> np.ndarray:
+    """CSR -> [rows, words] uint32 bitsets."""
+    idx, off = _csr(indices, offsets)
+    out = np.zeros((rows, words), np.uint32)
+    lib = _load()
+    if lib is not None and rows:
+        lib.vcsnap_pack_bits(idx, off, rows, words, out)
+        return out
+    if len(idx):
+        counts = np.diff(off)
+        row_of = np.repeat(np.arange(rows, dtype=np.int64), counts)
+        valid = (idx >= 0) & (idx < words * 32)
+        r, b = row_of[valid], idx[valid].astype(np.int64)
+        np.bitwise_or.at(out, (r, b >> 5), (1 << (b & 31)).astype(np.uint32))
+    return out
+
+
+def scatter_rows_f32(slots, values, offsets, rows: int, width: int) -> np.ndarray:
+    """CSR (slot, value) pairs -> [rows, width] float32."""
+    slot = np.ascontiguousarray(slots, np.int32)
+    val = np.ascontiguousarray(values, np.float32)
+    off = np.ascontiguousarray(offsets, np.int64)
+    out = np.zeros((rows, width), np.float32)
+    lib = _load()
+    if lib is not None and rows:
+        lib.vcsnap_scatter_f32(slot, val, off, rows, width, out)
+        return out
+    if len(slot):
+        counts = np.diff(off)
+        row_of = np.repeat(np.arange(rows, dtype=np.int64), counts)
+        valid = (slot >= 0) & (slot < width)
+        out[row_of[valid], slot[valid]] = val[valid]
+    return out
+
+
+def gather_rows_f32(src: np.ndarray, order, rows: int) -> np.ndarray:
+    """out[i] = src[order[i]] (order < 0 -> zero row), padded to rows."""
+    src = np.ascontiguousarray(src, np.float32)
+    order = np.ascontiguousarray(order, np.int32)
+    if len(order) < rows:  # short order rows are padding (-1 = zero row)
+        order = np.concatenate(
+            [order, np.full((rows - len(order),), -1, np.int32)]
+        )
+    width = src.shape[1] if src.ndim == 2 else 1
+    out = np.zeros((rows, width), np.float32)
+    lib = _load()
+    if lib is not None and rows:
+        lib.vcsnap_gather_rows_f32(src.reshape(-1), order, rows, width, out)
+        return out
+    n = min(rows, len(order))
+    sel = order[:n]
+    ok = sel >= 0
+    out[np.arange(n)[ok]] = src[sel[ok]]
+    return out
+
+
+def less_equal_rows(l: np.ndarray, rhs: np.ndarray, eps: np.ndarray,
+                    scalar_slot: np.ndarray) -> np.ndarray:
+    """Epsilon LessEqual of each row of ``l`` against the single row
+    ``rhs`` -> [rows] bool (host-side fit checks at replay/commit time)."""
+    l = np.ascontiguousarray(l, np.float32)
+    rhs = np.ascontiguousarray(rhs, np.float32)
+    eps = np.ascontiguousarray(eps, np.float32)
+    ss = np.ascontiguousarray(np.asarray(scalar_slot, bool).view(np.uint8))
+    rows = l.shape[0]
+    lib = _load()
+    if lib is not None and rows:
+        out = np.zeros((rows,), np.uint8)
+        lib.vcsnap_less_equal(l, rhs, eps, ss, rows, l.shape[1], out)
+        return out.astype(bool)
+    per = (l < rhs[None, :]) | (np.abs(l - rhs[None, :]) < eps[None, :])
+    per |= (np.asarray(scalar_slot, bool)[None, :] & (l <= eps[None, :]))
+    return np.all(per, axis=-1)
