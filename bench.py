@@ -1,14 +1,27 @@
-"""Benchmark: full scheduling cycle (OpenSession -> Bind) on synthetic
-clusters.
+"""Benchmark suite: the five BASELINE.md configurations.
 
-Default configuration is BASELINE.md config 2 (1k nodes x 10k pending pods,
-binpack + predicates, single queue), overridable via BENCH_NODES/BENCH_PODS/
-BENCH_GANG.  The north-star budget is 100 ms OpenSession->Bind at 10k x 100k
-on one TPU chip (BASELINE.json); vs_baseline reports budget/measured scaled
-by problem size relative to the north-star config (so >= 1.0 means on track
-at the measured scale).
+Select with BENCH_CONFIG=1..5 (default 2, the 1k-node x 10k-pod binpack
+config the driver tracks).  Each config prints ONE JSON line
+{"metric", "value", "unit", "vs_baseline"} on stdout; details go to stderr.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Configs (BASELINE.json.configs):
+  1. 3-replica gang Job end-to-end through the full service (admission ->
+     job controller -> PodGroup -> scheduler -> bind -> simulated kubelet),
+     the rebuild's `example/job.yaml on kind`.
+  2. Synthetic 1k x 10k binpack+predicates, single queue.
+  3. DRF multi-queue fairness: 5k nodes, 4 weighted queues, mixed gang sizes.
+  4. Preempt + reclaim: 10k nodes fully occupied by low-priority victims,
+     20k pending high-priority pods.
+  5. Hyperscale bin-pack with inter-pod affinity / topology spread
+     (full 50k x 500k when BENCH_FULL=1; 10k x 100k otherwise — the
+     north-star shape).
+
+The north-star budget is 100 ms OpenSession->Bind at 10k x 100k on one TPU
+chip; vs_baseline = budget/measured with the budget scaled linearly by task
+count (>= 1.0 means on budget at the measured scale).
+
+Env knobs: BENCH_NODES/BENCH_PODS/BENCH_GANG/BENCH_REPEATS override config
+defaults.
 """
 
 import json
@@ -16,18 +29,55 @@ import os
 import sys
 import time
 
+NORTH_STAR_MS = 100.0
+NORTH_STAR_PODS = 100000
 
-def main():
-    n_nodes = int(os.environ.get("BENCH_NODES", 1000))
-    n_pods = int(os.environ.get("BENCH_PODS", 10000))
-    gang = int(os.environ.get("BENCH_GANG", 4))
-    repeats = int(os.environ.get("BENCH_REPEATS", 3))
 
-    from volcano_tpu.cache import FakeBinder
+def _emit(metric, value_ms, n_pods, extra="", budget_ms=None):
+    if budget_ms is None:
+        budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value_ms, 2),
+                "unit": "ms",
+                "vs_baseline": round(
+                    budget_ms / value_ms if value_ms > 0 else 0.0, 4
+                ),
+            }
+        )
+    )
+    if extra:
+        print(f"# {extra}", file=sys.stderr)
+
+
+def _cycle_bench(make_store, conf, repeats, warm_store=None):
+    """Measure one full scheduling cycle (OpenSession -> Bind) steady-state:
+    warm-up compiles, then fresh stores of the same shape hit the jit cache."""
     from volcano_tpu.scheduler import Scheduler
-    from volcano_tpu.synth import synthetic_cluster
 
-    conf = """
+    store = warm_store if warm_store is not None else make_store(0)
+    binder = store.binder
+    t0 = time.perf_counter()
+    Scheduler(store, conf_str=conf).run_once()
+    warm_s = time.perf_counter() - t0
+    bound = len(binder.binds)
+    evicted = len(getattr(store.evictor, "evicts", []))
+
+    times = []
+    for r in range(repeats):
+        store_r = make_store(r + 1)
+        sched_r = Scheduler(store_r, conf_str=conf)
+        t0 = time.perf_counter()
+        sched_r.run_once()
+        times.append(time.perf_counter() - t0)
+        del store_r, sched_r
+    e2e_ms = min(times) * 1e3 if times else warm_s * 1e3
+    return e2e_ms, bound, evicted, warm_s, times
+
+
+CONF_BASE = """
 actions: "enqueue, allocate, backfill"
 tiers:
 - plugins:
@@ -41,60 +91,174 @@ tiers:
   - name: binpack
 """
 
+CONF_PREEMPT = """
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def config_1():
+    """End-to-end 3-replica gang job through the full control plane."""
+    from volcano_tpu.controllers.apis import Job, TaskSpec
+    from volcano_tpu.service import Service
+
+    # Prewarm the solver jit on the same padded shape bucket so the
+    # measured latency is steady-state control-plane time, not XLA compile.
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    warm = synthetic_cluster(n_nodes=2, n_pods=3, gang_size=3)
+    Scheduler(warm).run_once()
+
+    svc = Service(simulate=True, schedule_period=0.01,
+                  controller_period=0.005)
+    for i in range(2):
+        from volcano_tpu.api import Node
+
+        svc.store.add_node(
+            Node(name=f"node-{i}",
+                 allocatable={"cpu": "8", "memory": "16Gi", "pods": 64})
+        )
+    job = Job(
+        name="test-job",
+        min_available=3,
+        tasks=[TaskSpec(
+            name="worker", replicas=3,
+            containers=[{"cpu": "1", "memory": "1Gi"}],
+        )],
+    )
+    svc.start(http_port=0)
+    try:
+        t0 = time.perf_counter()
+        svc.admitted.add_batch_job(job)
+        deadline = t0 + 60.0
+        while time.perf_counter() < deadline:
+            pods = [
+                p for p in svc.store.pods.values()
+                if p.owner_job == job.key and p.phase == "Running"
+            ]
+            if len(pods) >= 3:
+                break
+            time.sleep(0.002)
+        else:
+            raise RuntimeError("job did not reach Running in 60s")
+        e2e_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        svc.stop()
+    # Budget: the reference on kind needs >= one 1 s schedule period plus
+    # controller reconcile latency before pods run; call it 2 s.
+    _emit("gang job submit->3 pods Running (full control plane)", e2e_ms, 3,
+          "pods_running=3", budget_ms=2000.0)
+
+
+def config_2(n_nodes, n_pods, gang, repeats):
+    from volcano_tpu.synth import synthetic_cluster
+
     build_t0 = time.perf_counter()
     store = synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods, gang_size=gang)
     build_s = time.perf_counter() - build_t0
-    binder = store.binder  # FakeBinder by default
-
-    sched = Scheduler(store, conf_str=conf)
-
-    # Warm-up cycle: compiles the solver and binds the pods.
-    t0 = time.perf_counter()
-    sched.run_once()
-    warm_s = time.perf_counter() - t0
-    bound_first = len(binder.binds)
-
-    # Steady-state cycles on fresh stores (rebinding the same snapshot shape
-    # hits the jit cache).
-    times = []
-    for r in range(repeats):
-        store_r = synthetic_cluster(
-            n_nodes=n_nodes, n_pods=n_pods, gang_size=gang, seed=r + 1
-        )
-        sched_r = Scheduler(store_r, conf_str=conf)
-        t0 = time.perf_counter()
-        sched_r.run_once()
-        times.append(time.perf_counter() - t0)
-        del store_r, sched_r
-
-    e2e_ms = min(times) * 1e3
-    pods_per_sec = bound_first / (e2e_ms / 1e3) if e2e_ms > 0 else 0.0
-
-    # Budget scaling: north star is 100 ms at 10k x 100k; scale the budget
-    # linearly with task count (the dominant dimension of the sequential
-    # scan) for smaller configs.
-    budget_ms = 100.0 * (n_pods / 100000.0)
-    vs_baseline = budget_ms / e2e_ms if e2e_ms > 0 else 0.0
-
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"OpenSession->Bind e2e @ {n_nodes} nodes x "
-                    f"{n_pods} pending pods (gang {gang})"
-                ),
-                "value": round(e2e_ms, 2),
-                "unit": "ms",
-                "vs_baseline": round(vs_baseline, 4),
-            }
-        )
+    e2e_ms, bound, _, warm_s, times = _cycle_bench(
+        lambda r: synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods,
+                                    gang_size=gang, seed=r),
+        CONF_BASE, repeats, warm_store=store,
     )
-    print(
-        f"# details: warmup={warm_s:.2f}s bound={bound_first} "
-        f"pods/s={pods_per_sec:.0f} build={build_s:.2f}s "
+    _emit(
+        f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending pods "
+        f"(gang {gang})",
+        e2e_ms, n_pods,
+        f"warmup={warm_s:.2f}s bound={bound} "
+        f"pods/s={bound / (e2e_ms / 1e3):.0f} build={build_s:.2f}s "
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
-        file=sys.stderr,
     )
+
+
+def config_3(repeats):
+    from volcano_tpu.synth import synthetic_cluster
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    n_pods = int(os.environ.get("BENCH_PODS", 50000))
+    mk = lambda r: synthetic_cluster(
+        n_nodes=n_nodes, n_pods=n_pods, n_queues=4,
+        queue_weights=(1, 2, 4, 8), gang_sizes=(2, 4, 8, 16), seed=r,
+    )
+    e2e_ms, bound, _, warm_s, times = _cycle_bench(mk, CONF_BASE, repeats)
+    _emit(
+        f"DRF multi-queue e2e @ {n_nodes} nodes x {n_pods} pods, 4 queues",
+        e2e_ms, n_pods,
+        f"warmup={warm_s:.2f}s bound={bound} "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+    )
+
+
+def config_4(repeats):
+    from volcano_tpu.synth import preempt_cluster
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 10000))
+    n_pending = int(os.environ.get("BENCH_PODS", 20000))
+    mk = lambda r: preempt_cluster(n_nodes=n_nodes, n_pending=n_pending,
+                                   seed=r)
+    e2e_ms, bound, evicted, warm_s, times = _cycle_bench(
+        mk, CONF_PREEMPT, repeats)
+    _emit(
+        f"preempt+reclaim e2e @ {n_nodes} nodes oversubscribed, "
+        f"{n_pending} pending high-pri pods",
+        e2e_ms, n_pending,
+        f"warmup={warm_s:.2f}s bound={bound} evicted={evicted} "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+    )
+
+
+def config_5(repeats):
+    from volcano_tpu.synth import synthetic_cluster
+
+    full = os.environ.get("BENCH_FULL") == "1"
+    n_nodes = int(os.environ.get("BENCH_NODES", 50000 if full else 10000))
+    n_pods = int(os.environ.get("BENCH_PODS", 500000 if full else 100000))
+    mk = lambda r: synthetic_cluster(
+        n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16,
+        affinity_fraction=0.05, anti_affinity_fraction=0.05,
+        spread_fraction=0.1, seed=r,
+    )
+    e2e_ms, bound, _, warm_s, times = _cycle_bench(mk, CONF_BASE, repeats)
+    _emit(
+        f"hyperscale binpack+affinity e2e @ {n_nodes} nodes x "
+        f"{n_pods} pods",
+        e2e_ms, n_pods,
+        f"warmup={warm_s:.2f}s bound={bound} "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+    )
+
+
+def main():
+    config = int(os.environ.get("BENCH_CONFIG", 2))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    if config == 1:
+        config_1()
+    elif config == 2:
+        config_2(
+            int(os.environ.get("BENCH_NODES", 1000)),
+            int(os.environ.get("BENCH_PODS", 10000)),
+            int(os.environ.get("BENCH_GANG", 4)),
+            repeats,
+        )
+    elif config == 3:
+        config_3(repeats)
+    elif config == 4:
+        config_4(repeats)
+    elif config == 5:
+        config_5(repeats)
+    else:
+        raise SystemExit(f"unknown BENCH_CONFIG={config}")
 
 
 if __name__ == "__main__":

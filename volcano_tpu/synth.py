@@ -27,10 +27,115 @@ def synthetic_cluster(
     pod_cpu_choices: Sequence[str] = ("1", "2", "4"),
     pod_mem_choices: Sequence[str] = ("2Gi", "4Gi", "8Gi"),
     seed: int = 0,
+    zones: int = 0,
+    affinity_fraction: float = 0.0,
+    anti_affinity_fraction: float = 0.0,
+    spread_fraction: float = 0.0,
+    queue_weights: Optional[Sequence[int]] = None,
+    gang_sizes: Optional[Sequence[int]] = None,
 ) -> ClusterStore:
-    """A cluster of identical nodes and gang jobs with mixed pod sizes."""
+    """A cluster of identical nodes and gang jobs with mixed pod sizes.
+
+    ``zones`` > 0 labels nodes round-robin with zone labels;
+    ``affinity_fraction``/``anti_affinity_fraction``/``spread_fraction``
+    give that share of gangs required zone affinity to their own app label,
+    required hostname anti-affinity, or soft zone topology spread
+    (BASELINE config 5's inter-pod affinity / topology-spread mix).
+    ``gang_sizes`` draws each gang's size from the sequence (config 3's
+    mixed TF/MPI shapes) instead of the fixed ``gang_size``.
+    """
+    from .api import AffinityTerm
+
     rng = np.random.default_rng(seed)
     store = ClusterStore()
+    for i in range(n_nodes):
+        labels = {}
+        if zones > 0:
+            labels["zone"] = f"zone-{i % zones}"
+        store.add_node(
+            Node(
+                name=f"node-{i:06d}",
+                allocatable={"cpu": node_cpu, "memory": node_mem, "pods": 256},
+                labels=labels,
+            )
+        )
+    for q in range(1, n_queues):
+        weight = (
+            queue_weights[q % len(queue_weights)]
+            if queue_weights else int(rng.integers(1, 9))
+        )
+        store.add_queue(Queue(name=f"queue-{q}", weight=weight))
+    queues = ["default"] + [f"queue-{q}" for q in range(1, n_queues)]
+
+    g = 0
+    pods_made = 0
+    while pods_made < n_pods:
+        size = (
+            int(rng.choice(gang_sizes)) if gang_sizes else gang_size
+        )
+        size = min(size, n_pods - pods_made) or 1
+        queue = queues[g % len(queues)]
+        pg = PodGroup(name=f"pg-{g:06d}", min_member=size, queue=queue)
+        store.add_pod_group(pg)
+        cpu = str(rng.choice(pod_cpu_choices))
+        mem = str(rng.choice(pod_mem_choices))
+        app = f"app-{g:06d}"
+        r = rng.random()
+        affinity = anti_affinity = None
+        spread = None
+        if zones > 0 and r < affinity_fraction:
+            affinity = [AffinityTerm(match_labels={"app": app},
+                                     topology_key="zone")]
+        elif r < affinity_fraction + anti_affinity_fraction:
+            anti_affinity = [AffinityTerm(
+                match_labels={"app": app},
+                topology_key="kubernetes.io/hostname",
+            )]
+        elif zones > 0 and r < (affinity_fraction + anti_affinity_fraction
+                                + spread_fraction):
+            spread = [("zone", 10)]
+        for k in range(size):
+            store.add_pod(
+                Pod(
+                    name=f"pg-{g:06d}-{k}",
+                    labels={"app": app},
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[{"cpu": cpu, "memory": mem}],
+                    affinity=affinity or [],
+                    anti_affinity=anti_affinity or [],
+                    topology_spread=spread or [],
+                )
+            )
+            pods_made += 1
+        g += 1
+    return store
+
+
+def preempt_cluster(
+    n_nodes: int = 10000,
+    fill_per_node: int = 4,
+    n_pending: int = 20000,
+    gang_size: int = 4,
+    node_cpu: str = "64",
+    node_mem: str = "256Gi",
+    seed: int = 0,
+) -> ClusterStore:
+    """BASELINE config 4: oversubscribed queues with PriorityClass.
+
+    A weight-1 "victim" queue holds running low-priority gangs filling
+    ``fill_per_node`` x 16-cpu slots per node (all of a 64-cpu node); a
+    weight-9 "premium" queue holds pending high-priority gangs that only fit
+    by reclaiming from the victim queue (cross-queue) or preempting
+    low-priority jobs (in-queue).
+    """
+    from .api import PodPhase, PriorityClass
+
+    rng = np.random.default_rng(seed)
+    store = ClusterStore()
+    store.add_priority_class(PriorityClass(name="low", value=100))
+    store.add_priority_class(PriorityClass(name="high", value=10000))
+    store.add_queue(Queue(name="victim", weight=1))
+    store.add_queue(Queue(name="premium", weight=9))
     for i in range(n_nodes):
         store.add_node(
             Node(
@@ -38,23 +143,38 @@ def synthetic_cluster(
                 allocatable={"cpu": node_cpu, "memory": node_mem, "pods": 256},
             )
         )
-    for q in range(1, n_queues):
-        store.add_queue(Queue(name=f"queue-{q}", weight=int(rng.integers(1, 9))))
-    queues = ["default"] + [f"queue-{q}" for q in range(1, n_queues)]
-
-    n_gangs = n_pods // gang_size
-    for g in range(n_gangs):
-        queue = queues[g % len(queues)]
-        pg = PodGroup(name=f"pg-{g:06d}", min_member=gang_size, queue=queue)
+    # Running low-priority filler gangs, one per node slot.
+    g = 0
+    for i in range(n_nodes):
+        for s in range(fill_per_node):
+            pg = PodGroup(name=f"filler-{g:07d}", min_member=1,
+                          queue="victim")
+            store.add_pod_group(pg)
+            store.add_pod(
+                Pod(
+                    name=f"filler-{g:07d}-0",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[{"cpu": "16", "memory": "48Gi"}],
+                    phase=PodPhase.Running,
+                    node_name=f"node-{i:06d}",
+                    priority_class="low",
+                    priority=100,
+                )
+            )
+            g += 1
+    # Pending high-priority gangs in the premium queue.
+    for j in range(n_pending // gang_size):
+        pg = PodGroup(name=f"hi-{j:06d}", min_member=gang_size,
+                      queue="premium")
         store.add_pod_group(pg)
-        cpu = str(rng.choice(pod_cpu_choices))
-        mem = str(rng.choice(pod_mem_choices))
         for k in range(gang_size):
             store.add_pod(
                 Pod(
-                    name=f"pg-{g:06d}-{k}",
+                    name=f"hi-{j:06d}-{k}",
                     annotations={GROUP_NAME_ANNOTATION: pg.name},
-                    containers=[{"cpu": cpu, "memory": mem}],
+                    containers=[{"cpu": "8", "memory": "16Gi"}],
+                    priority_class="high",
+                    priority=10000,
                 )
             )
     return store
