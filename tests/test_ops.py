@@ -26,6 +26,7 @@ from volcano_tpu.ops import (
     default_weights,
     less_equal,
     solve,
+    solve_inputs,
     static_predicate_mask,
 )
 from volcano_tpu.ops.scoring import binpack_score, ScoreWeights
@@ -119,33 +120,15 @@ def run_solver(store, job_ids=None, pending=None, weights=None,
             )
             pending.extend(t for t in tasks if not t.resreq.is_empty())
     arrays, maps = encode_cluster(snap, pending, job_ids)
-    mask = static_predicate_mask(arrays)
-    Q, R = arrays.queues.capability.shape
+    s_nodes, s_tasks, s_jobs, s_queues = solve_inputs(arrays)
     res = solve(
-        arrays.nodes.idle,
-        arrays.nodes.allocatable,
-        arrays.nodes.releasing,
-        arrays.nodes.pipelined,
-        arrays.nodes.num_tasks,
-        arrays.nodes.max_tasks,
-        arrays.nodes.port_bits,
-        arrays.tasks.req,
-        arrays.tasks.init_req,
-        arrays.tasks.job,
-        arrays.tasks.real,
-        arrays.tasks.port_bits,
-        arrays.jobs.queue,
-        arrays.jobs.min_available,
-        arrays.jobs.ready_base,
-        jnp.full((Q, R), 3e38, jnp.float32),
-        arrays.queues.allocated,
-        mask,
-        jnp.zeros(mask.shape, jnp.float32),
+        s_nodes, s_tasks, s_jobs, s_queues,
         weights if weights is not None else default_weights(maps.slots.width),
-        jnp.asarray(arrays.eps),
-        jnp.asarray(arrays.scalar_slot),
+        arrays.eps,
+        arrays.scalar_slot,
         encode_affinity(snap, pending, maps.node_names,
-                        mask.shape[1], mask.shape[0]),
+                        arrays.nodes.idle.shape[0],
+                        arrays.tasks.req.shape[0]),
     )
     return res, maps
 
@@ -352,24 +335,20 @@ def test_overused_skip_not_reported_as_gang_discard():
         job.task_status_index[TaskStatus.Pending].values(), key=lambda t: t.name
     )
     arrays, maps = encode_cluster(snap, pending, ["default/pg1"])
-    mask = static_predicate_mask(arrays)
     Q, R = arrays.queues.capability.shape
     # deserved = 0 -> queue overused only when allocation > epsilon; force
     # overuse by pre-charging the queue allocation.
     deserved = np.zeros((Q, R), np.float32)
     q_alloc0 = np.full((Q, R), 1.0e9, np.float32)
+    s_nodes, s_tasks, s_jobs, s_queues = solve_inputs(
+        arrays, deserved, q_alloc0
+    )
     res = solve(
-        arrays.nodes.idle, arrays.nodes.allocatable, arrays.nodes.releasing,
-        arrays.nodes.pipelined, arrays.nodes.num_tasks, arrays.nodes.max_tasks,
-        arrays.nodes.port_bits, arrays.tasks.req, arrays.tasks.init_req,
-        arrays.tasks.job, arrays.tasks.real, arrays.tasks.port_bits,
-        arrays.jobs.queue, arrays.jobs.min_available, arrays.jobs.ready_base,
-        jnp.asarray(deserved), jnp.asarray(q_alloc0), mask,
-        jnp.zeros(mask.shape, jnp.float32),
-        default_weights(maps.slots.width), jnp.asarray(arrays.eps),
-        jnp.asarray(arrays.scalar_slot),
+        s_nodes, s_tasks, s_jobs, s_queues,
+        default_weights(maps.slots.width), arrays.eps, arrays.scalar_slot,
         encode_affinity(snap, pending, maps.node_names,
-                        mask.shape[1], mask.shape[0]),
+                        arrays.nodes.idle.shape[0],
+                        arrays.tasks.req.shape[0]),
     )
     assert int(res.assigned[0]) == -1  # skipped
     assert not bool(res.never_ready[0])  # but not reported as gang discard

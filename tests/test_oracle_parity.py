@@ -135,14 +135,13 @@ def test_invariants(seed):
     res = solve(*args)
     assigned = np.asarray(res.assigned)
     idle_final = np.asarray(res.idle)
-    (idle0, _alloc, _rel, _pip, _nt, _mt, _np_, req, _init, task_job,
-     task_real, _tp, job_queue, min_available, ready_base, *_rest) = args
-    idle0 = np.asarray(idle0)
-    req = np.asarray(req)
-    task_job = np.asarray(task_job)
-    task_real = np.asarray(task_real)
-    min_available = np.asarray(min_available)
-    ready_base = np.asarray(ready_base)
+    s_nodes, s_tasks, s_jobs = args[0], args[1], args[2]
+    idle0 = np.asarray(s_nodes.idle)
+    req = np.asarray(s_tasks.req)
+    task_job = np.asarray(s_tasks.job)
+    task_real = np.asarray(s_tasks.real)
+    min_available = np.asarray(s_jobs.min_available)
+    ready_base = np.asarray(s_jobs.ready_base)
 
     # Resource conservation: node idle decreases exactly by the sum of
     # committed requests.

@@ -135,9 +135,7 @@ class AllocateAction:
     # ------------------------------------------------------------- execute
 
     def execute(self, ssn) -> None:
-        import jax.numpy as jnp
-
-        from ..ops import solve, static_predicate_mask
+        from ..ops import solve, solve_inputs
 
         args = get_action_args(ssn.configurations, self.name)
         rounds = args.get_int(ROUNDS_ARG, 1) if args else 1
@@ -163,40 +161,15 @@ class AllocateAction:
             if slots is None:
                 slots = ResourceSlots.for_cluster(cluster)
             arrays, maps = encode_cluster(cluster, pending, job_ids, slots)
-            mask = np.asarray(static_predicate_mask(arrays))
-            node_list = [cluster.nodes[n] for n in maps.node_names]
 
             # Inter-pod (anti)affinity + spread: per-(term, domain) count
-            # tensors, checked and updated live inside the solver (replaces
-            # the former host-evaluated [P, N] fallback columns).
+            # tensors, checked and updated live inside the solver.
             aff = encode_affinity(
                 cluster, pending, maps.node_names,
-                mask.shape[1], mask.shape[0],
+                arrays.nodes.idle.shape[0], arrays.tasks.req.shape[0],
             )
 
             weights = ssn.score_weights(slots)
-
-            # Static per-(task,node) score: preferred node affinity
-            # (CalculateNodeAffinityPriority), computed once per cycle.
-            P_pad, N_pad = mask.shape
-            static_score = np.zeros((P_pad, N_pad), np.float32)
-            if weights.node_affinity_weight:
-                for i, ti in enumerate(pending):
-                    prefs = ti.pod.preferred_node_affinity
-                    if not prefs:
-                        continue
-                    total = sum(w for _, w in prefs)
-                    if total <= 0:
-                        continue
-                    for ni, node in enumerate(node_list):
-                        labels = node.node.labels if node.node else {}
-                        got = sum(
-                            w for sel, w in prefs
-                            if all(labels.get(k) == v for k, v in sel.items())
-                        )
-                        static_score[i, ni] = (
-                            got / total * 10.0 * weights.node_affinity_weight
-                        )
 
             Q, R = arrays.queues.capability.shape
             deserved = np.full((Q, R), 3.0e38, np.float32)
@@ -210,31 +183,13 @@ class AllocateAction:
                 if qi is not None:
                     q_alloc0[qi] = slots.vec(res)
 
+            s_nodes, s_tasks, s_jobs, s_queues = solve_inputs(
+                arrays, deserved, q_alloc0
+            )
             t0 = time.perf_counter()
             result = solve(
-                arrays.nodes.idle,
-                arrays.nodes.allocatable,
-                arrays.nodes.releasing,
-                arrays.nodes.pipelined,
-                arrays.nodes.num_tasks,
-                arrays.nodes.max_tasks,
-                arrays.nodes.port_bits,
-                arrays.tasks.req,
-                arrays.tasks.init_req,
-                arrays.tasks.job,
-                arrays.tasks.real,
-                arrays.tasks.port_bits,
-                arrays.jobs.queue,
-                arrays.jobs.min_available,
-                arrays.jobs.ready_base,
-                jnp.asarray(deserved),
-                jnp.asarray(q_alloc0),
-                jnp.asarray(mask),
-                jnp.asarray(static_score),
-                weights,
-                jnp.asarray(arrays.eps),
-                jnp.asarray(arrays.scalar_slot),
-                aff,
+                s_nodes, s_tasks, s_jobs, s_queues,
+                weights, arrays.eps, arrays.scalar_slot, aff,
             )
             assigned = np.asarray(result.assigned)
             pipelined = np.asarray(result.pipelined)

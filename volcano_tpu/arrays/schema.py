@@ -159,6 +159,10 @@ class TaskArrays(NamedTuple):
     aff_terms: np.ndarray  # [P] int32 number of alternatives (0 = none)
     tol_bits: np.ndarray  # [P, TW] tolerated taints
     port_bits: np.ndarray  # [P, PW] requested host ports
+    # Preferred node affinity (soft): per-term label bitsets and scores
+    # pre-normalized to [0, 10] (CalculateNodeAffinityPriority semantics).
+    pref_bits: np.ndarray  # [P, AP, LW]
+    pref_w: np.ndarray  # [P, AP] float32
 
 
 class JobArrays(NamedTuple):
@@ -261,6 +265,9 @@ def encode_cluster(
             maps.label_dict.setdefault(kv, len(maps.label_dict))
         for req in ti.pod.required_node_affinity:
             for kv in req.items():
+                maps.label_dict.setdefault(kv, len(maps.label_dict))
+        for sel, _w in ti.pod.preferred_node_affinity:
+            for kv in sel.items():
                 maps.label_dict.setdefault(kv, len(maps.label_dict))
         for port in ti.pod.host_ports:
             maps.port_dict.setdefault(port, len(maps.port_dict))
@@ -401,8 +408,12 @@ def encode_cluster(
     t_real = np.zeros((P,), bool)
     A = max(1, max((len(t.pod.required_node_affinity) for t in pending_tasks),
                    default=1))
+    AP = max(1, max((len(t.pod.preferred_node_affinity)
+                     for t in pending_tasks), default=1))
     t_aff = np.zeros((P, A, LW), np.uint32)
     t_affn = np.zeros((P,), I)
+    t_pref = np.zeros((P, AP, LW), np.uint32)
+    t_prefw = np.zeros((P, AP), F)
     t_hassel = np.zeros((P,), bool)
     req_sb: List[int] = []
     req_vb: List[float] = []
@@ -444,6 +455,19 @@ def encode_cluster(
                  if kv in maps.label_dict],
                 LW,
             )
+        # Preferred node affinity: normalize term weights to sum 10
+        # (got/total * MaxPriority in the upstream priority).
+        prefs = ti.pod.preferred_node_affinity
+        if prefs:
+            total_w = float(sum(w for _, w in prefs))
+            if total_w > 0:
+                for a, (sel, w) in enumerate(prefs[:AP]):
+                    t_pref[i, a] = _pack_bits(
+                        [maps.label_dict[kv] for kv in sel.items()
+                         if kv in maps.label_dict],
+                        LW,
+                    )
+                    t_prefw[i, a] = w / total_w * 10.0
         # Tolerations: a task tolerates a taint bit when any toleration
         # matches key(/value)(/effect) (predicates.go taint check).
         if ti.pod.tolerations:
@@ -510,6 +534,8 @@ def encode_cluster(
             aff_terms=t_affn,
             tol_bits=t_tol,
             port_bits=t_ports,
+            pref_bits=t_pref,
+            pref_w=t_prefw,
         ),
         jobs=JobArrays(
             min_available=j_min,

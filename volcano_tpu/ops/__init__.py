@@ -1,13 +1,26 @@
 """Device kernels: fit predicates, scoring, and the allocate solver."""
 
-from .allocate import AllocResult, solve
+from .allocate import (
+    AllocResult,
+    SolveJobs,
+    SolveNodes,
+    SolveQueues,
+    SolveTasks,
+    solve,
+    solve_inputs,
+)
 from .predicates import static_predicate_mask
 from .resreq import is_empty, less, less_equal, less_equal_strict
 from .scoring import ScoreWeights, default_weights, node_score
 
 __all__ = [
     "AllocResult",
+    "SolveJobs",
+    "SolveNodes",
+    "SolveQueues",
+    "SolveTasks",
     "solve",
+    "solve_inputs",
     "static_predicate_mask",
     "is_empty",
     "less",

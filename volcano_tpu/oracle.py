@@ -110,52 +110,51 @@ class OracleResult(NamedTuple):
     q_alloc: np.ndarray  # [Q, R] allocated + pipelined
 
 
+def _subset_np(bits_row, table):
+    """[..., W] & [N, W] -> [..., N]: row bits all present in table rows."""
+    missing = bits_row[..., None, :] & ~table
+    return np.all(missing == 0, axis=-1)
+
+
 def solve_oracle(
-    idle0,
-    allocatable,
-    releasing,
-    pipelined0,
-    ntasks0,
-    max_tasks,
-    nports0,
-    req,
-    init_req,
-    task_job,
-    task_real,
-    task_ports,
-    job_queue,
-    min_available,
-    ready_base,
-    deserved,
-    q_alloc0,
-    static_mask,
-    static_score,
+    nodes,
+    tasks,
+    jobs,
+    queues,
     weights,
     eps,
     scalar_slot,
     aff=None,
 ) -> OracleResult:
-    """Run the Go-shaped sequential loop over the dense snapshot."""
+    """Run the Go-shaped sequential loop over the dense snapshot (same
+    grouped inputs as ops.allocate.solve)."""
     to_np = lambda a: np.array(a, copy=True)
-    idle = to_np(idle0).astype(np.float32)
-    allocatable = to_np(allocatable).astype(np.float32)
-    releasing = to_np(releasing).astype(np.float32)
-    pipelined0 = to_np(pipelined0).astype(np.float32)
-    ntasks = to_np(ntasks0).astype(np.int64)
-    max_tasks = to_np(max_tasks).astype(np.int64)
-    nports = to_np(nports0).astype(np.uint32)
-    req = to_np(req).astype(np.float32)
-    init_req = to_np(init_req).astype(np.float32)
-    task_job = to_np(task_job).astype(np.int64)
-    task_real = to_np(task_real).astype(bool)
-    task_ports = to_np(task_ports).astype(np.uint32)
-    job_queue = to_np(job_queue).astype(np.int64)
-    min_available = to_np(min_available).astype(np.int64)
-    ready_base = to_np(ready_base).astype(np.int64)
-    deserved = to_np(deserved).astype(np.float32)
-    q_alloc = to_np(q_alloc0).astype(np.float32)
-    static_mask = to_np(static_mask).astype(bool)
-    static_score = to_np(static_score).astype(np.float32)
+    idle = to_np(nodes.idle).astype(np.float32)
+    allocatable = to_np(nodes.allocatable).astype(np.float32)
+    releasing = to_np(nodes.releasing).astype(np.float32)
+    pipelined0 = to_np(nodes.pipelined).astype(np.float32)
+    ntasks = to_np(nodes.ntasks).astype(np.int64)
+    max_tasks = to_np(nodes.max_tasks).astype(np.int64)
+    nports = to_np(nodes.ports).astype(np.uint32)
+    n_ready = np.asarray(nodes.ready, bool)
+    n_labels = np.asarray(nodes.label_bits, np.uint32)
+    n_taints = np.asarray(nodes.taint_bits, np.uint32)
+    req = to_np(tasks.req).astype(np.float32)
+    init_req = to_np(tasks.init_req).astype(np.float32)
+    task_job = to_np(tasks.job).astype(np.int64)
+    task_real = to_np(tasks.real).astype(bool)
+    task_ports = to_np(tasks.ports).astype(np.uint32)
+    t_sel = np.asarray(tasks.sel_bits, np.uint32)
+    t_aff_bits = np.asarray(tasks.aff_bits, np.uint32)
+    t_aff_terms = np.asarray(tasks.aff_terms, np.int64)
+    t_tol = np.asarray(tasks.tol_bits, np.uint32)
+    t_pref = np.asarray(tasks.pref_bits, np.uint32)
+    t_prefw = np.asarray(tasks.pref_w, np.float32)
+    job_queue = to_np(jobs.queue).astype(np.int64)
+    min_available = to_np(jobs.min_available).astype(np.int64)
+    ready_base = to_np(jobs.ready_base).astype(np.int64)
+    deserved = to_np(queues.deserved).astype(np.float32)
+    q_alloc = to_np(queues.allocated).astype(np.float32)
     eps = np.asarray(eps, np.float32)
     scalar_slot = np.asarray(scalar_slot, bool)
 
@@ -219,6 +218,19 @@ def solve_oracle(
         alloc_cnt = 0
 
         for t in rows:
+            # Static predicates from the bitset tables (selector, required
+            # node affinity OR-terms, taints, node readiness).
+            stat = n_ready & _subset_np(t_sel[t], n_labels)
+            term_ok = _subset_np(t_aff_bits[t], n_labels)  # [A, N]
+            A = t_aff_bits.shape[1]
+            term_real = np.arange(A) < t_aff_terms[t]
+            stat &= (
+                np.any(term_ok & term_real[:, None], axis=0)
+                | (t_aff_terms[t] == 0)
+            )
+            untol = n_taints & ~t_tol[t][None, :]
+            stat &= np.all(untol == 0, axis=-1)
+
             future_idle = idle + releasing - pipelined0 - pip_extra
             fit_future = np_less_equal(
                 init_req[t][None, :], future_idle, eps, scalar_slot
@@ -237,13 +249,17 @@ def solve_oracle(
             aff_ok = np.all(~t_req_aff[t][None, :] | aff_term_ok, axis=-1)
             anti_ok = np.all(~t_req_anti[t][None, :] | (cval == 0), axis=-1)
 
-            feasible = static_mask[t] & fit_future & pods_ok & ports_ok
+            feasible = stat & fit_future & pods_ok & ports_ok
             feasible = feasible & aff_ok & anti_ok
             if not feasible.any():
                 fit_failed[j] = True
                 break  # abort the rest of this job's tasks
 
-            score = _node_score(req[t], allocatable, idle, weights) + static_score[t]
+            score = _node_score(req[t], allocatable, idle, weights)
+            pref_match = _subset_np(t_pref[t], n_labels)  # [AP, N]
+            score = score + np.float32(weights.node_affinity_weight) * np.sum(
+                pref_match * t_prefw[t][:, None], axis=0, dtype=np.float32
+            )
             score = score + np.sum(
                 t_soft[t][None, :] * cval.astype(np.float32), axis=-1
             )

@@ -35,47 +35,42 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = NODES_AXIS) -> Mesh:
 
 
 def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
-    """Place solve() positional args on the mesh: node-major arrays sharded
-    on the nodes axis, everything else replicated.
+    """Place solve() args on the mesh: every field of the SolveNodes group
+    (and AffinityArgs.node_dom) is sharded on its leading N axis; task/job/
+    queue state, weights, and the affinity count tensors are replicated
+    (they are O(P + J + Q + E*D) scalars next to the [N, R] node state, and
+    every chip needs the winner of each step anyway).
 
-    solve()'s signature (ops/allocate.py): the first 7 args are node state
-    ([N, R] / [N] / [N, PW]), then task/job/queue arrays (replicated), the
-    [P, N] static mask and static score (sharded on their N axis), weights,
-    eps, scalar_slot.
+    solve()'s signature (ops/allocate.py): (nodes, tasks, jobs, queues,
+    weights, eps, scalar_slot, aff).
     """
     node_sharded = NamedSharding(mesh, P(axis))  # leading dim = N
     replicated = NamedSharding(mesh, P())
-    mask_sharded = NamedSharding(mesh, P(None, axis))  # [P, N]
 
-    out = []
-    n_node_args = 7
-    for i, arg in enumerate(solve_args):
-        if i < n_node_args:
-            out.append(jax.device_put(arg, node_sharded))
-        elif i in (17, 18):  # static_mask, static_score [P, N]
-            out.append(jax.device_put(arg, mask_sharded))
-        elif i == 19:  # ScoreWeights NamedTuple
-            out.append(
-                type(arg)(*[
-                    jax.device_put(np.asarray(x, np.float32), replicated)
-                    for x in arg
-                ])
-            )
-        elif i == 22:  # AffinityArgs: node_dom is [N, K], rest replicated
-            out.append(
-                type(arg)(
-                    node_dom=jax.device_put(arg.node_dom, node_sharded),
-                    term_key=jax.device_put(arg.term_key, replicated),
-                    cnt0=jax.device_put(arg.cnt0, replicated),
-                    t_req_aff=jax.device_put(arg.t_req_aff, replicated),
-                    t_req_anti=jax.device_put(arg.t_req_anti, replicated),
-                    t_matches=jax.device_put(arg.t_matches, replicated),
-                    t_soft=jax.device_put(arg.t_soft, replicated),
-                )
-            )
-        else:
-            out.append(jax.device_put(arg, replicated))
-    return out
+    def rep(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(np.asarray(x), replicated), tree
+        )
+
+    nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = solve_args
+    nodes = type(nodes)(*[
+        jax.device_put(np.asarray(x), node_sharded) for x in nodes
+    ])
+    aff = type(aff)(
+        node_dom=jax.device_put(np.asarray(aff.node_dom), node_sharded),
+        term_key=jax.device_put(np.asarray(aff.term_key), replicated),
+        cnt0=jax.device_put(np.asarray(aff.cnt0), replicated),
+        t_req_aff=jax.device_put(np.asarray(aff.t_req_aff), replicated),
+        t_req_anti=jax.device_put(np.asarray(aff.t_req_anti), replicated),
+        t_matches=jax.device_put(np.asarray(aff.t_matches), replicated),
+        t_soft=jax.device_put(np.asarray(aff.t_soft), replicated),
+    )
+    return (
+        nodes, rep(tasks), rep(jobs), rep(queues), rep(weights),
+        jax.device_put(np.asarray(eps), replicated),
+        jax.device_put(np.asarray(scalar_slot), replicated),
+        aff,
+    )
 
 
 def sharded_solve(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):

@@ -190,10 +190,8 @@ def solve_args_from_store(
     Returns (args, maps).  Orders jobs by id and tasks by creation; applies
     infinite deserved shares (no proportion gating).
     """
-    import jax.numpy as jnp
-
     from .arrays.affinity import encode_affinity
-    from .ops import default_weights, static_predicate_mask
+    from .ops import default_weights, solve_inputs
 
     snap = store.snapshot()
     job_ids = sorted(snap.jobs.keys())
@@ -211,36 +209,17 @@ def solve_args_from_store(
         kept_job_ids.append(jid)
         pending.extend(tasks)
     arrays, maps = encode_cluster(snap, pending, kept_job_ids)
-    mask = static_predicate_mask(arrays)
     aff = encode_affinity(
         snap, pending, maps.node_names,
         arrays.nodes.idle.shape[0], arrays.tasks.req.shape[0],
     )
-    Q, R = arrays.queues.capability.shape
+    nodes, tasks, jobs, queues = solve_inputs(arrays)
     args = (
-        arrays.nodes.idle,
-        arrays.nodes.allocatable,
-        arrays.nodes.releasing,
-        arrays.nodes.pipelined,
-        arrays.nodes.num_tasks,
-        arrays.nodes.max_tasks,
-        arrays.nodes.port_bits,
-        arrays.tasks.req,
-        arrays.tasks.init_req,
-        arrays.tasks.job,
-        arrays.tasks.real,
-        arrays.tasks.port_bits,
-        arrays.jobs.queue,
-        arrays.jobs.min_available,
-        arrays.jobs.ready_base,
-        jnp.full((Q, R), 3.0e38, jnp.float32),
-        arrays.queues.allocated,
-        mask,
-        jnp.zeros(mask.shape, jnp.float32),
+        nodes, tasks, jobs, queues,
         default_weights(maps.slots.width, binpack_enabled=binpack,
                         nodeorder_enabled=nodeorder),
-        jnp.asarray(arrays.eps),
-        jnp.asarray(arrays.scalar_slot),
+        arrays.eps,
+        arrays.scalar_slot,
         aff,
     )
     return args, maps
