@@ -99,6 +99,11 @@ class JobInfo:
         self.nodes_fit_errors: Dict[str, FitErrors] = {}
         # status -> {task uid -> TaskInfo}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        # Incremental count of Pending tasks with empty InitResreq (they
+        # count as "ready" in job_info.go:329-348); keeping it live makes
+        # ready_task_num O(statuses) instead of O(tasks) — it sits inside
+        # every job-order heap comparison.
+        self._empty_pending: int = 0
         self.tasks: Dict[str, TaskInfo] = {}
         self.allocated: Resource = Resource.empty()
         self.total_request: Resource = Resource.empty()
@@ -124,13 +129,21 @@ class JobInfo:
 
     def _add_task_index(self, ti: TaskInfo) -> None:
         self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+        if ti.status == TaskStatus.Pending and ti.init_resreq.is_empty():
+            self._empty_pending += 1
 
     def _delete_task_index(self, ti: TaskInfo) -> None:
         tasks = self.task_status_index.get(ti.status)
         if tasks is not None:
-            tasks.pop(ti.uid, None)
+            removed = tasks.pop(ti.uid, None)
             if not tasks:
                 del self.task_status_index[ti.status]
+            if (
+                removed is not None
+                and ti.status == TaskStatus.Pending
+                and removed.init_resreq.is_empty()
+            ):
+                self._empty_pending -= 1
 
     def add_task_info(self, ti: TaskInfo) -> None:
         self.tasks[ti.uid] = ti
@@ -177,14 +190,10 @@ class JobInfo:
     def ready_task_num(self) -> int:
         """Tasks holding resources, succeeded, or zero-request pending
         (job_info.go:329-348)."""
-        occupied = 0
+        occupied = self._empty_pending
         for status, tasks in self.task_status_index.items():
             if allocated_status(status) or status == TaskStatus.Succeeded:
                 occupied += len(tasks)
-            elif status == TaskStatus.Pending:
-                occupied += sum(
-                    1 for t in tasks.values() if t.init_resreq.is_empty()
-                )
         return occupied
 
     def waiting_task_num(self) -> int:

@@ -129,19 +129,29 @@ class Pod:
     # ------------------------------------------------------------- resources
 
     def resource_request(self) -> Resource:
-        """Sum of container requests (GetPodResourceWithoutInitContainers)."""
-        r = Resource.empty()
-        for c in self.containers:
-            r.add(Resource.from_resource_list(c))
-        return r
+        """Sum of container requests (GetPodResourceWithoutInitContainers).
+
+        Cached per Pod object: container lists are treated as immutable
+        (updates replace the Pod), and callers clone() before mutating."""
+        cached = getattr(self, "_req_cache", None)
+        if cached is None:
+            cached = Resource.empty()
+            for c in self.containers:
+                cached.add(Resource.from_resource_list(c))
+            self._req_cache = cached
+        return cached
 
     def init_resource_request(self) -> Resource:
         """max(max(init containers), sum(containers))
-        (GetPodResourceRequest in pod_info.go)."""
-        r = self.resource_request()
-        for ic in self.init_containers:
-            r.set_max_resource(Resource.from_resource_list(ic))
-        return r
+        (GetPodResourceRequest in pod_info.go).  Cached like
+        resource_request."""
+        cached = getattr(self, "_init_req_cache", None)
+        if cached is None:
+            cached = self.resource_request().clone()
+            for ic in self.init_containers:
+                cached.set_max_resource(Resource.from_resource_list(ic))
+            self._init_req_cache = cached
+        return cached
 
     def task_status(self) -> TaskStatus:
         """Map pod phase to TaskStatus (pod_info.go getTaskStatus)."""

@@ -166,12 +166,21 @@ class Session:
     # ------------------------------------------------------ tier iteration
 
     def _tier_plugins(self, flag_attr: str):
-        """Yield (tier_index, PluginOption) for plugins with a flag on."""
-        for ti, tier in enumerate(self.tiers):
-            for opt in tier.plugins:
-                enabled = getattr(opt, flag_attr, None)
-                if enabled:
-                    yield ti, opt
+        """(tier_index, PluginOption) list for plugins with a flag on.
+        Memoized: this sits inside every heap comparison of the job/task
+        orderings (tiers never change within a session)."""
+        cache = getattr(self, "_tier_plugin_cache", None)
+        if cache is None:
+            cache = self._tier_plugin_cache = {}
+        hit = cache.get(flag_attr)
+        if hit is None:
+            hit = cache[flag_attr] = [
+                (ti, opt)
+                for ti, tier in enumerate(self.tiers)
+                for opt in tier.plugins
+                if getattr(opt, flag_attr, None)
+            ]
+        return hit
 
     # ------------------------------------------------------------ dispatch
 
