@@ -34,10 +34,12 @@ from ..utils.priority_queue import PriorityQueue
 log = logging.getLogger(__name__)
 
 ROUNDS_ARG = "rounds"
+SOLVER_ARG = "solver"  # "wave" (default) or "seq" (exact sequential)
 
 
 class AllocateAction:
     name = "allocate"
+    _retry_discards = False
 
     def initialize(self):
         pass
@@ -136,12 +138,21 @@ class AllocateAction:
 
     def execute(self, ssn) -> None:
         from ..ops import solve, solve_inputs
+        from ..ops.wave import solve_wave
 
         args = get_action_args(ssn.configurations, self.name)
         rounds = args.get_int(ROUNDS_ARG, 1) if args else 1
+        solver = args.get_str(SOLVER_ARG, "wave") if args else "wave"
+        # Wave-mode gang discards release capacity only after the solve
+        # (wave.py module docs); extra rounds give discard survivors the
+        # freed capacity — the sequential solver releases in-scan and
+        # needs none.
+        max_rounds = max(rounds, 1) + (3 if solver == "wave" else 0)
 
         slots = None
-        for rnd in range(max(rounds, 1)):
+        for rnd in range(max_rounds):
+            if rnd >= max(rounds, 1) and not self._retry_discards:
+                break
             jobs = self._schedulable_jobs(ssn)
             ordered_jobs = self._job_order(ssn, jobs)
             pending: List[TaskInfo] = []
@@ -187,7 +198,8 @@ class AllocateAction:
                 arrays, deserved, q_alloc0
             )
             t0 = time.perf_counter()
-            result = solve(
+            solve_fn = solve_wave if solver == "wave" else solve
+            result = solve_fn(
                 s_nodes, s_tasks, s_jobs, s_queues,
                 weights, arrays.eps, arrays.scalar_slot, aff,
             )
@@ -208,6 +220,10 @@ class AllocateAction:
                 ssn, maps, pending, assigned, pipelined, never_ready,
                 fit_failed,
             )
+            # Jobs discarded by the wave solver left their capacity on the
+            # table this round; retry while the round also made progress
+            # (so a retry can actually see different state).
+            self._retry_discards = bool(never_ready.any()) and made_progress
             if not made_progress:
                 return
 

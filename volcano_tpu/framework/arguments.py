@@ -31,6 +31,12 @@ class Arguments(dict):
             log.warning("Could not parse argument %r for key %s", raw, key)
             return default
 
+    def get_str(self, key: str, default: str) -> str:
+        raw = self.get(key)
+        if raw in (None, ""):
+            return default
+        return str(raw)
+
     def get_bool(self, key: str, default: bool) -> bool:
         raw = self.get(key)
         if raw in (None, ""):

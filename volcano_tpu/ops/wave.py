@@ -1,0 +1,876 @@
+"""Wave-batched allocate solver: W tasks per device iteration.
+
+The sequential solver (``ops/allocate.py``) preserves Volcano's exact
+per-task semantics but pays one device loop iteration per task — at
+BASELINE's north-star shape (10k nodes x 100k pending pods) that is 100k
+sequential steps and over ten seconds of device time.  This module trades a
+small, documented amount of ordering fidelity for two orders of magnitude:
+tasks are processed in *waves* of W (task order preserved across and within
+waves), and each wave resolves with batched feasibility/score tensors plus
+an O(W^2) prefix-acceptance pass that lands on the MXU as tiny matmuls.
+
+**Profile dedup.** Pending pods are overwhelmingly replicas: a gang of 64
+identical tasks shares one request vector, one node-selector bitset, one
+affinity term set.  The expensive [*, N] tensors (resource fit, scores,
+ports, affinity) are therefore computed once per *distinct task profile*
+present in the wave (host-side ``np.unique`` over the per-task rows), and
+every task just gathers its profile's row — the same collapse the array
+schema performs on the reference's O(tasks x nodes x predicates) fan-out
+(scheduler_helper.go:43-118), applied a second time within the solve.
+
+Semantics relative to ``pkg/scheduler/actions/allocate/allocate.go:40-250``
+(and to the sequential solver, which mirrors it step-for-step):
+
+- predicates/scores for the tasks of one wave are evaluated against the
+  cluster state at the start of the wave *attempt*, not after every single
+  placement.  Within an attempt, capacity is still charged exactly, in task
+  order, via per-node prefix sums: a task is only accepted if the requests
+  of every earlier accepted wave-task on its chosen node still leave room.
+  Tasks that lose the race re-enter the next attempt, where scores are
+  recomputed on the updated state; each attempt is guaranteed to resolve at
+  least the first unresolved task, so the attempt loop terminates.
+- choice diversification: when many tasks of a wave argmax to the same
+  node, the k-th contender is steered to its profile's k-th-best feasible
+  node (scaled by how many replicas the best node can still hold).  The
+  sequential reference reaches the same nodes one fill at a time (best node
+  saturates, scores shift to the runner-up); the wave solver just gets
+  there without serializing.  Tie-break stays lowest-node-index.
+- gang discard (stmt.Discard, statement.go:324-367) is applied as one
+  vectorized rollback after the scan instead of at each job boundary, so
+  capacity held by a doomed job is not released to later jobs within the
+  same solve call.  The allocate action re-runs the solver on the remaining
+  pending tasks when any job was discarded (``actions/allocate.py``),
+  which restores the freed capacity for the next pass — the same "later
+  jobs see post-discard state" outcome, one round later.
+- queue-overuse gating (proportion.go:217-229) is evaluated when the job's
+  first task comes up in its wave, against live queue allocations at that
+  attempt — the same point in task order where the reference evaluates it.
+- a task with no feasible node marks its job fit-failed and aborts the
+  job's remaining tasks (allocate.go:189-193): in-wave, later tasks of that
+  job are masked from this attempt's acceptance and from every later
+  attempt; tasks of the job accepted in earlier attempts stay (they are
+  rolled back at the end unless the job still reached ready).
+
+Everything else — epsilon resource semantics, pipeline (future-idle)
+accounting surviving discard, port/pod-count/label/taint/inter-pod-affinity
+predicates, additive scoring — is identical to the sequential solver, and
+the two agree exactly on conflict-free workloads (tests/test_wave.py).
+
+Bitset predicates (node selector / required+preferred node affinity /
+taints / host ports) are evaluated as f32 matmuls over the unpacked bit
+axis: "row bits all present in table row" == "popcount(row & ~table) == 0",
+and the popcount of an AND is an inner product of 0/1 vectors — which puts
+the predicate fan-out on the MXU instead of the vector units.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..arrays.affinity import AffinityArgs
+from .allocate import (
+    NEG,
+    AllocResult,
+    SolveJobs,
+    SolveNodes,
+    SolveQueues,
+    SolveTasks,
+)
+from .resreq import less_equal
+from .scoring import ScoreWeights, node_score
+
+DEFAULT_WAVE = 4096
+TOPK = 256  # diversification breadth: k-th contender takes its k-th best node
+
+
+class SolveProfiles(NamedTuple):
+    """Distinct task profiles ([U] rows): every per-task input that shapes
+    the [*, N] feasibility/score tensors.  Tasks map to profiles via
+    ``pid``; waves gather their present profiles via ``wave_prof``."""
+
+    req: jnp.ndarray  # [U, R]
+    init_req: jnp.ndarray  # [U, R]
+    ports: jnp.ndarray  # [U, PW] uint32
+    sel_bits: jnp.ndarray  # [U, LW]
+    aff_bits: jnp.ndarray  # [U, A, LW]
+    aff_terms: jnp.ndarray  # [U]
+    tol_bits: jnp.ndarray  # [U, TW]
+    pref_bits: jnp.ndarray  # [U, AP, LW]
+    pref_w: jnp.ndarray  # [U, AP]
+    t_req_aff: jnp.ndarray  # [U, E]
+    t_req_anti: jnp.ndarray  # [U, E]
+    t_matches: jnp.ndarray  # [U, E]
+    t_soft: jnp.ndarray  # [U, E]
+
+
+class GState(NamedTuple):
+    """Cluster state threaded through waves and attempts."""
+
+    idle: jnp.ndarray  # [N, R]
+    pip_extra: jnp.ndarray  # [N, R]
+    ntasks: jnp.ndarray  # [N] int32
+    pip_ntasks: jnp.ndarray  # [N]
+    nport_bits: jnp.ndarray  # [N, B] bool (unpacked, alloc side)
+    pip_nport_bits: jnp.ndarray  # [N, B] bool
+    cnt_alloc: jnp.ndarray  # [E, D] int32
+    cnt_pip: jnp.ndarray  # [E, D] int32
+    q_alloc: jnp.ndarray  # [Q, R]
+    q_pip: jnp.ndarray  # [Q, R]
+    alloc_cnt: jnp.ndarray  # [J] int32
+    fit_failed: jnp.ndarray  # [J] bool
+    job_skip: jnp.ndarray  # [J] bool (fit abort OR overuse skip)
+    job_overskip: jnp.ndarray  # [J] bool (skipped for overuse only)
+    assigned: jnp.ndarray  # [P] int32
+    pipelined: jnp.ndarray  # [P] int32
+
+
+def _unpack_bits(words):
+    """[..., W] uint32 -> [..., W*32] bool, bit 0 of word 0 first."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*words.shape[:-1], -1).astype(bool)
+
+
+def _subset_mm(rows_bits, table_missing_f):
+    """rows ⊆ table per pair, as a matmul.
+
+    rows_bits: [..., B] bool; table_missing_f: [N, B] f32 of ~table.
+    Result [..., N] bool: no bit of the row falls on a missing table bit.
+    """
+    viol = jnp.matmul(rows_bits.astype(jnp.float32), table_missing_f.T)
+    return viol == 0
+
+
+@partial(jax.jit, static_argnames=("wave", "n_waves", "features"))
+def _solve_wave(
+    nodes: SolveNodes,
+    tasks: SolveTasks,
+    jobs: SolveJobs,
+    queues: SolveQueues,
+    weights: ScoreWeights,
+    eps,
+    scalar_slot,
+    aff: AffinityArgs,
+    prof: SolveProfiles,
+    pid: jnp.ndarray,  # [P] int32 global profile id per task
+    wave_prof: jnp.ndarray,  # [NW, U_MAX] int32 profile ids present per wave
+    pid_local: jnp.ndarray,  # [P] int32 index into the wave's profile list
+    wave: int,
+    n_waves: int,
+    features: tuple = (True, True, True, True, True),
+) -> AllocResult:
+    # Static feature flags let XLA drop whole subsystems from the program
+    # when the snapshot provably cannot exercise them (no host ports
+    # anywhere, no affinity terms, no taints, no releasing capacity =>
+    # no pipelining, no finite queue deserved => no overuse gating).
+    has_ports, has_aff, has_taints, has_future, has_overuse = features
+
+    P, R = tasks.req.shape
+    N = nodes.idle.shape[0]
+    J = jobs.min_available.shape[0]
+    A = prof.aff_bits.shape[1]
+    AP = prof.pref_bits.shape[1]
+    E, D = aff.cnt0.shape
+    Q = queues.deserved.shape[0]
+    W = wave
+    NW = n_waves
+    UM = wave_prof.shape[1]
+    K = min(TOPK, N)
+    JP = J + W  # job axis padded so any wave's window slice stays in range
+    f32 = jnp.float32
+    BIG = jnp.float32(1.0e9)
+
+    # The device inner loop avoids every large sort and every wide
+    # scatter/gather it can:
+    #  - nodes are *ranked once per wave* (argsort of the per-profile score
+    #    rows); attempts walk down the fixed ranking by live cumulative
+    #    capacity instead of re-sorting (TPU TopK/sort is millisecond-slow
+    #    at [U, 16k]);
+    #  - job- and queue-indexed state reads/writes are [W, W]/[W, Q]
+    #    one-hot matmuls over the wave's contiguous job window (TPU
+    #    scatters serialize per row);
+    #  - a stalled attempt (capacity exhausted inside the ranked prefix
+    #    while feasible nodes remain beyond it) triggers a re-rank, which
+    #    also guarantees loop progress.
+
+    node_dom_t = aff.node_dom[:, aff.term_key]  # [N, E] domain per term
+    term_arange = jnp.arange(E)
+
+    # Unpacked-bit tables (f32 complements feed the matmul subset checks).
+    label_missing_f = (~_unpack_bits(nodes.label_bits)).astype(f32)
+    node_taint_bits_f = _unpack_bits(nodes.taint_bits).astype(f32)
+    node_ready = nodes.ready
+
+    # Padded-row job sentinel J keeps wave windows ([jlo, jlo+W)) in the
+    # padded job range without branching.
+    tjob = jnp.where(tasks.real, tasks.job, J).astype(jnp.int32)
+    prev_job = jnp.concatenate([jnp.int32([-1]), tjob[:-1]])
+    is_first = tasks.real & (tjob != prev_job)
+    queue_p = jnp.pad(jobs.queue, (0, W))
+
+    job_seen = jnp.zeros((JP,), bool).at[tjob].max(tasks.real)
+
+    state = GState(
+        idle=nodes.idle,
+        pip_extra=jnp.zeros_like(nodes.idle),
+        ntasks=nodes.ntasks,
+        pip_ntasks=jnp.zeros_like(nodes.ntasks),
+        nport_bits=_unpack_bits(nodes.ports),
+        pip_nport_bits=jnp.zeros_like(_unpack_bits(nodes.ports)),
+        cnt_alloc=aff.cnt0.astype(jnp.int32),
+        cnt_pip=jnp.zeros_like(aff.cnt0.astype(jnp.int32)),
+        q_alloc=queues.allocated,
+        q_pip=jnp.zeros_like(queues.allocated),
+        alloc_cnt=jnp.zeros((JP,), jnp.int32),
+        fit_failed=jnp.zeros((JP,), bool),
+        job_skip=jnp.zeros((JP,), bool),
+        job_overskip=jnp.zeros((JP,), bool),
+        assigned=jnp.full((P,), -1, jnp.int32),
+        pipelined=jnp.full((P,), -1, jnp.int32),
+    )
+
+    tril = jnp.tril(jnp.ones((W, W), bool), k=-1)  # strictly-earlier mask
+
+    def run_wave(w, state: GState) -> GState:
+        off = w * W
+        sl = lambda a: jax.lax.dynamic_slice_in_dim(a, off, W, axis=0)
+
+        req_w = sl(tasks.req)
+        init_req_w = sl(tasks.init_req)
+        jraw = sl(tjob)
+        real_w = sl(tasks.real)
+        is_first_w = sl(is_first)
+        pid_l = sl(pid_local)  # [W] -> rows of this wave's profile list
+
+        # Job window: job ids of a wave form a contiguous range (tasks are
+        # job-contiguous), so job state lives in [W]-sized locals.
+        jlo = jnp.min(jnp.where(real_w, jraw, J))
+        jw = jnp.clip(jraw - jlo, 0, W - 1)
+        onehot_j = (
+            (jw[:, None] == jnp.arange(W)[None, :]) & real_w[:, None]
+        ).astype(f32)  # [W_task, W_job]
+        queue_l = jax.lax.dynamic_slice_in_dim(queue_p, jlo, W)
+        onehot_ql = (queue_l[:, None] == jnp.arange(Q)[None, :]).astype(f32)
+        onehot_jq = jnp.matmul(onehot_j, onehot_ql)  # [W_task, Q]
+        onehot_u = (pid_l[:, None] == jnp.arange(UM)[None, :]).astype(f32)
+        same_pid = pid_l[:, None] == pid_l[None, :]
+        jsl = lambda a: jax.lax.dynamic_slice_in_dim(a, jlo, W, axis=0)
+
+        # Profiles present in this wave ([UM] global rows).
+        pids = wave_prof[w]  # [UM]
+        p_req = prof.req[pids]
+        p_init_req = prof.init_req[pids]
+        p_req_pos = p_req > 0
+        if has_ports:
+            p_ports = _unpack_bits(prof.ports[pids])  # [UM, B]
+            p_has_ports = jnp.any(p_ports, axis=-1)
+            ports_w = p_ports[pid_l]  # [W, B] per-task view
+        if has_aff:
+            p_t_req_aff = prof.t_req_aff[pids]  # [UM, E]
+            p_t_req_anti = prof.t_req_anti[pids]
+            p_t_matches = prof.t_matches[pids]
+            p_t_soft = prof.t_soft[pids]
+            t_matches_w = p_t_matches[pid_l]  # [W, E]
+
+        # ---- static predicate masks, hoisted out of the attempt loop ----
+        p_ok = node_ready[None, :] & _subset_mm(
+            _unpack_bits(prof.sel_bits[pids]), label_missing_f
+        )
+        aff_bits_p = _unpack_bits(prof.aff_bits[pids])  # [UM, A, B]
+        term_ok = _subset_mm(
+            aff_bits_p.reshape(UM * A, -1), label_missing_f
+        ).reshape(UM, A, N)
+        n_terms = prof.aff_terms[pids]
+        term_real = jnp.arange(A)[None, :] < n_terms[:, None]  # [UM, A]
+        p_ok &= (
+            jnp.any(term_ok & term_real[:, :, None], axis=1)
+            | (n_terms == 0)[:, None]
+        )
+        if has_taints:
+            # Taints: any node taint bit not tolerated kills the pair.
+            untol = jnp.matmul(
+                node_taint_bits_f,
+                (~_unpack_bits(prof.tol_bits[pids])).astype(f32).T,
+            )  # [N, UM]
+            p_ok &= untol.T == 0
+
+        pref_bits_p = _unpack_bits(prof.pref_bits[pids])  # [UM, AP, B]
+        pref_match = _subset_mm(
+            pref_bits_p.reshape(UM * AP, -1), label_missing_f
+        ).reshape(UM, AP, N)
+        p_static_score = weights.node_affinity_weight * jnp.sum(
+            pref_match * prof.pref_w[pids][:, :, None], axis=1
+        )  # [UM, N]
+
+        def live_parts(s: GState):
+            """Per-attempt dynamic feasibility [UM, N] (+ cval for aff)."""
+            if has_future:
+                future_idle = (
+                    s.idle + nodes.releasing - nodes.pipelined - s.pip_extra
+                )
+                walk_idle = future_idle
+            else:
+                future_idle = s.idle
+                walk_idle = s.idle
+            fit_future = less_equal(
+                p_init_req[:, None, :], future_idle[None, :, :],
+                eps, scalar_slot,
+            )
+            total_ntasks = s.ntasks + s.pip_ntasks
+            pods_ok = (
+                (nodes.max_tasks <= 0) | (total_ntasks < nodes.max_tasks)
+            )[None, :]
+            p_feasible = p_ok & fit_future & pods_ok
+            if has_ports:
+                used_port_f = (s.nport_bits | s.pip_nport_bits).astype(f32)
+                port_clash = jnp.matmul(
+                    p_ports.astype(f32), used_port_f.T
+                )
+                p_feasible &= ~p_has_ports[:, None] | (port_clash == 0)
+            cval = None
+            if has_aff:
+                cnt = s.cnt_alloc + s.cnt_pip  # [E, D]
+                cval = cnt[term_arange[None, :], jnp.maximum(node_dom_t, 0)]
+                cval = jnp.where(node_dom_t >= 0, cval, 0)  # [N, E]
+                total = jnp.sum(cnt, axis=-1)  # [E]
+                # Required affinity: every required term needs a resident
+                # match in the node's domain (or the self-match rule).
+                selfok = (total == 0)[None, :] & p_t_matches  # [UM, E]
+                need = (p_t_req_aff & ~selfok).astype(f32)
+                aff_viol = jnp.matmul(need, (cval == 0).astype(f32).T)
+                anti_viol = jnp.matmul(
+                    p_t_req_anti.astype(f32), (cval > 0).astype(f32).T
+                )
+                p_feasible &= (aff_viol == 0) & (anti_viol == 0)
+            return p_feasible, future_idle, walk_idle, cval
+
+        def rank_nodes(s: GState, p_feasible, cval):
+            """Per-profile node ranking by live score ([UM, K] ids).
+
+            One argsort per attempt.  Because infeasible nodes rank last
+            (NEG-masked) and every live-feasible node holds at least one
+            copy, the first unresolved candidate always lands on a node
+            that accepts it — the attempt loop's progress guarantee.
+            """
+            p_score = jax.vmap(node_score, in_axes=(0, None, None, None))(
+                p_req, nodes.allocatable, s.idle, weights
+            )
+            p_score = p_score + p_static_score
+            if has_aff:
+                p_score = p_score + jnp.matmul(
+                    p_t_soft, cval.T.astype(f32)
+                )
+            p_score = jnp.where(p_feasible, p_score, NEG)
+            order = jnp.argsort(-p_score, axis=1, stable=True)
+            return order[:, :K].astype(jnp.int32)
+
+        done0 = ~real_w
+
+        def attempt_cond(carry):
+            _s, done, _al, _ff, skip_l, _ov, _aw, _pw, it = carry
+            skip_t = (
+                jnp.matmul(onehot_j, skip_l.astype(f32)[:, None])[:, 0] > 0
+            )
+            # Each attempt provably resolves at least the first unresolved
+            # candidate; the bound is a belt-and-braces guard that turns
+            # any regression into an incomplete (retryable) solve instead
+            # of a wedged device.
+            return jnp.any(~done & ~skip_t) & (it < 2 * W + 64)
+
+        def attempt_body(carry):
+            (s, done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
+             pipelined_w, it) = carry
+
+            if has_overuse:
+                # Queue-overuse gating at each job's first task (live q).
+                gate = is_first_w & ~done
+                q_tot_w = jnp.matmul(onehot_jq, s.q_alloc + s.q_pip)
+                des_w = jnp.matmul(onehot_jq, queues.deserved)
+                overused = ~less_equal(q_tot_w, des_w, eps, scalar_slot)
+                gate_over = gate & overused & real_w
+                gated = (
+                    jnp.matmul(
+                        onehot_j.T, gate_over.astype(f32)[:, None]
+                    )[:, 0] > 0
+                )
+                skip_l = skip_l | gated
+                over_l = over_l | gated
+
+            skip_t = (
+                jnp.matmul(onehot_j, skip_l.astype(f32)[:, None])[:, 0] > 0
+            )
+            cand = ~done & ~skip_t
+
+            p_feasible, future_idle, walk_idle, cval = live_parts(s)
+            ranked = rank_nodes(s, p_feasible, cval)
+
+            p_any = jnp.any(p_feasible, axis=1)
+            any_feasible = (
+                jnp.matmul(onehot_u, p_any.astype(f32)[:, None])[:, 0] > 0
+            )
+            no_node = cand & ~any_feasible
+
+            # ---- capacity walk down the ranked list ------------------------
+            # Live capacity (copies of the profile) at each ranked node.
+            feas_k = jnp.take_along_axis(p_feasible, ranked, axis=1)
+            walk_k = walk_idle[ranked]  # [UM, K, R] small gather
+            per = jnp.where(
+                p_req_pos[:, None, :],
+                walk_k / jnp.maximum(p_req[:, None, :], 1e-9),
+                jnp.inf,
+            )
+            c_res = jnp.clip(jnp.min(per, axis=-1), 0.0, BIG)
+            nt_k = (s.ntasks + s.pip_ntasks)[ranked]
+            mt_k = nodes.max_tasks[ranked]
+            c_pods = jnp.where(
+                mt_k > 0, (mt_k - nt_k).astype(f32), BIG
+            )
+            c = jnp.where(
+                feas_k, jnp.minimum(jnp.floor(c_res), c_pods), 0.0
+            )
+            cumcap = jnp.cumsum(c, axis=1)  # [UM, K]
+
+            # m = my rank among this attempt's candidates of my profile.
+            m = jnp.sum(same_pid & tril & cand[None, :], axis=1).astype(f32)
+            rows_cc = jnp.matmul(onehot_u, cumcap)  # [W, K]
+            j = jnp.sum(
+                (rows_cc <= m[:, None]).astype(jnp.int32), axis=1
+            )
+            overflow = cand & any_feasible & (j >= K)
+            j = jnp.clip(j, 0, K - 1)
+            rows_rk = jnp.matmul(onehot_u, ranked.astype(f32))  # [W, K]
+            j1h = (j[:, None] == jnp.arange(K)[None, :]).astype(f32)
+            choice = jnp.round(jnp.sum(rows_rk * j1h, axis=1)).astype(
+                jnp.int32
+            )
+            choice = jnp.clip(choice, 0, N - 1)
+
+            # Abort-in-order: a no-node task masks later tasks of its job
+            # from this attempt's acceptance (allocate.go:189-193).
+            same_job = jw[:, None] == jw[None, :]
+            aborted = jnp.any(same_job & tril & no_node[None, :], axis=1)
+            live = cand & any_feasible & ~aborted & ~overflow
+
+            # ---- prefix acceptance in task order ---------------------------
+            same_node = (choice[:, None] == choice[None, :]) & tril
+            pre = (same_node & live[None, :]).astype(f32)
+            cum_req = jnp.matmul(pre, req_w)  # [W, R]
+            cum_cnt = jnp.sum(pre, axis=1).astype(jnp.int32)
+
+            # One fused node gather for every per-choice read.
+            cols = [s.idle, (s.ntasks + s.pip_ntasks)[:, None].astype(f32),
+                    nodes.max_tasks[:, None].astype(f32)]
+            if has_future:
+                cols.append(future_idle)
+            g = jnp.concatenate(cols, axis=1)[choice]  # [W, C]
+            idle_c = g[:, :R]
+            ntasks_c = jnp.round(g[:, R]).astype(jnp.int32)
+            maxt_c = jnp.round(g[:, R + 1]).astype(jnp.int32)
+
+            fits_idle = less_equal(
+                init_req_w + cum_req, idle_c, eps, scalar_slot
+            )
+            tot_c = ntasks_c + cum_cnt
+            pods_fit = (maxt_c <= 0) | (tot_c < maxt_c)
+            clean = live & pods_fit
+            if has_ports:
+                # Port clash against earlier same-node accepted tasks.
+                pair_port = jnp.matmul(
+                    ports_w.astype(f32), ports_w.astype(f32).T
+                )
+                port_conf = jnp.any(
+                    same_node & live[None, :] & (pair_port > 0), axis=1
+                )
+                clean &= ~port_conf
+            if has_aff:
+                # Same-domain affinity interaction with earlier wave tasks:
+                # conservative — any shared term in the same topology
+                # domain sends the later task to the next attempt.
+                dw = node_dom_t[choice]  # [W, E]
+                p_involved = p_t_req_aff | p_t_req_anti | (
+                    jnp.abs(p_t_soft) > 0
+                )
+                involved = p_involved[pid_l] & (dw >= 0)  # [W, E]
+                gives = t_matches_w & (dw >= 0)
+                if E * W * W <= (1 << 27):
+                    hit = (
+                        involved[:, None, :] & gives[None, :, :]
+                        & (dw[:, None, :] == dw[None, :, :])
+                    )
+                    aff_pair = jnp.any(hit, axis=-1)
+                else:
+                    # Large term tables: chunk the E axis to bound the
+                    # [W, W, C] intermediate.
+                    C = max(1, (1 << 27) // (W * W))
+                    EC = (E + C - 1) // C
+                    e_pad = EC * C - E
+                    inv_p = jnp.pad(involved, ((0, 0), (0, e_pad)))
+                    giv_p = jnp.pad(gives, ((0, 0), (0, e_pad)))
+                    dw_p = jnp.pad(
+                        dw, ((0, 0), (0, e_pad)), constant_values=-1
+                    )
+
+                    def chunk_body(ci, acc):
+                        lo = ci * C
+                        inv_c = jax.lax.dynamic_slice_in_dim(
+                            inv_p, lo, C, 1
+                        )
+                        giv_c = jax.lax.dynamic_slice_in_dim(
+                            giv_p, lo, C, 1
+                        )
+                        dw_c = jax.lax.dynamic_slice_in_dim(dw_p, lo, C, 1)
+                        hit = (
+                            inv_c[:, None, :] & giv_c[None, :, :]
+                            & (dw_c[:, None, :] == dw_c[None, :, :])
+                            & (dw_c[None, :, :] >= 0)
+                        )
+                        return acc | jnp.any(hit, axis=-1)
+
+                    aff_pair = jax.lax.fori_loop(
+                        0, EC, chunk_body, jnp.zeros((W, W), bool)
+                    )
+                aff_conf = jnp.any(
+                    tril & live[None, :] & aff_pair, axis=1
+                )
+                clean &= ~aff_conf
+
+            acc_alloc = clean & fits_idle
+            if has_future:
+                fut_c = g[:, R + 2:2 * R + 2]
+                fits_fut = less_equal(
+                    init_req_w + cum_req, fut_c, eps, scalar_slot
+                )
+                acc_pipe = clean & ~fits_idle & fits_fut
+            else:
+                acc_pipe = jnp.zeros_like(acc_alloc)
+
+            # ---- apply ------------------------------------------------------
+            radd = req_w * acc_alloc[:, None]
+            s = s._replace(
+                idle=s.idle.at[choice].add(-radd),
+                ntasks=s.ntasks.at[choice].add(acc_alloc.astype(jnp.int32)),
+                q_alloc=s.q_alloc + jnp.matmul(onehot_jq.T, radd),
+            )
+            if has_future:
+                padd = req_w * acc_pipe[:, None]
+                s = s._replace(
+                    pip_extra=s.pip_extra.at[choice].add(padd),
+                    pip_ntasks=s.pip_ntasks.at[choice].add(
+                        acc_pipe.astype(jnp.int32)
+                    ),
+                    q_pip=s.q_pip + jnp.matmul(onehot_jq.T, padd),
+                )
+            if has_ports:
+                s = s._replace(
+                    nport_bits=s.nport_bits.at[choice].max(
+                        ports_w & acc_alloc[:, None]
+                    )
+                )
+                if has_future:
+                    s = s._replace(
+                        pip_nport_bits=s.pip_nport_bits.at[choice].max(
+                            ports_w & acc_pipe[:, None]
+                        )
+                    )
+            if has_aff:
+                flat_dom = term_arange[None, :] * D + jnp.maximum(dw, 0)
+                inc_base = t_matches_w & (dw >= 0)
+                cnt_alloc = (
+                    s.cnt_alloc.reshape(-1)
+                    .at[flat_dom.reshape(-1)]
+                    .add(
+                        (inc_base & acc_alloc[:, None])
+                        .astype(jnp.int32).reshape(-1)
+                    )
+                    .reshape(E, D)
+                )
+                s = s._replace(cnt_alloc=cnt_alloc)
+                if has_future:
+                    cnt_pip = (
+                        s.cnt_pip.reshape(-1)
+                        .at[flat_dom.reshape(-1)]
+                        .add(
+                            (inc_base & acc_pipe[:, None])
+                            .astype(jnp.int32).reshape(-1)
+                        )
+                        .reshape(E, D)
+                    )
+                    s = s._replace(cnt_pip=cnt_pip)
+
+            # Job-local bookkeeping as one [W, W] matmul.
+            jupd = jnp.matmul(
+                onehot_j.T,
+                jnp.stack([acc_alloc, no_node], axis=1).astype(f32),
+            )  # [W_job, 2]
+            alloc_l = alloc_l + jnp.round(jupd[:, 0]).astype(jnp.int32)
+            fitf_l = fitf_l | (jupd[:, 1] > 0)
+            skip_l = skip_l | (jupd[:, 1] > 0)
+
+            assigned_w = jnp.where(acc_alloc, choice, assigned_w)
+            pipelined_w = jnp.where(acc_pipe, choice, pipelined_w)
+            done = done | acc_alloc | acc_pipe | no_node
+
+            return (
+                s, done, alloc_l, fitf_l, skip_l, over_l,
+                assigned_w, pipelined_w, it + 1,
+            )
+
+        init = (
+            state,
+            done0,
+            jsl(state.alloc_cnt),
+            jsl(state.fit_failed),
+            jsl(state.job_skip),
+            jsl(state.job_overskip),
+            jnp.full((W,), -1, jnp.int32),
+            jnp.full((W,), -1, jnp.int32),
+            jnp.int32(0),
+        )
+        (s, _done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
+         pipelined_w, _it) = jax.lax.while_loop(
+            attempt_cond, attempt_body, init
+        )
+
+        jupd_back = lambda g, l: jax.lax.dynamic_update_slice_in_dim(
+            g, l, jlo, axis=0
+        )
+        return s._replace(
+            alloc_cnt=jupd_back(s.alloc_cnt, alloc_l),
+            fit_failed=jupd_back(s.fit_failed, fitf_l),
+            job_skip=jupd_back(s.job_skip, skip_l),
+            job_overskip=jupd_back(s.job_overskip, over_l),
+            assigned=jax.lax.dynamic_update_slice_in_dim(
+                s.assigned, assigned_w, off, axis=0
+            ),
+            pipelined=jax.lax.dynamic_update_slice_in_dim(
+                s.pipelined, pipelined_w, off, axis=0
+            ),
+        )
+
+    state = jax.lax.fori_loop(0, NW, run_wave, state)
+
+    # ---- gang commit/discard, vectorized (stmt.Discard) --------------------
+    min_av_p = jnp.pad(jobs.min_available, (0, W), constant_values=1 << 30)
+    ready_base_p = jnp.pad(jobs.ready_base, (0, W))
+    job_ready = ready_base_p + state.alloc_cnt >= min_av_p
+    never_ready_p = job_seen & ~state.job_overskip & ~job_ready  # [JP]
+    discard_t = never_ready_p[tjob] & tasks.real & (state.assigned >= 0)
+    n_c = jnp.maximum(state.assigned, 0)
+    rsub = tasks.req * discard_t[:, None]
+    idle = state.idle.at[n_c].add(rsub)
+    q_alloc = state.q_alloc.at[queue_p[tjob]].add(-rsub)
+    assigned = jnp.where(discard_t, -1, state.assigned)
+
+    return AllocResult(
+        assigned=assigned,
+        pipelined=state.pipelined,
+        never_ready=never_ready_p[:J],
+        fit_failed=state.fit_failed[:J],
+        idle=idle,
+        q_alloc=q_alloc + state.q_pip,
+    )
+
+
+def _np(a):
+    return np.asarray(a)
+
+
+_HASH_SEED = np.random.RandomState(0x5EED)
+
+
+def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs):
+    """Group tasks into distinct profiles (host, numpy).
+
+    Returns (profiles, pid[P]) where profiles hold one row per distinct
+    combination of every per-task solver input except job identity, and
+    pid is ordered by first occurrence (so job-contiguous task order keeps
+    per-wave profile ranges narrow).
+
+    Grouping hashes each row with a random linear map and verifies the
+    result exactly (every row compared against its representative); on the
+    astronomically unlikely hash collision it falls back to exact grouping.
+    """
+    P = tasks.req.shape[0]
+    cols = [
+        _np(tasks.req).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.init_req).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.ports).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.sel_bits).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.aff_bits).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.aff_terms).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.tol_bits).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.pref_bits).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(tasks.pref_w).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(aff.t_req_aff).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(aff.t_req_anti).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(aff.t_matches).reshape(P, -1).view(np.uint8).reshape(P, -1),
+        _np(aff.t_soft).reshape(P, -1).view(np.uint8).reshape(P, -1),
+    ]
+    raw = np.concatenate(cols, axis=1)  # [P, C] uint8
+    # Three independent linear hashes with small coefficients: every dot
+    # product stays below 2^33, so the float64 BLAS matmul is exact and two
+    # distinct rows collide in one column with probability ~2^-20 (the
+    # coefficients are random); across three columns ~2^-60 per pair.
+    rnd = _HASH_SEED.randint(1, 1 << 20, size=(raw.shape[1], 3))
+    h = (raw.astype(np.float64) @ rnd.astype(np.float64)).astype(np.int64)
+    p1 = np.uint64(0x9E3779B97F4A7C15).astype(np.int64)
+    p2 = np.uint64(0xC2B2AE3D27D4EB4F).astype(np.int64)
+    with np.errstate(over="ignore"):
+        hv = h[:, 0] + h[:, 1] * p1 + h[:, 2] * p2
+    _, first_idx, inv = np.unique(
+        hv, return_index=True, return_inverse=True
+    )
+    # Renumber profiles by first occurrence so pid follows task order.
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order))
+    pid = rank[inv].astype(np.int32)
+    u = first_idx[order]
+
+    if not np.array_equal(raw, raw[u][pid]):  # hash collision: exact path
+        key = np.ascontiguousarray(raw)
+        _, first_idx, inv = np.unique(
+            key.view([("", np.uint8)] * key.shape[1]).ravel(),
+            return_index=True,
+            return_inverse=True,
+        )
+        order = np.argsort(first_idx, kind="stable")
+        rank = np.empty(len(order), np.int64)
+        rank[order] = np.arange(len(order))
+        pid = rank[inv].astype(np.int32)
+        u = first_idx[order]
+
+    profiles = SolveProfiles(
+        req=_np(tasks.req)[u],
+        init_req=_np(tasks.init_req)[u],
+        ports=_np(tasks.ports)[u],
+        sel_bits=_np(tasks.sel_bits)[u],
+        aff_bits=_np(tasks.aff_bits)[u],
+        aff_terms=_np(tasks.aff_terms)[u],
+        tol_bits=_np(tasks.tol_bits)[u],
+        pref_bits=_np(tasks.pref_bits)[u],
+        pref_w=_np(tasks.pref_w)[u],
+        t_req_aff=_np(aff.t_req_aff)[u],
+        t_req_anti=_np(aff.t_req_anti)[u],
+        t_matches=_np(aff.t_matches)[u],
+        t_soft=_np(aff.t_soft)[u],
+    )
+    return profiles, pid
+
+
+def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
+    """Per-wave profile lists as [min, min+UM) id ranges.
+
+    Because pid is numbered by first occurrence and tasks are
+    job-contiguous, the profiles of one wave form a narrow id range; the
+    wave's profile list is just that range (padded to a power-of-two width
+    across waves to bound recompilation).  Returns (wave_prof [NW, UM],
+    pid_local [P]).
+    """
+    U = int(pid.max()) + 1 if len(pid) else 1
+    seg = pid.reshape(n_waves, wave)
+    lo = seg.min(axis=1)  # [NW]
+    hi = seg.max(axis=1)
+    um = int((hi - lo).max()) + 1
+    UM = 1
+    while UM < um:
+        UM *= 2
+    UM = min(UM, max(U, 1))
+    wave_prof = np.minimum(
+        lo[:, None] + np.arange(UM, dtype=np.int32)[None, :], U - 1
+    ).astype(np.int32)
+    pid_local = (pid - np.repeat(lo, wave)).astype(np.int32)
+    return wave_prof, pid_local
+
+
+def _pad_tasks(tasks: SolveTasks, pad: int) -> SolveTasks:
+    def z(a):
+        a = _np(a)
+        return np.concatenate([a, np.zeros((pad, *a.shape[1:]), a.dtype)])
+
+    return SolveTasks(
+        req=z(tasks.req),
+        init_req=z(tasks.init_req),
+        job=np.concatenate(
+            [_np(tasks.job), np.full((pad,), -1, np.int32)]
+        ),
+        real=np.concatenate([_np(tasks.real), np.zeros((pad,), bool)]),
+        ports=z(tasks.ports),
+        sel_bits=z(tasks.sel_bits),
+        aff_bits=z(tasks.aff_bits),
+        aff_terms=z(tasks.aff_terms),
+        tol_bits=z(tasks.tol_bits),
+        pref_bits=z(tasks.pref_bits),
+        pref_w=z(tasks.pref_w),
+    )
+
+
+def _pad_aff(aff: AffinityArgs, pad: int) -> AffinityArgs:
+    def z(a):
+        a = _np(a)
+        return np.concatenate([a, np.zeros((pad, *a.shape[1:]), a.dtype)])
+
+    return AffinityArgs(
+        node_dom=aff.node_dom,
+        term_key=aff.term_key,
+        cnt0=aff.cnt0,
+        t_req_aff=z(aff.t_req_aff),
+        t_req_anti=z(aff.t_req_anti),
+        t_matches=z(aff.t_matches),
+        t_soft=z(aff.t_soft),
+    )
+
+
+def solve_wave(
+    nodes: SolveNodes,
+    tasks: SolveTasks,
+    jobs: SolveJobs,
+    queues: SolveQueues,
+    weights: ScoreWeights,
+    eps,
+    scalar_slot,
+    aff: AffinityArgs,
+    wave: int = DEFAULT_WAVE,
+) -> AllocResult:
+    """Wave-batched solve; same signature/result as ``allocate.solve``.
+
+    Pads the task axis to a multiple of ``wave`` (padded rows are inert),
+    deduplicates tasks into profiles host-side, and truncates the result
+    back to the caller's task count.
+    """
+    P = int(_np(tasks.req).shape[0])
+    wave = int(min(wave, max(1, P)))
+    pad = (-P) % wave
+    if pad:
+        tasks = _pad_tasks(tasks, pad)
+        aff = _pad_aff(aff, pad)
+    n_waves = (P + pad) // wave
+    profiles, pid = _profile_tasks(tasks, aff)
+    wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
+    features = (
+        bool(_np(profiles.ports).any()),
+        bool(
+            _np(profiles.t_req_aff).any()
+            or _np(profiles.t_req_anti).any()
+            or _np(profiles.t_soft).any()
+            or _np(aff.cnt0).any()
+        ),
+        bool(_np(nodes.taint_bits).any()),
+        bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
+        bool((_np(queues.deserved) < 1.0e38).any()),
+    )
+    res = _solve_wave(
+        nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
+        profiles, pid, wave_prof, pid_local,
+        wave=wave, n_waves=n_waves, features=features,
+    )
+    if pad:
+        res = res._replace(
+            assigned=res.assigned[:P], pipelined=res.pipelined[:P]
+        )
+    return res
