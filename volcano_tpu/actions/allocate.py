@@ -39,7 +39,6 @@ SOLVER_ARG = "solver"  # "wave" (default) or "seq" (exact sequential)
 
 class AllocateAction:
     name = "allocate"
-    _retry_discards = False
 
     def initialize(self):
         pass
@@ -150,8 +149,9 @@ class AllocateAction:
         max_rounds = max(rounds, 1) + (3 if solver == "wave" else 0)
 
         slots = None
+        retry_discards = False
         for rnd in range(max_rounds):
-            if rnd >= max(rounds, 1) and not self._retry_discards:
+            if rnd >= max(rounds, 1) and not retry_discards:
                 break
             jobs = self._schedulable_jobs(ssn)
             ordered_jobs = self._job_order(ssn, jobs)
@@ -223,7 +223,7 @@ class AllocateAction:
             # Jobs discarded by the wave solver left their capacity on the
             # table this round; retry while the round also made progress
             # (so a retry can actually see different state).
-            self._retry_discards = bool(never_ready.any()) and made_progress
+            retry_discards = bool(never_ready.any()) and made_progress
             if not made_progress:
                 return
 

@@ -194,9 +194,9 @@ def _solve_wave(
     #  - job- and queue-indexed state reads/writes are [W, W]/[W, Q]
     #    one-hot matmuls over the wave's contiguous job window (TPU
     #    scatters serialize per row);
-    #  - a stalled attempt (capacity exhausted inside the ranked prefix
-    #    while feasible nodes remain beyond it) triggers a re-rank, which
-    #    also guarantees loop progress.
+    #  - a stalled attempt (no placement and no new skip) leaves the state
+    #    bit-identical, so the loop exits; the unresolved tasks stay
+    #    Pending for the cycle (see attempt_cond).
 
     node_dom_t = aff.node_dom[:, aff.term_key]  # [N, E] domain per term
     term_arange = jnp.arange(E)
@@ -372,19 +372,25 @@ def _solve_wave(
         done0 = ~real_w
 
         def attempt_cond(carry):
-            _s, done, _al, _ff, skip_l, _ov, _aw, _pw, it = carry
+            _s, done, _al, _ff, skip_l, _ov, _aw, _pw, it, stalled = carry
             skip_t = (
                 jnp.matmul(onehot_j, skip_l.astype(f32)[:, None])[:, 0] > 0
             )
-            # Each attempt provably resolves at least the first unresolved
-            # candidate; the bound is a belt-and-braces guard that turns
-            # any regression into an incomplete (retryable) solve instead
-            # of a wedged device.
-            return jnp.any(~done & ~skip_t) & (it < 2 * W + 64)
+            # An attempt that resolves nothing leaves the state
+            # bit-identical, so the next attempt would stall the same way:
+            # exit on stall.  (Stall happens when every unresolved task's
+            # feasible nodes sit beyond the top-K ranking prefix while the
+            # prefix keeps live capacity claimed by earlier candidates —
+            # those tasks stay Pending this cycle, the same outcome as the
+            # reference's percentage-of-nodes-to-score cutoff,
+            # scheduler_helper.go:43-62.)  The iteration bound is a
+            # belt-and-braces guard on top.
+            return jnp.any(~done & ~skip_t) & ~stalled & (it < 2 * W + 64)
 
         def attempt_body(carry):
             (s, done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
-             pipelined_w, it) = carry
+             pipelined_w, it, _stalled) = carry
+            skip_l0 = skip_l
 
             if has_overuse:
                 # Queue-overuse gating at each job's first task (live q).
@@ -613,11 +619,15 @@ def _solve_wave(
 
             assigned_w = jnp.where(acc_alloc, choice, assigned_w)
             pipelined_w = jnp.where(acc_pipe, choice, pipelined_w)
-            done = done | acc_alloc | acc_pipe | no_node
+            new_done = acc_alloc | acc_pipe | no_node
+            stalled = ~jnp.any(new_done & ~done) & jnp.all(
+                skip_l == skip_l0
+            )
+            done = done | new_done
 
             return (
                 s, done, alloc_l, fitf_l, skip_l, over_l,
-                assigned_w, pipelined_w, it + 1,
+                assigned_w, pipelined_w, it + 1, stalled,
             )
 
         init = (
@@ -630,9 +640,10 @@ def _solve_wave(
             jnp.full((W,), -1, jnp.int32),
             jnp.full((W,), -1, jnp.int32),
             jnp.int32(0),
+            jnp.bool_(False),
         )
         (s, _done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
-         pipelined_w, _it) = jax.lax.while_loop(
+         pipelined_w, _it, _stalled) = jax.lax.while_loop(
             attempt_cond, attempt_body, init
         )
 
@@ -780,7 +791,6 @@ def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
     UM = 1
     while UM < um:
         UM *= 2
-    UM = min(UM, max(U, 1))
     wave_prof = np.minimum(
         lo[:, None] + np.arange(UM, dtype=np.int32)[None, :], U - 1
     ).astype(np.int32)
@@ -864,11 +874,17 @@ def solve_wave(
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),
     )
-    res = _solve_wave(
-        nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
-        profiles, pid, wave_prof, pid_local,
-        wave=wave, n_waves=n_waves, features=features,
-    )
+    # Exact f32 matmuls are load-bearing: the one-hot matmuls carry node
+    # indices, resource sums, and 0/1 predicate counts that are compared
+    # with == / <=; the TPU default (bf16 MXU passes) rounds node ids above
+    # 256 and capacity sums, mis-routing placements and stalling the
+    # attempt loop.
+    with jax.default_matmul_precision("float32"):
+        res = _solve_wave(
+            nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
+            profiles, pid, wave_prof, pid_local,
+            wave=wave, n_waves=n_waves, features=features,
+        )
     if pad:
         res = res._replace(
             assigned=res.assigned[:P], pipelined=res.pipelined[:P]
