@@ -1,0 +1,94 @@
+"""Fast-path parity: the vectorized cycle must produce the same binds and
+pod-group phases as the object-session path on identical stores."""
+
+import os
+
+import pytest
+
+from volcano_tpu.framework import parse_scheduler_conf
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _run(store, fast: bool):
+    os.environ["VOLCANO_TPU_FASTPATH"] = "1" if fast else "0"
+    try:
+        Scheduler(store, conf_str=CONF).run_once()
+    finally:
+        os.environ.pop("VOLCANO_TPU_FASTPATH", None)
+    return store
+
+
+def _state(store):
+    binds = dict(store.binder.binds)
+    phases = {
+        uid: pg.status.phase for uid, pg in sorted(store.pod_groups.items())
+    }
+    return binds, phases
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(n_nodes=8, n_pods=40, gang_size=4),
+        dict(n_nodes=12, n_pods=60, gang_size=3, n_queues=3,
+             queue_weights=(1, 2, 4)),
+        dict(n_nodes=6, n_pods=30, gang_size=5, zones=2,
+             affinity_fraction=0.2, anti_affinity_fraction=0.1,
+             spread_fraction=0.2),
+    ],
+)
+def test_fast_matches_object_path(seed, kwargs):
+    a = _run(synthetic_cluster(seed=seed, **kwargs), fast=False)
+    b = _run(synthetic_cluster(seed=seed, **kwargs), fast=True)
+    binds_a, phases_a = _state(a)
+    binds_b, phases_b = _state(b)
+    assert binds_b == binds_a
+    assert phases_b == phases_a
+
+
+def test_fast_path_used(monkeypatch):
+    """The eligible default conf actually takes the fast path."""
+    import volcano_tpu.fastpath as fp
+
+    called = {}
+    orig = fp.FastCycle.run
+
+    def spy(self):
+        called["yes"] = True
+        return orig(self)
+
+    monkeypatch.setattr(fp.FastCycle, "run", spy)
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+    Scheduler(store, conf_str=CONF).run_once()
+    assert called.get("yes")
+
+
+def test_object_model_rebuild_after_fast_cycle():
+    store = synthetic_cluster(n_nodes=4, n_pods=12, gang_size=3)
+    Scheduler(store, conf_str=CONF).run_once()
+    # Accessing the object model after a fast commit rebuilds it from pods.
+    total_bound = sum(
+        1 for p in store.pods.values() if p.node_name
+    )
+    assert total_bound == len(store.binder.binds)
+    node_tasks = sum(len(n.tasks) for n in store.nodes.values())
+    assert node_tasks == total_bound
+    # Node accounting balances.
+    for node in store.nodes.values():
+        assert node.idle.milli_cpu >= -1e-6

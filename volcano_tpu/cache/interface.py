@@ -49,6 +49,15 @@ class FakeBinder:
             self.binds[key] = hostname
             self.channel.append(key)
 
+    def bind_batch(self, pairs) -> None:
+        """Batched dispatch used by the fast path (the async-goroutine
+        bind fan-out of cache.go:536-552, collapsed into one call)."""
+        with self._lock:
+            for task, hostname in pairs:
+                key = f"{task.namespace}/{task.name}"
+                self.binds[key] = hostname
+                self.channel.append(key)
+
 
 class FakeEvictor:
     """Records evictions (test_utils.go:119-143)."""

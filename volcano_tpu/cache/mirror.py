@@ -1,0 +1,731 @@
+"""Incremental struct-of-arrays mirror of the cluster store.
+
+The TPU-native replacement for the reference's per-cycle deep-copied
+snapshot (``pkg/scheduler/cache/cache.go:652-730``): instead of cloning
+every Job/Node object and re-flattening it into device arrays each cycle
+(O(cluster) Python work), the store keeps a columnar pod/node/job table
+that is updated *incrementally* as objects mutate — the array analog of the
+reference's informer-driven cache (``cache/event_handlers.go:178-731``).
+
+Design:
+
+- **Static per-pod features are encoded once, at add time.**  Resource
+  requests, label selectors, tolerations, host ports, node-affinity terms
+  and inter-pod affinity terms are interned against store-scoped
+  *append-only* dictionaries and stored as CSR segments (flat index/value
+  buffers + per-row offsets).  Because the dictionaries only grow, encoded
+  rows never go stale.  The feature blob is cached on the ``Pod`` object, so
+  the copy-on-write pod replacement done by ``bind``/``evict`` reuses it.
+- **Dynamic per-pod state is three scalars** (status i8-equivalent, node
+  row, job row) updated in place.
+- **Everything aggregate is derived per cycle by vectorized reductions**
+  (``np.add.at`` over the live rows): node idle/used/releasing, queue
+  allocated, per-job status counts, affinity resident counts.  No
+  incremental double-entry bookkeeping to drift.
+- Rows are tombstoned on delete and compacted when more than half the
+  table is dead.
+
+The fast scheduling path (``volcano_tpu.fastpath``) consumes these tables
+directly; the object model (``api.info``) remains the system of record for
+the controllers and for the object-session path (preempt/reclaim, custom
+plugins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import Pod, TaskStatus
+from ..api.resource import Resource
+
+F = np.float32
+I = np.int32
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+JOB_SELECTOR = "__job__"
+
+# TaskStatus values are bit flags; keep them in int16 columns.
+_OCCUPYING = (
+    TaskStatus.Bound | TaskStatus.Binding | TaskStatus.Running
+    | TaskStatus.Allocated | TaskStatus.Unknown
+)
+_TERMINATED = TaskStatus.Succeeded | TaskStatus.Failed
+
+
+class CSRColumn:
+    """Append-only ragged column: per-row variable-length int/float data.
+
+    Rows are appended once and never mutated; ``gather`` materializes the
+    concatenated segments of a row subset plus the local row index of every
+    element (for vectorized scatters).
+    """
+
+    __slots__ = ("idx", "val", "off", "_n", "_len", "has_val")
+
+    def __init__(self, has_val: bool = False, cap: int = 1024):
+        self.idx = np.zeros(cap, I)
+        self.val = np.zeros(cap, F) if has_val else None
+        self.off = np.zeros(cap + 1, np.int64)
+        self._n = 0  # rows
+        self._len = 0  # elements
+        self.has_val = has_val
+
+    def append(self, indices, values=None) -> None:
+        k = len(indices)
+        if self._len + k > len(self.idx):
+            grow = max(len(self.idx) * 2, self._len + k)
+            self.idx = np.resize(self.idx, grow)
+            if self.val is not None:
+                self.val = np.resize(self.val, grow)
+        if self._n + 1 >= len(self.off):
+            self.off = np.resize(self.off, len(self.off) * 2)
+        if k:
+            self.idx[self._len:self._len + k] = indices
+            if self.val is not None:
+                self.val[self._len:self._len + k] = values
+        self._len += k
+        self._n += 1
+        self.off[self._n] = self._len
+
+    def lens(self, rows: np.ndarray) -> np.ndarray:
+        return (self.off[rows + 1] - self.off[rows]).astype(np.int64)
+
+    def gather(self, rows: np.ndarray):
+        """-> (elem_row_local, indices[, values]) for the given rows."""
+        lens = self.lens(rows)
+        total = int(lens.sum())
+        elem_row = np.repeat(np.arange(len(rows)), lens)
+        if total == 0:
+            pos = np.zeros(0, np.int64)
+        else:
+            # Flat positions: start[row] + intra-row offset.
+            starts = self.off[rows]
+            cum = np.concatenate(([0], np.cumsum(lens)[:-1]))
+            pos = (
+                np.arange(total, dtype=np.int64)
+                - np.repeat(cum, lens)
+                + np.repeat(starts, lens)
+            )
+        if self.val is not None:
+            return elem_row, self.idx[pos], self.val[pos]
+        return elem_row, self.idx[pos]
+
+
+class Interner:
+    """Append-only value -> dense index dictionary."""
+
+    __slots__ = ("index", "items")
+
+    def __init__(self):
+        self.index: Dict[object, int] = {}
+        self.items: List[object] = []
+
+    def intern(self, key) -> int:
+        i = self.index.get(key)
+        if i is None:
+            i = len(self.items)
+            self.index[key] = i
+            self.items.append(key)
+        return i
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+def _grow(a: np.ndarray, n: int) -> np.ndarray:
+    if n <= len(a):
+        return a
+    return np.resize(a, max(n, len(a) * 2))
+
+
+@dataclass
+class _PodFeat:
+    """Static per-pod encoded features (cached on the Pod object)."""
+
+    req: Tuple[list, list]  # (slot idxs, values)
+    init_req: Tuple[list, list]
+    sel: List[int]  # label-pair idxs (node selector + labels interned)
+    own_labels: List[int]  # pod's own label-pair idxs
+    tol: List[int]  # tolerated taint idxs
+    ports: List[int]  # port idxs
+    aff_alts: List[List[int]]  # required node-affinity alternatives
+    pref: List[Tuple[List[int], float]]  # preferred node affinity
+    ip_req_aff: List[int]  # inter-pod term idxs (required affinity)
+    ip_req_anti: List[int]
+    ip_soft: List[Tuple[int, float]]
+    has_ip: bool
+    priority: int
+    create: float
+    best_effort: bool
+    key: tuple = ()
+
+
+class StoreMirror:
+    """Columnar mirror maintained by ``ClusterStore`` mutations."""
+
+    def __init__(self):
+        # -------- dictionaries (append-only; shared across the store life)
+        self.scalar_slots = Interner()  # scalar resource name -> slot-2
+        self.labels = Interner()  # (k, v) pairs
+        self.taints = Interner()  # (key, value, effect)
+        self.ports = Interner()  # port number
+        self.terms = Interner()  # inter-pod term key
+        self.term_info: List[tuple] = []  # (sel_items dict, topo_key, ns set|None)
+        self.topo_keys = Interner()  # topology key -> column
+        # Term membership: per term, a growing list of pod rows whose labels
+        # match the term (resident counting + t_matches are derived).
+        self.term_members: List[List[int]] = []
+        # Task profiles: pods with identical solver-relevant features share
+        # a profile id, interned once at add time (replaces the wave
+        # solver's per-cycle feature hashing).  The key deliberately
+        # excludes job identity; job-dependent inter-pod matches are
+        # refined per cycle by the fast path.
+        self.profiles = Interner()
+
+        # ------------------------------------------------------- pod table
+        cap = 1024
+        self.p_uid: List[Optional[str]] = []
+        self.p_feat: List[Optional[_PodFeat]] = []
+        self.p_row: Dict[str, int] = {}
+        self.p_status = np.zeros(cap, np.int16)
+        self.p_node = np.full(cap, -1, I)
+        self.p_job = np.full(cap, -1, I)
+        self.p_prio = np.zeros(cap, I)
+        self.p_create = np.zeros(cap, np.float64)
+        self.p_alive = np.zeros(cap, bool)
+        self.p_be = np.zeros(cap, bool)  # best-effort (empty init_req)
+        self.p_has_ip = np.zeros(cap, bool)  # has inter-pod terms
+        self.p_prof = np.zeros(cap, I)  # task profile id (self.profiles)
+        self.c_req = CSRColumn(has_val=True)
+        self.c_init_req = CSRColumn(has_val=True)
+        self.c_sel = CSRColumn()
+        self.c_tol = CSRColumn()
+        self.c_ports = CSRColumn()
+        # Node-affinity alternatives: rows in a side table, pods reference a
+        # contiguous [aff_lo, aff_hi) range of it.
+        self.c_aff_alt = CSRColumn()  # one row per alternative
+        self.p_aff_lo = np.zeros(cap, I)
+        self.p_aff_hi = np.zeros(cap, I)
+        self.c_pref = CSRColumn()  # one row per preferred term
+        self.pref_w: List[float] = []
+        self.p_pref_lo = np.zeros(cap, I)
+        self.p_pref_hi = np.zeros(cap, I)
+        self.c_ip_aff = CSRColumn()
+        self.c_ip_anti = CSRColumn()
+        self.c_ip_soft = CSRColumn(has_val=True)
+        self.n_dead = 0
+
+        # ------------------------------------------------------ node table
+        self.n_name: List[Optional[str]] = []
+        self.n_row: Dict[str, int] = {}
+        ncap = 64
+        self.n_ready = np.zeros(ncap, bool)
+        self.n_alive = np.zeros(ncap, bool)
+        self.n_maxtasks = np.zeros(ncap, I)
+        self.c_n_alloc = CSRColumn(has_val=True)
+        self.c_n_labels = CSRColumn()
+        self.c_n_taints = CSRColumn()
+        self.node_objs: List[object] = []  # Node spec per row (labels for dom)
+        # Topology domains: (key column, value) -> dense domain id;
+        # hostname domains are allocated per (node row).
+        self.domains = Interner()
+        self._node_dom_dirty = True
+        self._node_dom: Optional[np.ndarray] = None
+
+        # ------------------------------------------------- job (podgroup) table
+        self.j_uid: List[Optional[str]] = []
+        self.j_row: Dict[str, int] = {}
+        jcap = 64
+        self.j_minav = np.zeros(jcap, I)
+        self.j_prio = np.zeros(jcap, I)
+        self.j_create = np.zeros(jcap, np.float64)
+        self.j_queue: List[str] = []
+        self.j_ns: List[str] = []
+        self.j_alive = np.zeros(jcap, bool)
+        # Toleration specs per pod row (matched lazily per cycle, because
+        # the taint dictionary may grow after the pod was added).
+        self._pod_tols: List[list] = []
+        # Pods bound to nodes the mirror has not seen yet: name -> uids.
+        self._orphans: Dict[str, List[str]] = {}
+        # Epoch bumps force full fallback-path consumers to resync if needed.
+        self.epoch = 0
+
+    # ================================================================ pods
+
+    def _feat(self, pod: Pod) -> _PodFeat:
+        feat = getattr(pod, "_mirror_feat", None)
+        if feat is not None:
+            return feat
+        req = pod.resource_request()
+        init_req = pod.init_resource_request()
+
+        def res_csr(r: Resource):
+            slots, vals = [], []
+            if r.milli_cpu:
+                slots.append(0)
+                vals.append(r.milli_cpu)
+            if r.memory:
+                slots.append(1)
+                vals.append(r.memory)
+            if r.scalars:
+                for name, quant in r.scalars.items():
+                    if quant:
+                        slots.append(2 + self.scalar_slots.intern(name))
+                        vals.append(quant)
+            return slots, vals
+
+        sel = [self.labels.intern(kv) for kv in pod.node_selector.items()]
+        own = [self.labels.intern(kv) for kv in pod.labels.items()]
+        tol = []
+        for t in pod.tolerations:
+            # A toleration row gates taints; intern every (key,value,effect)
+            # combination it covers that exists in the taint dict lazily at
+            # cycle time instead — here we record the toleration spec items.
+            tol.append(t)
+        ports = [self.ports.intern(p) for p in pod.host_ports]
+        aff_alts = [
+            [self.labels.intern(kv) for kv in alt.items()]
+            for alt in pod.required_node_affinity
+        ]
+        pref = [
+            ([self.labels.intern(kv) for kv in sel_d.items()], float(w))
+            for sel_d, w in pod.preferred_node_affinity
+        ]
+
+        ip_req_aff = [self._intern_term(t, pod.namespace) for t in pod.affinity]
+        ip_req_anti = [
+            self._intern_term(t, pod.namespace) for t in pod.anti_affinity
+        ]
+        ip_soft: List[Tuple[int, float]] = []
+        for term, w in getattr(pod, "preferred_affinity", []):
+            ip_soft.append((self._intern_term(term, pod.namespace), float(w)))
+        for term, w in getattr(pod, "preferred_anti_affinity", []):
+            ip_soft.append((self._intern_term(term, pod.namespace), -float(w)))
+        for key, w in getattr(pod, "topology_spread", []):
+            ip_soft.append((self._intern_job_term(pod.job_id(), key), -float(w)))
+
+        req_pair = res_csr(req)
+        init_pair = res_csr(init_req)
+        feat = _PodFeat(
+            req=req_pair,
+            init_req=init_pair,
+            sel=sel,
+            own_labels=own,
+            tol=tol,
+            ports=ports,
+            aff_alts=aff_alts,
+            pref=pref,
+            ip_req_aff=ip_req_aff,
+            ip_req_anti=ip_req_anti,
+            ip_soft=ip_soft,
+            has_ip=bool(ip_req_aff or ip_req_anti or ip_soft),
+            priority=pod.priority if pod.priority is not None else 1,
+            create=pod.creation_timestamp,
+            best_effort=init_req.is_empty(),
+            # NOTE: the pod's own labels/namespace are deliberately NOT part
+            # of the key — they only influence inter-pod term membership
+            # (t_matches), which the fast path refines per cycle.
+            key=(
+                tuple(zip(*req_pair)),
+                tuple(zip(*init_pair)),
+                tuple(sorted(sel)),
+                tuple(sorted(ports)),
+                tuple(tuple(sorted(a)) for a in aff_alts),
+                tuple((tuple(sorted(s)), w) for s, w in pref),
+                tuple(
+                    (t.key, t.operator, t.value, t.effect)
+                    for t in pod.tolerations
+                ),
+                tuple(sorted(ip_req_aff)),
+                tuple(sorted(ip_req_anti)),
+                tuple(sorted(ip_soft)),
+            ),
+        )
+        try:
+            pod._mirror_feat = feat
+        except Exception:
+            pass
+        return feat
+
+    def _intern_term(self, term, task_ns: str) -> int:
+        ns = tuple(sorted(term.namespaces)) if term.namespaces else (task_ns,)
+        key = (tuple(sorted(term.match_labels.items())), term.topology_key, ns)
+        before = len(self.terms)
+        e = self.terms.intern(key)
+        if len(self.terms) != before:
+            self.topo_keys.intern(term.topology_key)
+            self.term_info.append((dict(term.match_labels),
+                                   term.topology_key, set(ns)))
+            self.term_members.append([])
+            self._backfill_term(e)
+            self._node_dom_dirty = True
+        return e
+
+    def _intern_job_term(self, job_id: str, topo_key: str) -> int:
+        key = (((JOB_SELECTOR, job_id),), topo_key, None)
+        before = len(self.terms)
+        e = self.terms.intern(key)
+        if len(self.terms) != before:
+            self.topo_keys.intern(topo_key)
+            self.term_info.append(({JOB_SELECTOR: job_id}, topo_key, None))
+            self.term_members.append([])
+            self._backfill_term(e)
+            self._node_dom_dirty = True
+        return e
+
+    def _term_matches(self, e: int, namespace: str, labels: Dict[str, str],
+                      job_uid: str) -> bool:
+        sel, _key, ns = self.term_info[e]
+        if JOB_SELECTOR in sel:
+            return job_uid == sel[JOB_SELECTOR]
+        if ns is not None and namespace not in ns:
+            return False
+        return all(labels.get(k) == v for k, v in sel.items())
+
+    def _backfill_term(self, e: int) -> None:
+        """A new term must learn which existing pods match it."""
+        members = self.term_members[e]
+        for row, uid in enumerate(self.p_uid):
+            if uid is None or not self.p_alive[row]:
+                continue
+            pod = self._pods_ref.get(uid) if self._pods_ref else None
+            if pod is None:
+                continue
+            jrow = self.p_job[row]
+            juid = self.j_uid[jrow] if jrow >= 0 else ""
+            if self._term_matches(e, pod.namespace, pod.labels, juid or ""):
+                members.append(row)
+
+    _pods_ref: Optional[Dict[str, Pod]] = None
+
+    def attach(self, pods: Dict[str, Pod]) -> None:
+        """Give the mirror a live reference to the store's pod dict (used
+        only for rare term backfills)."""
+        self._pods_ref = pods
+
+    def upsert_pod(self, pod: Pod, job_row_of) -> None:
+        """Insert or update a pod row.  ``job_row_of(job_id) -> row``."""
+        feat = self._feat(pod)
+        status = int(pod.task_status())
+        node_row = -1
+        if pod.node_name:
+            node_row = self.n_row.get(pod.node_name, -1)
+            if node_row < 0:
+                # Node not seen yet: remember to adopt when it arrives
+                # (the placeholder-NodeInfo analog, event_handlers.go addTask).
+                self._orphans.setdefault(pod.node_name, []).append(pod.uid)
+        row = self.p_row.get(pod.uid)
+        if row is not None and self.p_uid[row] == pod.uid:
+            if self.p_feat[row] is feat:
+                # Same spec blob (bind/evict copy-on-write carries it over):
+                # update dynamic state only.  The job link is re-derived —
+                # the podgroup controller back-annotates bare pods with a
+                # group name after the fact (pg_controller_handler.go:72-105).
+                self.p_status[row] = status
+                self.p_node[row] = node_row
+                jid = pod.job_id()
+                self.p_job[row] = job_row_of(jid) if jid else -1
+                return
+            # Spec changed: tombstone the old row, fall through to re-add.
+            self.remove_pod(pod.uid)
+        row = len(self.p_uid)
+        self.p_uid.append(pod.uid)
+        self.p_feat.append(feat)
+        self.p_row[pod.uid] = row
+        n = row + 1
+        self.p_status = _grow(self.p_status, n)
+        self.p_node = _grow(self.p_node, n)
+        self.p_job = _grow(self.p_job, n)
+        self.p_prio = _grow(self.p_prio, n)
+        self.p_create = _grow(self.p_create, n)
+        self.p_alive = _grow(self.p_alive, n)
+        self.p_be = _grow(self.p_be, n)
+        self.p_has_ip = _grow(self.p_has_ip, n)
+        self.p_prof = _grow(self.p_prof, n)
+        self.p_aff_lo = _grow(self.p_aff_lo, n)
+        self.p_aff_hi = _grow(self.p_aff_hi, n)
+        self.p_pref_lo = _grow(self.p_pref_lo, n)
+        self.p_pref_hi = _grow(self.p_pref_hi, n)
+
+        self.p_status[row] = status
+        self.p_node[row] = node_row
+        jid = pod.job_id()
+        jrow = job_row_of(jid) if jid else -1
+        self.p_job[row] = jrow
+        self.p_prio[row] = feat.priority
+        self.p_create[row] = feat.create
+        self.p_alive[row] = True
+        self.p_be[row] = feat.best_effort
+        self.p_has_ip[row] = feat.has_ip
+        self.p_prof[row] = self.profiles.intern(feat.key)
+
+        self.c_req.append(*feat.req)
+        self.c_init_req.append(*feat.init_req)
+        self.c_sel.append(feat.sel)
+        # Tolerations are matched lazily per cycle (taint dict may grow);
+        # store toleration list on the side.
+        self._pod_tols.append(feat.tol)
+        self.c_ports.append(feat.ports)
+        self.p_aff_lo[row] = self.c_aff_alt._n
+        for alt in feat.aff_alts:
+            self.c_aff_alt.append(alt)
+        self.p_aff_hi[row] = self.c_aff_alt._n
+        self.p_pref_lo[row] = self.c_pref._n
+        for sel_idx, w in feat.pref:
+            self.c_pref.append(sel_idx)
+            self.pref_w.append(w)
+        self.p_pref_hi[row] = self.c_pref._n
+        self.c_ip_aff.append(feat.ip_req_aff)
+        self.c_ip_anti.append(feat.ip_req_anti)
+        if feat.ip_soft:
+            si = [e for e, _ in feat.ip_soft]
+            sv = [w for _, w in feat.ip_soft]
+            self.c_ip_soft.append(si, sv)
+        else:
+            self.c_ip_soft.append([], [])
+        # Term membership of this pod's own labels.
+        if len(self.terms):
+            juid = jid or ""
+            for e in range(len(self.terms)):
+                if self._term_matches(e, pod.namespace, pod.labels, juid):
+                    self.term_members[e].append(row)
+
+    def remove_pod(self, uid: str) -> None:
+        row = self.p_row.pop(uid, None)
+        if row is None:
+            return
+        self.p_alive[row] = False
+        self.p_uid[row] = None
+        self.n_dead += 1
+
+    def set_pod_state(self, uid: str, status: int, node_row: int) -> None:
+        row = self.p_row.get(uid)
+        if row is not None:
+            self.p_status[row] = status
+            self.p_node[row] = node_row
+
+    # ================================================================ nodes
+
+    def upsert_node(self, node) -> int:
+        row = self.n_row.get(node.name)
+        new = row is None
+        if new:
+            row = len(self.n_name)
+            self.n_name.append(node.name)
+            self.n_row[node.name] = row
+            n = row + 1
+            self.n_ready = _grow(self.n_ready, n)
+            self.n_alive = _grow(self.n_alive, n)
+            self.n_maxtasks = _grow(self.n_maxtasks, n)
+            self.node_objs.append(node)
+        else:
+            self.node_objs[row] = node
+        alloc = node.allocatable_resource()
+        slots, vals = [], []
+        if alloc.milli_cpu:
+            slots.append(0)
+            vals.append(alloc.milli_cpu)
+        if alloc.memory:
+            slots.append(1)
+            vals.append(alloc.memory)
+        if alloc.scalars:
+            for name, quant in alloc.scalars.items():
+                if quant:
+                    slots.append(2 + self.scalar_slots.intern(name))
+                    vals.append(quant)
+        labels = [self.labels.intern(kv) for kv in node.labels.items()]
+        taints = [
+            self.taints.intern((t.key, t.value, t.effect))
+            for t in node.taints
+            if t.effect in ("NoSchedule", "NoExecute")
+        ]
+        if new:
+            self.c_n_alloc.append(slots, vals)
+            self.c_n_labels.append(labels)
+            self.c_n_taints.append(taints)
+        else:
+            # Node spec updates are rare: rewrite by appending a fresh row
+            # and repointing (tombstone the CSR row implicitly).
+            nrow = self.c_n_alloc._n
+            self.c_n_alloc.append(slots, vals)
+            self.c_n_labels.append(labels)
+            self.c_n_taints.append(taints)
+            self._node_csr_row = getattr(self, "_node_csr_row", {})
+            self._node_csr_row[row] = nrow
+        self.n_ready[row] = bool(node.ready) and not node.unschedulable
+        self.n_alive[row] = True
+        self.n_maxtasks[row] = alloc.max_task_num
+        self._node_dom_dirty = True
+        self.epoch += 1
+        for uid in self._orphans.pop(node.name, []):
+            prow = self.p_row.get(uid)
+            if prow is not None:
+                self.p_node[prow] = row
+        return row
+
+    def node_csr_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Map node table rows to their (possibly rewritten) CSR rows."""
+        m = getattr(self, "_node_csr_row", None)
+        if not m:
+            return rows
+        out = rows.copy()
+        for i, r in enumerate(rows):
+            out[i] = m.get(int(r), int(r))
+        return out
+
+    def remove_node(self, name: str) -> None:
+        row = self.n_row.get(name)
+        if row is not None:
+            self.n_alive[row] = False
+            # Pods pointing at this node keep their row; their node col is
+            # fixed up by the per-cycle liveness mask (n_alive).
+            self.epoch += 1
+
+    def node_dom(self) -> np.ndarray:
+        """[Nrows, K] topology domain ids (interned, append-only)."""
+        K = max(1, len(self.topo_keys))
+        N = len(self.n_name)
+        if (
+            not self._node_dom_dirty
+            and self._node_dom is not None
+            and self._node_dom.shape == (N, K)
+        ):
+            return self._node_dom
+        dom = np.full((N, K), -1, I)
+        for k, key in enumerate(self.topo_keys.items):
+            if key == HOSTNAME_KEY:
+                for ni in range(N):
+                    if self.n_alive[ni]:
+                        dom[ni, k] = self.domains.intern(("__host__", ni))
+                continue
+            for ni in range(N):
+                if not self.n_alive[ni]:
+                    continue
+                node = self.node_objs[ni]
+                val = node.labels.get(key) if node is not None else None
+                if val is not None:
+                    dom[ni, k] = self.domains.intern((k, val))
+        self._node_dom = dom
+        self._node_dom_dirty = False
+        return dom
+
+    # ========================================================== jobs (pgs)
+
+    def job_row(self, uid: str) -> int:
+        row = self.j_row.get(uid)
+        if row is None:
+            row = len(self.j_uid)
+            self.j_uid.append(uid)
+            self.j_row[uid] = row
+            n = row + 1
+            self.j_minav = _grow(self.j_minav, n)
+            self.j_prio = _grow(self.j_prio, n)
+            self.j_create = _grow(self.j_create, n)
+            self.j_alive = _grow(self.j_alive, n)
+            self.j_queue.append("default")
+            self.j_ns.append("default")
+            self.j_alive[row] = False
+        return row
+
+    def upsert_pod_group(self, pg, priority: int) -> None:
+        row = self.job_row(pg.uid)
+        self.j_minav[row] = pg.min_member
+        self.j_prio[row] = priority
+        self.j_create[row] = pg.creation_timestamp
+        self.j_queue[row] = pg.queue
+        self.j_ns[row] = pg.namespace
+        self.j_alive[row] = True
+
+    def remove_pod_group(self, uid: str) -> None:
+        row = self.j_row.get(uid)
+        if row is not None:
+            self.j_alive[row] = False
+
+    # ========================================================== maintenance
+
+    def maybe_compact(self) -> None:
+        """Rebuild the pod table without tombstones (rare, amortized)."""
+        total = len(self.p_uid)
+        if total < 4096 or self.n_dead * 2 < total:
+            return
+        live = np.flatnonzero(self.p_alive[:total])
+        old = self
+        fresh = StoreMirror.__new__(StoreMirror)
+        fresh.__init__()
+        # Dictionaries and node/job tables carry over untouched.
+        for attr in ("scalar_slots", "labels", "taints", "ports", "terms",
+                     "term_info", "topo_keys", "profiles",
+                     "n_name", "n_row", "n_ready",
+                     "n_alive", "n_maxtasks", "c_n_alloc", "c_n_labels",
+                     "c_n_taints", "node_objs", "domains", "j_uid", "j_row",
+                     "j_minav", "j_prio", "j_create", "j_queue", "j_ns",
+                     "j_alive", "_pods_ref", "_orphans", "epoch"):
+            setattr(fresh, attr, getattr(old, attr))
+        fresh._node_dom_dirty = True
+        if hasattr(old, "_node_csr_row"):
+            fresh._node_csr_row = old._node_csr_row
+        remap = np.full(total, -1, I)
+        remap[live] = np.arange(len(live), dtype=I)
+        for r in live:
+            uid = old.p_uid[r]
+            fresh.p_uid.append(uid)
+            fresh.p_feat.append(old.p_feat[r])
+            fresh.p_row[uid] = len(fresh.p_uid) - 1
+        n = len(live)
+        for name in ("p_status", "p_node", "p_job", "p_prio", "p_create",
+                     "p_alive", "p_be", "p_has_ip", "p_prof"):
+            arr = getattr(old, name)[:total][live]
+            setattr(fresh, name, arr.copy())
+        # CSR columns: re-append per live row (vectorized gather then bulk).
+        for col_name in ("c_req", "c_init_req", "c_sel", "c_ports",
+                         "c_ip_aff", "c_ip_anti", "c_ip_soft"):
+            oldc: CSRColumn = getattr(old, col_name)
+            newc = CSRColumn(has_val=oldc.has_val)
+            lens = oldc.lens(live)
+            g = oldc.gather(live)
+            newc.idx = g[1].astype(I).copy()
+            if oldc.has_val:
+                newc.val = g[2].astype(F).copy()
+            newc.off = np.concatenate(
+                ([0], np.cumsum(lens))
+            ).astype(np.int64)
+            newc._n = n
+            newc._len = int(lens.sum())
+            setattr(fresh, col_name, newc)
+        # Ragged side tables (aff alternatives / pref terms): rebuild.
+        fresh.p_aff_lo = np.zeros(max(n, 1), I)
+        fresh.p_aff_hi = np.zeros(max(n, 1), I)
+        fresh.p_pref_lo = np.zeros(max(n, 1), I)
+        fresh.p_pref_hi = np.zeros(max(n, 1), I)
+        fresh._pod_tols = []
+        for new_r, r in enumerate(live):
+            fresh.p_aff_lo[new_r] = fresh.c_aff_alt._n
+            for alt_row in range(old.p_aff_lo[r], old.p_aff_hi[r]):
+                _er, vals = old.c_aff_alt.gather(np.array([alt_row]))
+                fresh.c_aff_alt.append(vals)
+            fresh.p_aff_hi[new_r] = fresh.c_aff_alt._n
+            fresh.p_pref_lo[new_r] = fresh.c_pref._n
+            for p_row in range(old.p_pref_lo[r], old.p_pref_hi[r]):
+                _er, vals = old.c_pref.gather(np.array([p_row]))
+                fresh.c_pref.append(vals)
+                fresh.pref_w.append(old.pref_w[p_row])
+            fresh.p_pref_hi[new_r] = fresh.c_pref._n
+            fresh._pod_tols.append(old._pod_tols[r])
+        fresh.term_members = [
+            [int(remap[m]) for m in members if remap[m] >= 0]
+            for members in old.term_members
+        ]
+        self.__dict__.update(fresh.__dict__)
+
+    # ---------------------------------------------------------- inspection
+
+    @property
+    def n_pods(self) -> int:
+        return len(self.p_uid)
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.n_name)

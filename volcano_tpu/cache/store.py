@@ -66,8 +66,12 @@ class ClusterStore:
         default_queue: str = DEFAULT_QUEUE,
     ):
         self._lock = threading.RLock()
-        self.jobs: Dict[str, JobInfo] = {}
-        self.nodes: Dict[str, NodeInfo] = {}
+        self._jobs: Dict[str, JobInfo] = {}
+        self._nodes: Dict[str, NodeInfo] = {}
+        # The fast path (volcano_tpu.fastpath) commits directly to the pod
+        # records + array mirror and marks the derived JobInfo/NodeInfo
+        # object model stale; it is lazily rebuilt from pods on next access.
+        self._objects_stale = False
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.namespace_weights: Dict[str, int] = {}
@@ -91,8 +95,75 @@ class ClusterStore:
         # Watchers notified on spec mutations (the controllers' "informers").
         self._watchers: List[Callable[[str, str, object], None]] = []
 
+        # Incremental struct-of-arrays mirror (the TPU-native snapshot
+        # serializer's state; see cache/mirror.py).
+        from .mirror import StoreMirror
+
+        self.mirror = StoreMirror()
+        self.mirror.attach(self.pods)
+
         # Create the default queue at startup, weight 1 (cache.go:244-254).
         self.add_queue(Queue(name=default_queue, weight=1))
+
+    # ----------------------------------------------- lazy object model
+
+    @property
+    def jobs(self) -> Dict[str, JobInfo]:
+        if self._objects_stale:
+            self._rebuild_objects()
+        return self._jobs
+
+    @property
+    def nodes(self) -> Dict[str, NodeInfo]:
+        if self._objects_stale:
+            self._rebuild_objects()
+        return self._nodes
+
+    def mark_objects_stale(self) -> None:
+        """Called by the fast path after a bulk commit: JobInfo/NodeInfo
+        accounting will be rebuilt from the pod records on next read."""
+        self._objects_stale = True
+
+    def _rebuild_objects(self) -> None:
+        """Recompute the JobInfo/NodeInfo object model from pods + pod
+        groups (the same construction the informer replay performs,
+        cache.go:376-417).  Job insertion order follows the mirror's row
+        order = original arrival order, keeping dict-iteration behavior
+        aligned with the incremental path."""
+        with self._lock:
+            if not self._objects_stale:
+                return
+            self._objects_stale = False
+            self._nodes = {}
+            for row, name in enumerate(self.mirror.n_name):
+                if name is not None and self.mirror.n_alive[row]:
+                    self._nodes[name] = NodeInfo(self.mirror.node_objs[row])
+            self._jobs = {}
+            for uid in self.mirror.j_uid:
+                pg = self.pod_groups.get(uid) if uid else None
+                if pg is None:
+                    continue
+                job = JobInfo(uid)
+                job.set_pod_group(pg)
+                if (
+                    pg.priority_class
+                    and pg.priority_class in self.priority_classes
+                ):
+                    job.priority = self.priority_classes[
+                        pg.priority_class
+                    ].value
+                self._jobs[uid] = job
+            for pod in self.pods.values():
+                try:
+                    self._add_task(pod)
+                except (ValueError, KeyError) as err:
+                    # Over-subscription here means upstream divergence;
+                    # record and keep rebuilding (resync semantics).
+                    import logging
+
+                    logging.getLogger(__name__).error(
+                        "rebuild: failed to re-add task %s: %s", pod.uid, err
+                    )
 
     # ------------------------------------------------------------- watchers
 
@@ -158,6 +229,7 @@ class ClusterStore:
         with self._lock:
             self.pods[pod.uid] = pod
             self._add_task(pod)
+            self.mirror.upsert_pod(pod, self.mirror.job_row)
             self._notify("Pod", "add", pod)
 
     def update_pod(self, pod: Pod) -> None:
@@ -167,6 +239,7 @@ class ClusterStore:
                 self._remove_task(old)
             self.pods[pod.uid] = pod
             self._add_task(pod)
+            self.mirror.upsert_pod(pod, self.mirror.job_row)
             self._notify("Pod", "update", pod)
 
     def delete_pod(self, pod: Pod) -> None:
@@ -174,6 +247,8 @@ class ClusterStore:
             old = self.pods.pop(pod.uid, None)
             if old is not None:
                 self._remove_task(old)
+            self.mirror.remove_pod(pod.uid)
+            self.mirror.maybe_compact()
             self._notify("Pod", "delete", pod)
 
     # -------------------------------------------------------- node handlers
@@ -185,6 +260,7 @@ class ClusterStore:
                 existing.set_node(node)
             else:
                 self.nodes[node.name] = NodeInfo(node)
+            self.mirror.upsert_node(node)
             self._notify("Node", "add", node)
 
     def update_node(self, node: Node) -> None:
@@ -194,11 +270,13 @@ class ClusterStore:
                 self.nodes[node.name] = NodeInfo(node)
             else:
                 existing.set_node(node)
+            self.mirror.upsert_node(node)
             self._notify("Node", "update", node)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
+            self.mirror.remove_node(name)
             self._notify("Node", "delete", name)
 
     # --------------------------------------------------- pod group handlers
@@ -210,6 +288,7 @@ class ClusterStore:
             job.set_pod_group(pg)
             if pg.priority_class and pg.priority_class in self.priority_classes:
                 job.priority = self.priority_classes[pg.priority_class].value
+            self.mirror.upsert_pod_group(pg, job.priority)
             self._notify("PodGroup", "add", pg)
 
     def update_pod_group(self, pg: PodGroup) -> None:
@@ -219,6 +298,7 @@ class ClusterStore:
             job.set_pod_group(pg)
             if pg.priority_class and pg.priority_class in self.priority_classes:
                 job.priority = self.priority_classes[pg.priority_class].value
+            self.mirror.upsert_pod_group(pg, job.priority)
             self._notify("PodGroup", "update", pg)
 
     def delete_pod_group(self, uid: str) -> None:
@@ -229,6 +309,7 @@ class ClusterStore:
                 job.unset_pod_group()
                 if not job.tasks:
                     del self.jobs[uid]
+            self.mirror.remove_pod_group(uid)
             self._notify("PodGroup", "delete", uid)
 
     # ------------------------------------------------------- queue handlers
@@ -370,6 +451,7 @@ class ClusterStore:
             pod.node_name = hostname
             self.pods[pod.uid] = pod
             self._add_task(pod)
+            self.mirror.upsert_pod(pod, self.mirror.job_row)
             self._notify("Pod", "bind", pod)
 
     def evict(self, task: TaskInfo, reason: str) -> None:
@@ -384,6 +466,7 @@ class ClusterStore:
             pod.deleting = True
             self.pods[pod.uid] = pod
             self._add_task(pod)
+            self.mirror.upsert_pod(pod, self.mirror.job_row)
             self.evictor.evict(pod)
             self._notify("Pod", "evict", pod)
 

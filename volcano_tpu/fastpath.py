@@ -1,0 +1,1357 @@
+"""Vectorized scheduling cycle: the TPU-native fast path.
+
+The object-model session (``framework/session.py``) reproduces the
+reference's per-object semantics (``pkg/scheduler/framework/session.go``)
+but pays O(cluster) Python work per cycle: a deep-copied snapshot, heap
+orderings that dispatch a plugin comparator per comparison, and a per-task
+replay of the solver's assignment matrix.  This module is the same cycle —
+enqueue, allocate, backfill, session close — expressed over the store's
+incremental array mirror (``cache/mirror.py``):
+
+- aggregates (node idle/used, queue allocation, DRF shares, job readiness
+  counters) are derived by ``np.add.at``/``bincount`` reductions over the
+  pod table instead of object traversals;
+- job/queue/namespace orderings precompute one key tuple per job and reuse
+  the object path's exact ordering algorithm (``AllocateAction._job_order``,
+  ``allocate.go:107-153``) at job granularity, so heap tie-breaking matches
+  the object path bit-for-bit;
+- the assignment matrix from the wave solver is committed in bulk: array
+  scatter updates, one batched bind dispatch, and pod records mutated in
+  place; the NodeInfo/JobInfo object model is marked stale and lazily
+  rebuilt from pods on next access (the fast path itself never reads it);
+- pod-group status write-back replicates ``close_session``
+  (``framework/framework.go`` jobStatus) and the gang plugin's
+  OnSessionClose conditions (``gang.go:140-183``).
+
+Eligibility: actions within {enqueue, allocate, backfill} and plugins
+within the built-in set.  Anything else (preempt/reclaim, custom plugins)
+falls back to the object path, which remains the semantic reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .actions.allocate import AllocateAction
+from .api import PodGroupCondition, PodGroupPhase, TaskStatus
+from .api.resource import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+    Resource,
+)
+from .arrays.affinity import AffinityArgs, empty_affinity
+from .framework.arguments import Arguments, get_action_args
+from .framework.framework import POD_GROUP_UNSCHEDULABLE
+from .framework.session import _session_counter
+from .metrics import metrics
+from .ops.allocate import SolveJobs, SolveNodes, SolveQueues, SolveTasks
+from .ops.scoring import ScoreWeights
+from .utils.priority_queue import PriorityQueue
+
+log = logging.getLogger(__name__)
+
+F = np.float32
+I = np.int32
+
+FAST_ACTIONS = {"enqueue", "allocate", "backfill"}
+FAST_PLUGINS = {
+    "priority", "gang", "conformance", "drf", "proportion",
+    "predicates", "nodeorder", "binpack",
+}
+
+ST_PENDING = int(TaskStatus.Pending)
+ST_BOUND = int(TaskStatus.Bound)
+ST_BINDING = int(TaskStatus.Binding)
+ST_RUNNING = int(TaskStatus.Running)
+ST_ALLOCATED = int(TaskStatus.Allocated)
+ST_RELEASING = int(TaskStatus.Releasing)
+ST_SUCCEEDED = int(TaskStatus.Succeeded)
+ST_FAILED = int(TaskStatus.Failed)
+ST_UNKNOWN = int(TaskStatus.Unknown)
+
+_ALLOCATED_STATUSES = (ST_BOUND, ST_BINDING, ST_RUNNING, ST_ALLOCATED)
+
+
+def _pow2(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pack_bits(n_rows: int, words: int, rows: np.ndarray,
+               bits: np.ndarray) -> np.ndarray:
+    """Vectorized bitset packing: set ``bits`` in the given rows."""
+    out = np.zeros((n_rows, words), np.uint32)
+    if len(rows):
+        flat = rows.astype(np.int64) * words + (bits >> 5)
+        np.bitwise_or.at(
+            out.reshape(-1), flat,
+            (np.uint32(1) << (bits & 31).astype(np.uint32)),
+        )
+    return out
+
+
+class _JobProxy:
+    """Just enough of JobInfo for the ordering algorithm."""
+
+    __slots__ = ("row", "uid", "namespace", "queue", "key")
+
+    def __init__(self, row, uid, namespace, queue, key):
+        self.row = row
+        self.uid = uid
+        self.namespace = namespace
+        self.queue = queue
+        self.key = key
+
+
+class FastCycle:
+    """One vectorized scheduling cycle over the store mirror."""
+
+    def __init__(self, store, conf):
+        self.store = store
+        self.conf = conf
+        self.m = store.mirror
+        self.uid = f"ssn-{next(_session_counter)}"
+        self.action_names = [
+            a.strip() for a in conf.actions.split(",") if a.strip()
+        ]
+        self.plugin_opts: Dict[str, object] = {}
+        for tier in conf.tiers:
+            for opt in tier.plugins:
+                self.plugin_opts.setdefault(opt.name, opt)
+
+    # --------------------------------------------------------- eligibility
+
+    def eligible(self) -> bool:
+        if not set(self.action_names) <= FAST_ACTIONS:
+            return False
+        if not set(self.plugin_opts) <= FAST_PLUGINS:
+            return False
+        return True
+
+    def _tier_opts(self, flag: str):
+        for tier in self.conf.tiers:
+            for opt in tier.plugins:
+                if getattr(opt, flag, None):
+                    yield opt
+
+    def _has(self, name: str) -> bool:
+        return name in self.plugin_opts
+
+    # ---------------------------------------------------------- derivation
+
+    def derive(self) -> None:
+        """Compute per-cycle aggregates from the pod table."""
+        m = self.m
+        self.Pn = Pn = m.n_pods
+        self.Nn = Nn = m.n_nodes
+        self.R = R = 2 + len(m.scalar_slots)
+        status = m.p_status[:Pn]
+        alive = m.p_alive[:Pn]
+        node = m.p_node[:Pn]
+        self.jobr = m.p_job[:Pn]
+
+        self.slot_names = ["cpu", "memory"] + list(m.scalar_slots.items)
+        self.eps = np.full((R,), MIN_MILLI_SCALAR, F)
+        self.eps[0] = MIN_MILLI_CPU
+        self.eps[1] = MIN_MEMORY
+        self.scalar_slot = np.ones((R,), bool)
+        self.scalar_slot[:2] = False
+
+        # Node allocatable (dense).
+        node_rows = np.arange(Nn)
+        csr_rows = m.node_csr_rows(node_rows)
+        alloc = np.zeros((Nn, R), F)
+        if Nn:
+            er, si, v = m.c_n_alloc.gather(csr_rows)
+            alloc[er, si] = v
+        self.n_alloc = alloc
+        self.n_alive = m.n_alive[:Nn].copy() if Nn else np.zeros(0, bool)
+        self.n_ready = (m.n_ready[:Nn] & self.n_alive) if Nn else np.zeros(0, bool)
+        self.n_maxtasks = m.n_maxtasks[:Nn].astype(I)
+
+        # Resident pods and node usage.
+        node_ok = (node >= 0)
+        if Nn:
+            node_ok &= np.where(node >= 0, self.n_alive[np.clip(node, 0, Nn - 1)], False)
+        terminated = (status == ST_SUCCEEDED) | (status == ST_FAILED)
+        self.resident = alive & node_ok & ~terminated
+        releasing_m = self.resident & (status == ST_RELEASING)
+
+        used = np.zeros((Nn, R), F)
+        rel = np.zeros((Nn, R), F)
+        rows_res = np.flatnonzero(self.resident)
+        if len(rows_res):
+            er, si, v = m.c_req.gather(rows_res)
+            np.add.at(used, (node[rows_res][er], si), v)
+        rows_rel = np.flatnonzero(releasing_m)
+        if len(rows_rel):
+            er, si, v = m.c_req.gather(rows_rel)
+            np.add.at(rel, (node[rows_rel][er], si), v)
+        self.n_used = used  # includes releasing (NodeInfo semantics)
+        self.n_releasing = rel
+        self.n_idle = alloc - used
+        self.n_ntasks = (
+            np.bincount(node[rows_res], minlength=Nn).astype(I)
+            if len(rows_res) else np.zeros(Nn, I)
+        )
+
+        # Per-job status counters.
+        self.Jn = Jn = len(m.j_uid)
+        jr = self.jobr
+        valid_j = alive & (jr >= 0)
+
+        def jcount(mask):
+            rows = np.flatnonzero(valid_j & mask)
+            return np.bincount(jr[rows], minlength=Jn).astype(I)
+
+        alloc_mask = np.isin(status, _ALLOCATED_STATUSES)
+        self.j_cnt_alloc = jcount(alloc_mask)
+        self.j_cnt_succ = jcount(status == ST_SUCCEEDED)
+        self.j_cnt_fail = jcount(status == ST_FAILED)
+        self.j_cnt_run = jcount(status == ST_RUNNING)
+        pending_mask = status == ST_PENDING
+        self.j_cnt_pending = jcount(pending_mask)
+        self.j_cnt_empty_pending = jcount(pending_mask & m.p_be[:Pn])
+        self.j_cnt_total = jcount(np.ones_like(status, bool))
+        self.j_cnt_releasing = jcount(status == ST_RELEASING)
+        self.j_cnt_other = (
+            self.j_cnt_total - self.j_cnt_alloc - self.j_cnt_succ
+            - self.j_cnt_fail - self.j_cnt_pending - self.j_cnt_releasing
+        )
+        # ready_task_num (job_info.go:329-348).
+        self.j_ready_base = (
+            self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_empty_pending
+        )
+        # valid_task_num (job_info.go:351-366): allocated|succeeded|pending.
+        self.j_valid = self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_pending
+
+        # Per-job allocated resources (DRF + proportion).
+        self.j_alloc_res = np.zeros((Jn, R), F)
+        rows_am = np.flatnonzero(valid_j & alloc_mask)
+        if len(rows_am):
+            er, si, v = m.c_req.gather(rows_am)
+            np.add.at(self.j_alloc_res, (jr[rows_am][er], si), v)
+        # Pending request per job (proportion's request aggregation).
+        self.j_pending_res = np.zeros((Jn, R), F)
+        rows_pm = np.flatnonzero(valid_j & pending_mask)
+        if len(rows_pm):
+            er, si, v = m.c_req.gather(rows_pm)
+            np.add.at(self.j_pending_res, (jr[rows_pm][er], si), v)
+
+        # Queues (sorted by name: matches the array encoder's layout).
+        self.queue_names = sorted(self.store.queues.keys())
+        self.queue_index = {n: i for i, n in enumerate(self.queue_names)}
+        self.Qn = len(self.queue_names)
+        self.q_of_job = np.full(Jn, -1, I)
+        for row in range(Jn):
+            qi = self.queue_index.get(m.j_queue[row])
+            if qi is not None:
+                self.q_of_job[row] = qi
+
+        self.total_res = self.n_alloc[self.n_alive].sum(axis=0) if Nn else np.zeros(R, F)
+
+        # Session job set: jobs with a live PodGroup (snapshot semantics:
+        # cache.go snapshot skips jobs with no PodGroup).
+        self.session_jobs = [
+            row for row in range(Jn) if m.j_alive[row]
+        ]
+
+    # ---------------------------------------------------------- resources
+
+    def _res(self, vec: np.ndarray) -> Resource:
+        r = Resource(float(vec[0]), float(vec[1]))
+        for i, name in enumerate(self.slot_names[2:], start=2):
+            if vec[i]:
+                r.set_scalar(name, float(vec[i]))
+        return r
+
+    # -------------------------------------------------------------- shares
+
+    def _drf_shares(self) -> np.ndarray:
+        """Per-job DRF share (drf.go:317-329), vectorized."""
+        total = self.total_res
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratio = np.where(
+                total[None, :] > 0,
+                self.j_alloc_res / np.where(total[None, :] > 0, total[None, :], 1.0),
+                np.where(self.j_alloc_res > 0, 1.0, 0.0),
+            )
+        return ratio.max(axis=1) if self.R else np.zeros(len(self.j_alloc_res))
+
+    def _proportion(self):
+        """Water-fill deserved shares (proportion.go:117-173) over the
+        queues that have session jobs.  Mirrors the plugin's Resource-level
+        loop exactly (queue counts are small)."""
+        q_alloc = np.zeros((self.Qn, self.R), F)
+        q_req = np.zeros((self.Qn, self.R), F)
+        q_seen = np.zeros(self.Qn, bool)
+        for row in self.session_jobs:
+            qi = self.q_of_job[row]
+            if qi < 0:
+                continue
+            q_seen[qi] = True
+            q_alloc[qi] += self.j_alloc_res[row]
+            q_req[qi] += self.j_alloc_res[row] + self.j_pending_res[row]
+        self.q_alloc = q_alloc
+        self.q_seen = q_seen
+
+        deserved_res: Dict[int, Resource] = {}
+        share_by_queue: Dict[str, float] = {}
+        if not self._has("proportion"):
+            self.q_deserved = np.full((self.Qn, self.R), 3.0e38, F)
+            self.q_share = share_by_queue
+            self.q_deserved_res = deserved_res
+            return
+
+        total = self._res(self.total_res)
+        attrs = {}
+        for qi in np.flatnonzero(q_seen):
+            q = self.store.queues[self.queue_names[qi]]
+            attrs[int(qi)] = {
+                "weight": q.weight,
+                "deserved": Resource.empty(),
+                "allocated": self._res(q_alloc[qi]),
+                "request": self._res(q_req[qi]),
+                "share": 0.0,
+            }
+
+        remaining = total.clone()
+        meet = set()
+        while True:
+            total_weight = sum(
+                a["weight"] for qi, a in attrs.items() if qi not in meet
+            )
+            if total_weight == 0:
+                break
+            increased = Resource.empty()
+            decreased = Resource.empty()
+            for qi, a in attrs.items():
+                if qi in meet:
+                    continue
+                old = a["deserved"].clone()
+                a["deserved"].add(
+                    remaining.clone().multi(a["weight"] / float(total_weight))
+                )
+                if a["request"].less(a["deserved"]):
+                    from .api.resource import res_min
+
+                    a["deserved"] = res_min(a["deserved"], a["request"])
+                    meet.add(qi)
+                # share update
+                s = 0.0
+                for rn in a["deserved"].resource_names():
+                    from .api.resource import share as _share
+
+                    v = _share(a["allocated"].get(rn), a["deserved"].get(rn))
+                    if v > s:
+                        s = v
+                a["share"] = s
+                inc, dec = a["deserved"].diff(old)
+                increased.add(inc)
+                decreased.add(dec)
+            remaining.sub(increased).add(decreased)
+            if remaining.is_empty():
+                break
+
+        self.q_deserved = np.full((self.Qn, self.R), 3.0e38, F)
+        for qi, a in attrs.items():
+            self.q_deserved[qi] = self._slots_vec(a["deserved"])
+            deserved_res[qi] = a["deserved"]
+            share_by_queue[self.queue_names[qi]] = a["share"]
+        self.q_share = share_by_queue
+        self.q_deserved_res = deserved_res
+
+    def _slots_vec(self, r: Resource) -> np.ndarray:
+        v = np.zeros((self.R,), F)
+        v[0] = r.milli_cpu
+        v[1] = r.memory
+        if r.scalars:
+            for name, quant in r.scalars.items():
+                idx = self.m.scalar_slots.index.get(name)
+                if idx is not None:
+                    v[2 + idx] = quant
+        return v
+
+    # ------------------------------------------------------------ ordering
+
+    def _job_keys(self, rows: List[int], drf_share: np.ndarray) -> Dict[int, tuple]:
+        """Tier-ordered job-order key per job row (first-nonzero comparator
+        chain == lexicographic tuple compare)."""
+        m = self.m
+        ready = (self.j_ready_base >= m.j_minav[:self.Jn]) if self.Jn else None
+        keys = {}
+        comps = []
+        for opt in self._tier_opts("enabled_job_order"):
+            if opt.name == "priority":
+                comps.append(lambda r: -int(m.j_prio[r]))
+            elif opt.name == "gang":
+                comps.append(lambda r: bool(ready[r]))
+            elif opt.name == "drf":
+                comps.append(lambda r: float(drf_share[r]))
+        for r in rows:
+            key = tuple(c(r) for c in comps)
+            keys[r] = key + (m.j_create[r], m.j_uid[r])
+        return keys
+
+    def _queue_order_fn(self):
+        share = self.q_share
+        has_prop = self._has("proportion") and any(
+            opt.name == "proportion"
+            for opt in self._tier_opts("enabled_queue_order")
+        )
+
+        def fn(l, r) -> bool:
+            if has_prop:
+                ls = share.get(l.name, 0.0)
+                rs = share.get(r.name, 0.0)
+                if ls != rs:
+                    return ls < rs
+            if l.queue.creation_timestamp == r.queue.creation_timestamp:
+                return l.uid < r.uid
+            return l.queue.creation_timestamp < r.queue.creation_timestamp
+
+        return fn
+
+    def _namespace_order_fn(self, ns_share: Dict[str, float]):
+        drf_ns = any(
+            opt.name == "drf"
+            for opt in self._tier_opts("enabled_namespace_order")
+        ) and self._has("drf")
+
+        def fn(l: str, r: str) -> bool:
+            if drf_ns:
+                lw = ns_share.get(l, 0.0)
+                rw = ns_share.get(r, 0.0)
+                if lw != rw:
+                    return lw < rw
+            return l < r
+
+        return fn
+
+    def _overused_fn(self):
+        if not self._has("proportion"):
+            return lambda q: False
+        deserved = self.q_deserved_res
+        qidx = self.queue_index
+        alloc = self.q_alloc
+
+        def fn(q) -> bool:
+            qi = qidx.get(q.name)
+            if qi is None or qi not in deserved:
+                return False
+            return not self._res(alloc[qi]).less_equal(deserved[qi])
+
+        return fn
+
+    def _ns_shares(self, drf_share_unused) -> Dict[str, float]:
+        """Weighted namespace DRF shares (drf.go:224-258)."""
+        if not (self._has("drf") and any(
+            opt.name == "drf"
+            for opt in self._tier_opts("enabled_namespace_order")
+        )):
+            return {}
+        ns_alloc: Dict[str, np.ndarray] = {}
+        for row in self.session_jobs:
+            ns = self.m.j_ns[row]
+            ns_alloc.setdefault(ns, np.zeros(self.R, F))
+            ns_alloc[ns] += self.j_alloc_res[row]
+        total = self.total_res
+        out = {}
+        for ns, al in ns_alloc.items():
+            with np.errstate(divide="ignore", invalid="ignore"):
+                ratio = np.where(total > 0, al / np.where(total > 0, total, 1.0),
+                                 np.where(al > 0, 1.0, 0.0))
+            s = float(ratio.max()) if len(ratio) else 0.0
+            w = self.store.namespace_weights.get(ns, 1)
+            out[ns] = s / float(max(w, 1))
+        return out
+
+    # ------------------------------------------------------------- actions
+
+    def run(self) -> None:
+        self.derive()
+        self._proportion()
+        self.new_conditions: Dict[int, PodGroupCondition] = {}
+        for name in self.action_names:
+            with metrics.action_timer(name):
+                if name == "enqueue":
+                    self._enqueue()
+                elif name == "allocate":
+                    self._allocate()
+                elif name == "backfill":
+                    self._backfill()
+        self._close()
+
+    # ------------------------------------------------------------- enqueue
+
+    def _enqueue(self) -> None:
+        m = self.m
+        store = self.store
+        args = get_action_args(self.conf.configurations, "enqueue")
+        factor = args.get_float("overcommit-factor", 1.2) if args else 1.2
+
+        queue_order = self._queue_order_fn()
+        drf_share = self._drf_shares()
+        jkeys = self._job_keys(self.session_jobs, drf_share)
+        job_order = lambda l, r: jkeys[l] < jkeys[r]
+
+        queues_pq = PriorityQueue(
+            lambda l, r: queue_order(store.queues[l], store.queues[r])
+        )
+        queue_set = set()
+        jobs_map: Dict[str, PriorityQueue] = {}
+        row_pg = {}
+        for row in self.session_jobs:
+            qname = m.j_queue[row]
+            if qname not in store.queues:
+                log.error("Failed to find queue %s for job %s",
+                          qname, m.j_uid[row])
+                continue
+            if qname not in queue_set:
+                queue_set.add(qname)
+                queues_pq.push(qname)
+            pg = store.pod_groups.get(m.j_uid[row])
+            row_pg[row] = pg
+            if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
+                jobs_map.setdefault(qname, PriorityQueue(job_order)).push(row)
+
+        total = self._res(self.total_res)
+        used = self._res(self.n_used[self.n_alive].sum(axis=0)
+                         if self.Nn else np.zeros(self.R, F))
+        idle = total.clone().multi(factor).sub(used)
+
+        while not queues_pq.empty():
+            if idle.is_empty():
+                break
+            qname = queues_pq.pop()
+            jobs = jobs_map.get(qname)
+            if jobs is None or jobs.empty():
+                continue
+            row = jobs.pop()
+            pg = row_pg.get(row)
+            inqueue = False
+            if pg.min_resources is None:
+                inqueue = True
+            else:
+                min_req = Resource.from_resource_list(pg.min_resources)
+                if self._job_enqueueable(row, pg) and min_req.less_equal(idle):
+                    idle.sub(min_req)
+                    inqueue = True
+            if inqueue:
+                pg.status.phase = PodGroupPhase.Inqueue.value
+            queues_pq.push(qname)
+
+    def _job_enqueueable(self, row: int, pg) -> bool:
+        """proportion's JobEnqueueable veto (proportion.go:231-247)."""
+        if not self._has("proportion"):
+            return True
+        qname = self.m.j_queue[row]
+        queue = self.store.queues.get(qname)
+        if queue is None:
+            return True
+        if not queue.queue.capability:
+            return True
+        if pg is None or pg.min_resources is None:
+            return True
+        min_req = Resource.from_resource_list(pg.min_resources)
+        qi = self.queue_index.get(qname)
+        allocated = self._res(self.q_alloc[qi]) if qi is not None else Resource.empty()
+        return min_req.add(allocated).less_equal(
+            Resource.from_resource_list(queue.queue.capability)
+        )
+
+    # ------------------------------------------------------------ allocate
+
+    def _allocate(self) -> None:
+        from .ops.allocate import solve
+        from .ops.wave import solve_wave
+
+        args = get_action_args(self.conf.configurations, "allocate")
+        rounds = args.get_int("rounds", 1) if args else 1
+        solver = args.get_str("solver", "wave") if args else "wave"
+        max_rounds = max(rounds, 1) + (3 if solver == "wave" else 0)
+        solve_fn = solve_wave if solver == "wave" else solve
+
+        retry = False
+        for rnd in range(max_rounds):
+            if rnd >= max(rounds, 1) and not retry:
+                break
+            ordered = self._ordered_jobs()
+            prep = self._pending_rows(ordered)
+            if prep is None:
+                return
+            solve_jobs, task_rows = prep
+            inputs, pid = self._solve_inputs(solve_jobs, task_rows)
+            t0 = time.perf_counter()
+            if solver == "wave":
+                result = solve_fn(*inputs, pid=pid)
+            else:
+                result = solve_fn(*inputs)
+            assigned = np.asarray(result.assigned)[:len(task_rows)]
+            never_ready = np.asarray(result.never_ready)
+            fit_failed = np.asarray(result.fit_failed)
+            metrics.device_solve_latency.observe(
+                (time.perf_counter() - t0) * 1e3
+            )
+            progress = self._commit(
+                solve_jobs, task_rows, assigned, never_ready, fit_failed
+            )
+            retry = bool(never_ready.any()) and progress
+            if not progress:
+                return
+
+    def _schedulable_rows(self) -> List[int]:
+        m = self.m
+        rows = []
+        for row in self.session_jobs:
+            pg = self.store.pod_groups.get(m.j_uid[row])
+            if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
+                continue
+            # gang JobValid (gang.go:51-72): registered whenever the gang
+            # plugin is configured (JobValid has no enable flag).
+            if self._has("gang") and self.j_valid[row] < m.j_minav[row]:
+                continue
+            if m.j_queue[row] not in self.store.queues:
+                continue
+            rows.append(row)
+        return rows
+
+    def _ordered_jobs(self) -> List[_JobProxy]:
+        m = self.m
+        rows = self._schedulable_rows()
+        drf_share = self._drf_shares()
+        jkeys = self._job_keys(rows, drf_share)
+        proxies = [
+            _JobProxy(row, m.j_uid[row], m.j_ns[row], m.j_queue[row],
+                      jkeys[row])
+            for row in rows
+        ]
+        ns_share = self._ns_shares(drf_share)
+
+        class _Ctx:
+            pass
+
+        ctx = _Ctx()
+        ctx.queues = {
+            name: self.store.queues[name] for name in self.queue_names
+        }
+        ctx.job_order_fn = lambda l, r: l.key < r.key
+        ctx.queue_order_fn = self._queue_order_fn()
+        ctx.namespace_order_fn = self._namespace_order_fn(ns_share)
+        ctx.overused = self._overused_fn()
+        return AllocateAction._job_order(None, ctx, proxies)
+
+    def _pending_rows(self, ordered: List[_JobProxy]):
+        """Pending task rows in processing order (job-contiguous)."""
+        m = self.m
+        Pn = self.Pn
+        status = m.p_status[:Pn]
+        alive = m.p_alive[:Pn]
+        pending = alive & (status == ST_PENDING) & ~m.p_be[:Pn]
+        if not pending.any():
+            return None
+        rows_all = np.flatnonzero(pending)
+        jr = self.jobr[rows_all]
+        # Rank of each job in the processing order.
+        jrank = np.full(self.Jn + 1, -1, np.int64)
+        solve_jobs: List[int] = []
+        for p in ordered:
+            jrank[p.row] = len(solve_jobs)
+            solve_jobs.append(p.row)
+        ranks = jrank[jr]
+        keep = ranks >= 0
+        rows_all = rows_all[keep]
+        if not len(rows_all):
+            return None
+        ranks = ranks[keep]
+        # Task order within a job: priority desc, creation asc, uid asc
+        # (priority plugin task_order + session default tie-break).
+        prio = m.p_prio[rows_all]
+        prio_enabled = any(
+            opt.name == "priority"
+            for opt in self._tier_opts("enabled_task_order")
+        )
+        prio_key = -prio if prio_enabled else np.zeros_like(prio)
+        create = m.p_create[rows_all]
+        uids = np.array([m.p_uid[r] for r in rows_all])
+        order = np.lexsort((uids, create, prio_key, ranks))
+        task_rows = rows_all[order]
+        # Keep only jobs that actually have pending tasks, preserving order.
+        present = np.unique(self.jobr[task_rows])
+        present_set = set(int(j) for j in present)
+        kept_jobs = [j for j in solve_jobs if j in present_set]
+        if not kept_jobs:
+            return None
+        return kept_jobs, task_rows
+
+    # ------------------------------------------------------- solver inputs
+
+    def _score_weights(self) -> ScoreWeights:
+        import jax.numpy as jnp
+
+        width = self.R
+        merged = {
+            "binpack_weight": 0.0,
+            "binpack_res": [1.0] * width,
+            "least_req_weight": 0.0,
+            "most_req_weight": 0.0,
+            "balanced_weight": 0.0,
+            "node_affinity_weight": 0.0,
+        }
+        for opt in self._tier_opts("enabled_node_order"):
+            if opt.name == "binpack":
+                args = Arguments(opt.arguments)
+                weight = max(args.get_int("binpack.weight", 1), 1)
+                cpu_w = max(args.get_int("binpack.cpu", 1), 0)
+                mem_w = max(args.get_int("binpack.memory", 1), 0)
+                dense = [0.0] * width
+                dense[0] = float(cpu_w)
+                dense[1] = float(mem_w)
+                for name in (args.get("binpack.resources") or "").split(","):
+                    name = name.strip()
+                    if not name:
+                        continue
+                    idx = self.m.scalar_slots.index.get(name)
+                    if idx is not None:
+                        dense[2 + idx] = float(max(
+                            args.get_int(f"binpack.resources.{name}", 1), 0
+                        ))
+                merged["binpack_weight"] += float(weight)
+                merged["binpack_res"] = dense
+            elif opt.name == "nodeorder":
+                args = Arguments(opt.arguments)
+                merged["least_req_weight"] += float(
+                    args.get_int("leastrequested.weight", 1))
+                merged["most_req_weight"] += float(
+                    args.get_int("mostrequested.weight", 0))
+                merged["balanced_weight"] += float(
+                    args.get_int("balancedresource.weight", 1))
+                merged["node_affinity_weight"] += float(
+                    args.get_int("nodeaffinity.weight", 1))
+        return ScoreWeights(
+            binpack_weight=float(merged["binpack_weight"]),
+            binpack_res=jnp.asarray(merged["binpack_res"], jnp.float32),
+            least_req_weight=float(merged["least_req_weight"]),
+            most_req_weight=float(merged["most_req_weight"]),
+            balanced_weight=float(merged["balanced_weight"]),
+            node_affinity_weight=float(merged["node_affinity_weight"]),
+        )
+
+    def _tol_bits_for(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(elem_rows, taint_idx) pairs of tolerated taints per task row.
+
+        Cached per pod feature blob, keyed by the taint-dictionary size
+        (append-only: a grown dictionary only adds new taints to test)."""
+        m = self.m
+        taints = m.taints.items
+        nt = len(taints)
+        er: List[int] = []
+        ti: List[int] = []
+        for local, r in enumerate(rows):
+            feat = m.p_feat[r]
+            if feat is None or not feat.tol:
+                continue
+            cache = getattr(feat, "_tol_cache", None)
+            if cache is None or cache[0] != nt:
+                idxs = []
+                for k, (tkey, tval, teff) in enumerate(taints):
+                    for tol in feat.tol:
+                        if tol.operator == "Exists":
+                            key_ok = tol.key == "" or tol.key == tkey
+                        else:
+                            key_ok = tol.key == tkey and tol.value == tval
+                        eff_ok = tol.effect == "" or tol.effect == teff
+                        if key_ok and eff_ok:
+                            idxs.append(k)
+                            break
+                cache = (nt, idxs)
+                try:
+                    feat._tol_cache = cache
+                except Exception:
+                    pass
+            for k in cache[1]:
+                er.append(local)
+                ti.append(k)
+        return np.array(er, np.int64), np.array(ti, np.int64)
+
+    def _solve_inputs(self, solve_jobs: List[int], task_rows: np.ndarray):
+        m = self.m
+        P = len(task_rows)
+        # Task axis stays exact: solve_wave pads to wave multiples (the
+        # jit-shape bucket), so a power-of-two pad here would only add waves.
+        Pp = P
+        N = self.Nn
+        Np = _pow2(max(N, 1))
+        R = self.R
+        J = len(solve_jobs)
+        Jp = _pow2(max(J, 1), 4)
+        Qp = _pow2(max(self.Qn, 1), 4)
+
+        LW = _pow2(max(1, (len(m.labels) + 31) // 32), 1)
+        TW = _pow2(max(1, (len(m.taints) + 31) // 32), 1)
+        PW = _pow2(max(1, (len(m.ports) + 31) // 32), 1)
+
+        # ---- nodes
+        n_label_bits = np.zeros((Np, LW), np.uint32)
+        n_taint_bits = np.zeros((Np, TW), np.uint32)
+        if N:
+            csr_rows = m.node_csr_rows(np.arange(N))
+            er, li = m.c_n_labels.gather(csr_rows)
+            n_label_bits[:N] = _pack_bits(N, LW, er, li)
+            er, ti = m.c_n_taints.gather(csr_rows)
+            n_taint_bits[:N] = _pack_bits(N, TW, er, ti)
+        n_ports = np.zeros((Np, PW), np.uint32)
+        rows_res = np.flatnonzero(self.resident)
+        if len(rows_res):
+            er, pi = m.c_ports.gather(rows_res)
+            if len(er):
+                nrows = m.p_node[:self.Pn][rows_res][er]
+                n_ports[:N] = _pack_bits(N, PW, nrows, pi)
+
+        def padN(a, fill=0.0):
+            out = np.full((Np, *a.shape[1:]), fill, a.dtype)
+            out[:len(a)] = a
+            return out
+
+        nodes = SolveNodes(
+            idle=padN(self.n_idle.astype(F)),
+            allocatable=padN(self.n_alloc.astype(F)),
+            releasing=padN(self.n_releasing.astype(F)),
+            pipelined=np.zeros((Np, R), F),
+            ntasks=padN(self.n_ntasks),
+            max_tasks=padN(self.n_maxtasks),
+            ports=n_ports,
+            ready=padN(self.n_ready),
+            label_bits=n_label_bits,
+            taint_bits=n_taint_bits,
+        )
+
+        # ---- tasks
+        req = np.zeros((Pp, R), F)
+        init_req = np.zeros((Pp, R), F)
+        er, si, v = m.c_req.gather(task_rows)
+        req[er, si] = v
+        er, si, v = m.c_init_req.gather(task_rows)
+        init_req[er, si] = v
+        sel_bits = np.zeros((Pp, LW), np.uint32)
+        er, li = m.c_sel.gather(task_rows)
+        sel_bits[:P] = _pack_bits(P, LW, er, li)
+        tol_bits = np.zeros((Pp, TW), np.uint32)
+        er, ti = self._tol_bits_for(task_rows)
+        if len(er):
+            tol_bits[:P] = _pack_bits(P, TW, er, ti)
+        port_bits = np.zeros((Pp, PW), np.uint32)
+        er, pi = m.c_ports.gather(task_rows)
+        if len(er):
+            port_bits[:P] = _pack_bits(P, PW, er, pi)
+
+        # Required node-affinity alternatives.
+        aff_lo = m.p_aff_lo[task_rows]
+        aff_hi = m.p_aff_hi[task_rows]
+        n_alts = (aff_hi - aff_lo).astype(np.int64)
+        A = _pow2(max(1, int(n_alts.max()) if P else 1), 1)
+        aff_bits = np.zeros((Pp, A, LW), np.uint32)
+        aff_terms = np.zeros((Pp,), I)
+        aff_terms[:P] = n_alts
+        if n_alts.any():
+            alt_rows = np.concatenate([
+                np.arange(lo, hi) for lo, hi in zip(aff_lo, aff_hi) if hi > lo
+            ]).astype(np.int64)
+            task_of_alt = np.repeat(np.arange(P), n_alts)
+            slot_of_alt = np.concatenate([
+                np.arange(h - l) for l, h in zip(aff_lo, aff_hi) if h > l
+            ])
+            er, li = m.c_aff_alt.gather(alt_rows)
+            flat = _pack_bits(len(alt_rows), LW, er, li)
+            aff_bits[task_of_alt, slot_of_alt] = flat
+
+        # Preferred node affinity (normalized to [0,10] per task).
+        pref_lo = m.p_pref_lo[task_rows]
+        pref_hi = m.p_pref_hi[task_rows]
+        n_pref = (pref_hi - pref_lo).astype(np.int64)
+        AP = _pow2(max(1, int(n_pref.max()) if P else 1), 1)
+        pref_bits = np.zeros((Pp, AP, LW), np.uint32)
+        pref_w = np.zeros((Pp, AP), F)
+        if n_pref.any():
+            pr_rows = np.concatenate([
+                np.arange(lo, hi) for lo, hi in zip(pref_lo, pref_hi) if hi > lo
+            ]).astype(np.int64)
+            task_of_pr = np.repeat(np.arange(P), n_pref)
+            slot_of_pr = np.concatenate([
+                np.arange(h - l) for l, h in zip(pref_lo, pref_hi) if h > l
+            ])
+            er, li = m.c_pref.gather(pr_rows)
+            flat = _pack_bits(len(pr_rows), LW, er, li)
+            pref_bits[task_of_pr, slot_of_pr] = flat
+            w = np.array([m.pref_w[r] for r in pr_rows], F)
+            totals = np.zeros(P, F)
+            np.add.at(totals, task_of_pr, w)
+            wn = np.where(totals[task_of_pr] > 0,
+                          w / totals[task_of_pr] * 10.0, 0.0)
+            pref_w[task_of_pr, slot_of_pr] = wn
+
+        jrank = np.zeros(self.Jn + 1, I)
+        for i, row in enumerate(solve_jobs):
+            jrank[row] = i
+        tjob = jrank[self.jobr[task_rows]]
+        t_job = np.full((Pp,), -1, I)
+        t_job[:P] = tjob
+        t_real = np.zeros((Pp,), bool)
+        t_real[:P] = True
+
+        tasks = SolveTasks(
+            req=req,
+            init_req=init_req,
+            job=t_job,
+            real=t_real,
+            ports=port_bits,
+            sel_bits=sel_bits,
+            aff_bits=aff_bits,
+            aff_terms=aff_terms,
+            tol_bits=tol_bits,
+            pref_bits=pref_bits,
+            pref_w=pref_w,
+        )
+
+        # ---- jobs
+        j_min = np.full((Jp,), 1 << 30, I)
+        j_queue = np.zeros((Jp,), I)
+        j_ready_base = np.zeros((Jp,), I)
+        for i, row in enumerate(solve_jobs):
+            j_min[i] = m.j_minav[row]
+            j_queue[i] = max(self.q_of_job[row], 0)
+            j_ready_base[i] = self.j_ready_base[row]
+        jobs = SolveJobs(
+            queue=j_queue, min_available=j_min, ready_base=j_ready_base
+        )
+
+        # ---- queues
+        deserved = np.full((Qp, R), 3.0e38, F)
+        q_alloc = np.zeros((Qp, R), F)
+        deserved[:self.Qn] = self.q_deserved
+        q_alloc[:self.Qn] = self.q_alloc
+        queues = SolveQueues(deserved=deserved, allocated=q_alloc)
+
+        aff = self._affinity_args(task_rows, Np, Pp)
+        weights = self._score_weights()
+        pid = self._refined_pid(task_rows, aff, P)
+        return (
+            (nodes, tasks, jobs, queues, weights, self.eps,
+             self.scalar_slot, aff),
+            pid,
+        )
+
+    def _refined_pid(self, task_rows: np.ndarray, aff: AffinityArgs,
+                     P: int) -> np.ndarray:
+        """Store-interned profile ids, split further wherever per-cycle
+        inter-pod term membership (t_matches) differs within a profile —
+        the one profile input that can depend on *other* pods of the job
+        (a sibling's topology-spread term matches every pod of the job)."""
+        pid = self.m.p_prof[task_rows].astype(np.int64)
+        t_matches = np.asarray(aff.t_matches)[:P]
+        if t_matches.shape[1] <= 1 or not t_matches.any():
+            return pid
+        E = t_matches.shape[1]
+        rng = np.random.RandomState(0x7A5E)
+        coef = rng.randint(1, 1 << 20, size=(E, 2)).astype(np.float64)
+        h = (t_matches.astype(np.float64) @ coef).astype(np.int64)
+        combo = pid * np.int64(1_000_003) + h[:, 0] + h[:, 1] * np.int64(8191)
+        _, first, inv = np.unique(combo, return_index=True,
+                                  return_inverse=True)
+        refined = first[inv]
+        # Exactness check (hash-collision guard): every member must agree
+        # with its representative's membership row.
+        if not np.array_equal(t_matches, t_matches[refined]):
+            # Fall back to exact grouping on (pid, row bytes).
+            key = np.ascontiguousarray(
+                np.concatenate(
+                    [pid[:, None].view(np.uint8).reshape(P, -1),
+                     t_matches.view(np.uint8).reshape(P, -1)], axis=1
+                )
+            )
+            _, first, inv = np.unique(
+                key.view([("", np.uint8)] * key.shape[1]).ravel(),
+                return_index=True, return_inverse=True,
+            )
+            refined = first[inv]
+        return refined.astype(np.int64)
+
+    def _affinity_args(self, task_rows: np.ndarray, Np: int,
+                       Pp: int) -> AffinityArgs:
+        m = self.m
+        E = len(m.terms)
+        if E == 0:
+            return empty_affinity(Np, Pp)
+        P = len(task_rows)
+        # Any pending task with terms, or any resident counted?  Cheap test:
+        has_any = bool(m.p_has_ip[:self.Pn][task_rows].any())
+        Ep = _pow2(E, 1)
+        K = max(1, len(m.topo_keys))
+        node_dom_raw = m.node_dom()
+        D = max(1, len(m.domains))
+        node_dom = np.full((Np, K), -1, I)
+        node_dom[:len(node_dom_raw)] = node_dom_raw
+        term_key = np.zeros((Ep,), I)
+        for e, (_sel, key, _ns) in enumerate(m.term_info):
+            term_key[e] = m.topo_keys.index.get(key, 0)
+
+        # Resident counts per (term, domain).
+        cnt0 = np.zeros((Ep, D), I)
+        resident = self.resident
+        node = m.p_node[:self.Pn]
+        any_resident = False
+        for e in range(E):
+            members = np.array(
+                [r for r in m.term_members[e] if r < self.Pn], np.int64
+            )
+            if not len(members):
+                continue
+            members = members[resident[members]]
+            if not len(members):
+                continue
+            dom = node_dom_raw[node[members], term_key[e]]
+            dom = dom[dom >= 0]
+            if len(dom):
+                np.add.at(cnt0[e], dom, 1)
+                any_resident = True
+        if not has_any and not any_resident:
+            return empty_affinity(Np, Pp)
+
+        t_req_aff = np.zeros((Pp, Ep), bool)
+        t_req_anti = np.zeros((Pp, Ep), bool)
+        t_matches = np.zeros((Pp, Ep), bool)
+        t_soft = np.zeros((Pp, Ep), F)
+        er, ei = m.c_ip_aff.gather(task_rows)
+        t_req_aff[er, ei] = True
+        er, ei = m.c_ip_anti.gather(task_rows)
+        t_req_anti[er, ei] = True
+        er, ei, ev = m.c_ip_soft.gather(task_rows)
+        np.add.at(t_soft, (er, ei), ev)
+        # t_matches from term membership lists.
+        local = np.full(self.Pn, -1, np.int64)
+        local[task_rows] = np.arange(P)
+        for e in range(E):
+            members = np.array(
+                [r for r in m.term_members[e] if r < self.Pn], np.int64
+            )
+            if not len(members):
+                continue
+            loc = local[members]
+            loc = loc[loc >= 0]
+            if len(loc):
+                t_matches[loc, e] = True
+        return AffinityArgs(
+            node_dom=node_dom,
+            term_key=term_key,
+            cnt0=cnt0,
+            t_req_aff=t_req_aff,
+            t_req_anti=t_req_anti,
+            t_matches=t_matches,
+            t_soft=t_soft,
+        )
+
+    # -------------------------------------------------------------- commit
+
+    def _commit(self, solve_jobs: List[int], task_rows: np.ndarray,
+                assigned: np.ndarray, never_ready: np.ndarray,
+                fit_failed: np.ndarray) -> bool:
+        """Apply the assignment matrix in bulk (the vectorized _replay)."""
+        m = self.m
+        store = self.store
+        jrank_never = never_ready[:len(solve_jobs)]
+        committed = assigned >= 0
+        if not committed.any():
+            self._record_fit_failures(solve_jobs, fit_failed)
+            return False
+
+        rows = task_rows[committed]
+        nodes_c = assigned[committed]
+
+        # Divergence guard (vectorized analog of the replay's re-check):
+        # charged capacity must not exceed allocatable.
+        add = np.zeros((self.Nn, self.R), F)
+        er, si, v = m.c_req.gather(rows)
+        np.add.at(add, (nodes_c[er], si), v)
+        new_used = self.n_used + add
+        over = new_used > self.n_alloc + self.eps[None, :]
+        if over.any() and bool((add[over.any(axis=1)] > 0).any()):
+            bad = np.flatnonzero(over.any(axis=1))
+            log.error(
+                "Device/host divergence: %d nodes oversubscribed; "
+                "falling back to object path this cycle", len(bad),
+            )
+            raise RuntimeError("fastpath divergence")
+
+        # Array state updates.
+        m.p_status[rows] = ST_BOUND
+        m.p_node[rows] = nodes_c
+        self.n_used = new_used
+        self.n_idle = self.n_idle - add
+        np.add.at(self.n_ntasks, nodes_c, 1)
+        self.resident[rows] = True
+
+        # Job counters (affects readiness for later rounds + close).
+        jr = self.jobr[rows]
+        np.add.at(self.j_cnt_alloc, jr, 1)
+        np.add.at(self.j_cnt_pending, jr, -1)
+        self.j_ready_base = (
+            self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_empty_pending
+        )
+        er, si, v = m.c_req.gather(rows)
+        np.add.at(self.j_alloc_res, (jr[er], si), v)
+        np.add.at(self.j_pending_res, (jr[er], si), -v)
+        # Queue allocation (overuse gating in later rounds).
+        q_of = self.q_of_job[jr]
+        qmask = q_of >= 0
+        if qmask.any():
+            er_q = qmask[er]
+            np.add.at(self.q_alloc, (q_of[er][er_q], si[er_q]), v[er_q])
+
+        # Pod records + bind dispatch (async in the reference,
+        # cache.go:536-552; here one batched dispatch).
+        binder = store.binder
+        bind_batch = getattr(binder, "bind_batch", None)
+        pods = store.pods
+        notify = store._watchers
+        pairs = []
+        n_name = m.n_name
+        for row, nrow in zip(rows.tolist(), nodes_c.tolist()):
+            uid = m.p_uid[row]
+            pod = pods.get(uid)
+            if pod is None:
+                continue
+            hostname = n_name[nrow]
+            pod.node_name = hostname
+            pairs.append((pod, hostname))
+        if bind_batch is not None:
+            bind_batch(pairs)
+        else:
+            for pod, hostname in pairs:
+                binder.bind(pod, hostname)
+        if notify:
+            for pod, _ in pairs:
+                store._notify("Pod", "bind", pod)
+
+        store.mark_objects_stale()
+        self._record_fit_failures(solve_jobs, fit_failed)
+        return True
+
+    def _record_fit_failures(self, solve_jobs: List[int],
+                             fit_failed: np.ndarray) -> None:
+        self._fit_failed_rows = getattr(self, "_fit_failed_rows", set())
+        for i, row in enumerate(solve_jobs):
+            if i < len(fit_failed) and fit_failed[i]:
+                self._fit_failed_rows.add(row)
+
+    # ------------------------------------------------------------ backfill
+
+    def _backfill(self) -> None:
+        """Place zero-request pending tasks (backfill.go:39-88)."""
+        m = self.m
+        Pn = self.Pn
+        status = m.p_status[:Pn]
+        be_rows = np.flatnonzero(
+            m.p_alive[:Pn] & (status == ST_PENDING) & m.p_be[:Pn]
+        )
+        if not len(be_rows):
+            return
+        schedulable = set(self._schedulable_rows())
+        # Node order: store insertion order (dict iteration in the object
+        # path) == mirror row order.
+        live_nodes = [i for i in range(self.Nn) if self.n_alive[i]]
+        has_pred = self._has("predicates")
+        bound_rows = []
+        for row in be_rows:
+            jrow = self.jobr[row]
+            if jrow < 0 or jrow not in schedulable:
+                continue
+            feat = m.p_feat[row]
+            placed = None
+            for ni in live_nodes:
+                if has_pred and not self._host_predicate(row, feat, ni):
+                    continue
+                placed = ni
+                break
+            if placed is not None:
+                m.p_status[row] = ST_BOUND
+                m.p_node[row] = placed
+                self.n_ntasks[placed] += 1
+                self.resident[row] = True
+                self.j_cnt_alloc[jrow] += 1
+                self.j_cnt_pending[jrow] -= 1
+                self.j_cnt_empty_pending[jrow] -= 1
+                bound_rows.append(row)
+        if bound_rows:
+            # ready_base: empty-pending shrank, alloc grew -> net unchanged;
+            # recompute for exactness.
+            self.j_ready_base = (
+                self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_empty_pending
+            )
+            store = self.store
+            binder = store.binder
+            bind_batch = getattr(binder, "bind_batch", None)
+            pairs = []
+            for row in bound_rows:
+                pod = store.pods.get(m.p_uid[row])
+                if pod is None:
+                    continue
+                hostname = m.n_name[m.p_node[row]]
+                pod.node_name = hostname
+                pairs.append((pod, hostname))
+            if bind_batch is not None:
+                bind_batch(pairs)
+            else:
+                for pod, hostname in pairs:
+                    binder.bind(pod, hostname)
+            for pod, _ in pairs:
+                if store._watchers:
+                    store._notify("Pod", "bind", pod)
+            store.mark_objects_stale()
+
+    def _host_predicate(self, row: int, feat, ni: int) -> bool:
+        """Host predicates for best-effort tasks (predicates.go:144-293,
+        minus resource fit)."""
+        m = self.m
+        if not self.n_ready[ni]:
+            return False
+        if self.n_maxtasks[ni] > 0 and self.n_ntasks[ni] >= self.n_maxtasks[ni]:
+            return False
+        node = m.node_objs[ni]
+        labels = node.labels if node is not None else {}
+        pod = self.store.pods.get(m.p_uid[row])
+        if pod is None:
+            return False
+        if pod.node_selector and not all(
+            labels.get(k) == v for k, v in pod.node_selector.items()
+        ):
+            return False
+        terms = pod.required_node_affinity
+        if terms and not any(
+            all(labels.get(k) == v for k, v in t.items()) for t in terms
+        ):
+            return False
+        for taint in (node.taints if node is not None else []):
+            if taint.effect not in ("NoSchedule", "NoExecute"):
+                continue
+            ok = False
+            for tol in pod.tolerations:
+                if tol.operator == "Exists":
+                    key_ok = tol.key == "" or tol.key == taint.key
+                else:
+                    key_ok = tol.key == taint.key and tol.value == taint.value
+                if key_ok and (tol.effect == "" or tol.effect == taint.effect):
+                    ok = True
+                    break
+            if not ok:
+                return False
+        if pod.host_ports:
+            used = set()
+            res_on_node = np.flatnonzero(
+                self.resident & (m.p_node[:self.Pn] == ni)
+            )
+            for rr in res_on_node:
+                f = m.p_feat[rr]
+                if f is not None:
+                    used.update(f.ports)
+            my = {m.ports.index.get(p) for p in pod.host_ports}
+            if used & my:
+                return False
+        return True
+
+    # --------------------------------------------------------------- close
+
+    def _close(self) -> None:
+        """Gang OnSessionClose conditions + PodGroup status write-back
+        (gang.go:140-183 + framework.go jobStatus)."""
+        m = self.m
+        store = self.store
+        fit_failed = getattr(self, "_fit_failed_rows", set())
+        unschedulable_rows = set()
+
+        if self._has("gang"):
+            unschedulable_jobs = 0
+            for row in self.session_jobs:
+                if self.j_ready_base[row] >= m.j_minav[row]:
+                    continue
+                msg = self._gang_message(row, row in fit_failed)
+                unschedulable_jobs += 1
+                unschedulable_rows.add(row)
+                pg = store.pod_groups.get(m.j_uid[row])
+                if pg is not None:
+                    conditions = [
+                        c for c in pg.status.conditions
+                        if c.type != POD_GROUP_UNSCHEDULABLE
+                    ]
+                    conditions.append(PodGroupCondition(
+                        type=POD_GROUP_UNSCHEDULABLE,
+                        status="True",
+                        transition_id=self.uid,
+                        reason="NotEnoughResources",
+                        message=msg,
+                    ))
+                    pg.status.conditions = conditions
+                metrics.unschedule_task_count.set(
+                    int(m.j_minav[row] - self.j_ready_base[row]),
+                    job_name=m.j_uid[row].split("/")[-1],
+                )
+                metrics.job_retry_counts.inc(
+                    job_name=m.j_uid[row].split("/")[-1]
+                )
+            metrics.unschedule_job_count.set(unschedulable_jobs)
+
+        # jobStatus write-back (framework.go _job_status).
+        for row in self.session_jobs:
+            pg = store.pod_groups.get(m.j_uid[row])
+            if pg is None:
+                continue
+            status = pg.status
+            running = int(self.j_cnt_run[row])
+            if running != 0 and row in unschedulable_rows:
+                status.phase = PodGroupPhase.Unknown.value
+            else:
+                allocated = int(self.j_cnt_alloc[row] + self.j_cnt_succ[row])
+                if allocated >= m.j_minav[row]:
+                    status.phase = PodGroupPhase.Running.value
+                elif status.phase != PodGroupPhase.Inqueue.value:
+                    status.phase = PodGroupPhase.Pending.value
+            status.running = running
+            status.failed = int(self.j_cnt_fail[row])
+            status.succeeded = int(self.j_cnt_succ[row])
+            store.status_updater.update_pod_group(pg)
+            if store._watchers:
+                store._notify("PodGroup", "status", pg)
+
+    def _gang_message(self, row: int, fit_failed: bool) -> str:
+        """Replicates gang.go's unschedulable message via job.fit_error()."""
+        m = self.m
+        rows = np.flatnonzero(
+            m.p_alive[:self.Pn] & (self.jobr == row)
+        )
+        reasons = {}
+        for st in m.p_status[rows]:
+            name = TaskStatus(int(st)).name
+            reasons[name] = reasons.get(name, 0) + 1
+        reasons["minAvailable"] = int(m.j_minav[row])
+        parts = sorted(f"{v} {k}" for k, v in reasons.items())
+        fit = f"pod group is not ready, {', '.join(parts)}."
+        unready = int(m.j_minav[row] - self.j_ready_base[row])
+        total = int(self.j_cnt_total[row])
+        return f"{unready}/{total} tasks in gang unschedulable: {fit}"
+
+
+def run_cycle_fast(store, conf) -> bool:
+    """Run one scheduling cycle on the fast path; False = not eligible
+    (caller should fall back to the object-session path)."""
+    cycle = FastCycle(store, conf)
+    if not cycle.eligible():
+        return False
+    with store._lock:
+        cycle.run()
+    return True

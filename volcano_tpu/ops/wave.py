@@ -774,6 +774,39 @@ def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs):
     return profiles, pid
 
 
+def _renumber_pid(pid: np.ndarray):
+    """Renumber profile ids by first occurrence; return (pid2, u_rows) where
+    u_rows[k] is the first task row of profile k."""
+    _, first_idx, inv = np.unique(pid, return_index=True, return_inverse=True)
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order))
+    return rank[inv].astype(np.int32), first_idx[order]
+
+
+def _profiles_from_pid(tasks: SolveTasks, aff: AffinityArgs,
+                       pid: np.ndarray):
+    """Build SolveProfiles from caller-supplied profile ids (the store
+    mirror interns them at pod-add time, so no per-cycle hashing)."""
+    pid, u = _renumber_pid(pid)
+    profiles = SolveProfiles(
+        req=_np(tasks.req)[u],
+        init_req=_np(tasks.init_req)[u],
+        ports=_np(tasks.ports)[u],
+        sel_bits=_np(tasks.sel_bits)[u],
+        aff_bits=_np(tasks.aff_bits)[u],
+        aff_terms=_np(tasks.aff_terms)[u],
+        tol_bits=_np(tasks.tol_bits)[u],
+        pref_bits=_np(tasks.pref_bits)[u],
+        pref_w=_np(tasks.pref_w)[u],
+        t_req_aff=_np(aff.t_req_aff)[u],
+        t_req_anti=_np(aff.t_req_anti)[u],
+        t_matches=_np(aff.t_matches)[u],
+        t_soft=_np(aff.t_soft)[u],
+    )
+    return profiles, pid
+
+
 def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
     """Per-wave profile lists as [min, min+UM) id ranges.
 
@@ -846,12 +879,15 @@ def solve_wave(
     scalar_slot,
     aff: AffinityArgs,
     wave: int = DEFAULT_WAVE,
+    pid=None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
     Pads the task axis to a multiple of ``wave`` (padded rows are inert),
     deduplicates tasks into profiles host-side, and truncates the result
-    back to the caller's task count.
+    back to the caller's task count.  ``pid`` (optional [P] int32) supplies
+    precomputed profile ids — tasks with equal ids must have identical
+    per-task solver inputs — and skips the feature-hashing pass.
     """
     P = int(_np(tasks.req).shape[0])
     wave = int(min(wave, max(1, P)))
@@ -860,7 +896,15 @@ def solve_wave(
         tasks = _pad_tasks(tasks, pad)
         aff = _pad_aff(aff, pad)
     n_waves = (P + pad) // wave
-    profiles, pid = _profile_tasks(tasks, aff)
+    if pid is not None:
+        pid = np.asarray(pid, np.int64)
+        if pad:
+            # Padded rows are all-zero features: give them a fresh profile.
+            fresh = (pid.max() + 1) if len(pid) else 0
+            pid = np.concatenate([pid, np.full(pad, fresh, np.int64)])
+        profiles, pid = _profiles_from_pid(tasks, aff, pid)
+    else:
+        profiles, pid = _profile_tasks(tasks, aff)
     wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
     features = (
         bool(_np(profiles.ports).any()),

@@ -72,12 +72,26 @@ class Scheduler:
     # ---------------------------------------------------------------- cycle
 
     def run_once(self) -> None:
-        """One scheduling cycle (scheduler.go:71-87)."""
+        """One scheduling cycle (scheduler.go:71-87).
+
+        Eligible configurations (built-in plugins, enqueue/allocate/backfill
+        actions) run on the vectorized fast path over the store's array
+        mirror; anything else uses the object-session path."""
         conf = self._load_conf()
         action_names = [
             a.strip() for a in conf.actions.split(",") if a.strip()
         ]
         with metrics.e2e_timer():
+            if self._fastpath_enabled():
+                from .fastpath import run_cycle_fast
+
+                try:
+                    if run_cycle_fast(self.store, conf):
+                        return
+                except Exception:
+                    log.exception(
+                        "Fast path failed; falling back to object session"
+                    )
             ssn = open_session(self.store, conf.tiers, conf.configurations)
             try:
                 for name in action_names:
@@ -89,6 +103,12 @@ class Scheduler:
                         action.execute(ssn)
             finally:
                 close_session(ssn)
+
+    @staticmethod
+    def _fastpath_enabled() -> bool:
+        import os
+
+        return os.environ.get("VOLCANO_TPU_FASTPATH", "1") != "0"
 
     # ----------------------------------------------------------------- loop
 
