@@ -11,10 +11,10 @@ incremental array mirror (``cache/mirror.py``):
 - aggregates (node idle/used, queue allocation, DRF shares, job readiness
   counters) are derived by ``np.add.at``/``bincount`` reductions over the
   pod table instead of object traversals;
-- job/queue/namespace orderings precompute one key tuple per job and reuse
-  the object path's exact ordering algorithm (``AllocateAction._job_order``,
-  ``allocate.go:107-153``) at job granularity, so heap tie-breaking matches
-  the object path bit-for-bit;
+- job/queue/namespace orderings precompute one key tuple per job; the
+  object path's PriorityQueue pops over total-ordered keys (unique uid
+  tie-break) reduce to sorted-list merging (``allocate.go:107-153``), so
+  the produced order matches the object path bit-for-bit;
 - the assignment matrix from the wave solver is committed in bulk: array
   scatter updates, one batched bind dispatch, and pod records mutated in
   place; the NodeInfo/JobInfo object model is marked stale and lazily
@@ -36,7 +36,6 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .actions.allocate import AllocateAction
 from .api import PodGroupCondition, PodGroupPhase, TaskStatus
 from .api.resource import (
     MIN_MEMORY,
@@ -51,7 +50,6 @@ from .framework.session import _session_counter
 from .metrics import metrics
 from .ops.allocate import SolveJobs, SolveNodes, SolveQueues, SolveTasks
 from .ops.scoring import ScoreWeights
-from .utils.priority_queue import PriorityQueue
 
 log = logging.getLogger(__name__)
 
@@ -95,6 +93,26 @@ def _pack_bits(n_rows: int, words: int, rows: np.ndarray,
             (np.uint32(1) << (bits & 31).astype(np.uint32)),
         )
     return out
+
+
+def _cmp_key(less):
+    """sorted() key from a strict less(a, b) comparator."""
+    import functools
+
+    return functools.cmp_to_key(
+        lambda a, b: -1 if less(a, b) else (1 if less(b, a) else 0)
+    )
+
+
+def _vec_le(l: np.ndarray, r: np.ndarray, eps: np.ndarray,
+            scalar_slot: np.ndarray) -> bool:
+    """Epsilon-tolerant Resource.less_equal on dense slot vectors."""
+    per = (l < r) | (np.abs(l - r) < eps) | (scalar_slot & (l <= eps))
+    return bool(per.all())
+
+
+def _vec_is_empty(v: np.ndarray, eps: np.ndarray) -> bool:
+    return bool((v < eps).all())
 
 
 class _JobProxy:
@@ -381,24 +399,28 @@ class FastCycle:
 
     # ------------------------------------------------------------ ordering
 
-    def _job_keys(self, rows: List[int], drf_share: np.ndarray) -> Dict[int, tuple]:
-        """Tier-ordered job-order key per job row (first-nonzero comparator
-        chain == lexicographic tuple compare)."""
+    def _job_keys(self, rows: List[int], drf_share: np.ndarray) -> np.ndarray:
+        """[Jn] global rank array encoding the tier-ordered job-order key
+        (first-nonzero comparator chain == lexicographic compare, reduced
+        to one np.lexsort over key columns)."""
         m = self.m
-        ready = (self.j_ready_base >= m.j_minav[:self.Jn]) if self.Jn else None
-        keys = {}
-        comps = []
+        Jn = self.Jn
+        plugin_cols = []
         for opt in self._tier_opts("enabled_job_order"):
             if opt.name == "priority":
-                comps.append(lambda r: -int(m.j_prio[r]))
+                plugin_cols.append(-m.j_prio[:Jn])
             elif opt.name == "gang":
-                comps.append(lambda r: bool(ready[r]))
+                plugin_cols.append(self.j_ready_base >= m.j_minav[:Jn])
             elif opt.name == "drf":
-                comps.append(lambda r: float(drf_share[r]))
-        for r in rows:
-            key = tuple(c(r) for c in comps)
-            keys[r] = key + (m.j_create[r], m.j_uid[r])
-        return keys
+                plugin_cols.append(drf_share[:Jn])
+        # np.lexsort: LAST key is primary -> tie-breaks first, tiers in
+        # reverse order last.
+        cols = [np.array(m.j_uid[:Jn]), m.j_create[:Jn]]
+        cols.extend(reversed(plugin_cols))
+        order = np.lexsort(tuple(cols))
+        rank = np.empty(Jn, np.int64)
+        rank[order] = np.arange(Jn)
+        return rank
 
     def _queue_order_fn(self):
         share = self.q_share
@@ -436,17 +458,26 @@ class FastCycle:
         return fn
 
     def _overused_fn(self):
+        """Memoized per-queue overuse verdicts (shares are frozen at sort
+        time, so one evaluation per queue per pass suffices)."""
         if not self._has("proportion"):
             return lambda q: False
         deserved = self.q_deserved_res
         qidx = self.queue_index
         alloc = self.q_alloc
+        cache: Dict[str, bool] = {}
 
         def fn(q) -> bool:
+            hit = cache.get(q.name)
+            if hit is not None:
+                return hit
             qi = qidx.get(q.name)
             if qi is None or qi not in deserved:
-                return False
-            return not self._res(alloc[qi]).less_equal(deserved[qi])
+                out = False
+            else:
+                out = not self._res(alloc[qi]).less_equal(deserved[qi])
+            cache[q.name] = out
+            return out
 
         return fn
 
@@ -491,7 +522,35 @@ class FastCycle:
 
     # ------------------------------------------------------------- enqueue
 
+    def _minres_vec(self, pg) -> Optional[np.ndarray]:
+        """Dense slot vector of pg.min_resources, cached on the PodGroup.
+        None when min_resources names a resource outside the slot layout
+        (caller falls back to Resource-object math)."""
+        cached = getattr(pg, "_minres_vec", None)
+        if cached is not None and cached[0] == self.R:
+            return cached[1]
+        res = Resource.from_resource_list(pg.min_resources)
+        v = np.zeros((self.R,), F)
+        v[0] = res.milli_cpu
+        v[1] = res.memory
+        if res.scalars:
+            for name, quant in res.scalars.items():
+                idx = self.m.scalar_slots.index.get(name)
+                if idx is None:
+                    return None
+                v[2 + idx] = quant
+        try:
+            pg._minres_vec = (self.R, v)
+        except Exception:
+            pass
+        return v
+
     def _enqueue(self) -> None:
+        """Gate Pending PodGroups into Inqueue (enqueue.go:52-132).
+
+        The object path's queue/job PriorityQueues have static keys during
+        enqueue, so heap pops reduce to: queues in key order, each drained
+        of its jobs in key order, with the budget checked between jobs."""
         m = self.m
         store = self.store
         args = get_action_args(self.conf.configurations, "enqueue")
@@ -500,13 +559,10 @@ class FastCycle:
         queue_order = self._queue_order_fn()
         drf_share = self._drf_shares()
         jkeys = self._job_keys(self.session_jobs, drf_share)
-        job_order = lambda l, r: jkeys[l] < jkeys[r]
 
-        queues_pq = PriorityQueue(
-            lambda l, r: queue_order(store.queues[l], store.queues[r])
-        )
-        queue_set = set()
-        jobs_map: Dict[str, PriorityQueue] = {}
+        jobs_map: Dict[str, List[int]] = {}
+        queue_seq: List[str] = []
+        seen = set()
         row_pg = {}
         for row in self.session_jobs:
             qname = m.j_queue[row]
@@ -514,55 +570,91 @@ class FastCycle:
                 log.error("Failed to find queue %s for job %s",
                           qname, m.j_uid[row])
                 continue
-            if qname not in queue_set:
-                queue_set.add(qname)
-                queues_pq.push(qname)
+            if qname not in seen:
+                seen.add(qname)
+                queue_seq.append(qname)
             pg = store.pod_groups.get(m.j_uid[row])
             row_pg[row] = pg
             if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
-                jobs_map.setdefault(qname, PriorityQueue(job_order)).push(row)
+                jobs_map.setdefault(qname, []).append(row)
+        queue_seq.sort(key=_cmp_key(
+            lambda l, r: queue_order(store.queues[l], store.queues[r])
+        ))
+        for lst in jobs_map.values():
+            lst.sort(key=lambda r: jkeys[r])
 
-        total = self._res(self.total_res)
-        used = self._res(self.n_used[self.n_alive].sum(axis=0)
-                         if self.Nn else np.zeros(self.R, F))
-        idle = total.clone().multi(factor).sub(used)
+        eps = self.eps
+        scalar_slot = self.scalar_slot
+        used_vec = (self.n_used[self.n_alive].sum(axis=0)
+                    if self.Nn else np.zeros(self.R, F))
+        idle = self.total_res * factor - used_vec
 
-        while not queues_pq.empty():
-            if idle.is_empty():
+        q_cap_vec: Dict[str, Optional[np.ndarray]] = {}
+        done = False
+        for qname in queue_seq:
+            if done:
                 break
-            qname = queues_pq.pop()
-            jobs = jobs_map.get(qname)
-            if jobs is None or jobs.empty():
-                continue
-            row = jobs.pop()
-            pg = row_pg.get(row)
-            inqueue = False
-            if pg.min_resources is None:
-                inqueue = True
-            else:
-                min_req = Resource.from_resource_list(pg.min_resources)
-                if self._job_enqueueable(row, pg) and min_req.less_equal(idle):
-                    idle.sub(min_req)
+            for row in jobs_map.get(qname, ()):
+                if _vec_is_empty(idle, eps):
+                    done = True
+                    break
+                pg = row_pg.get(row)
+                inqueue = False
+                if pg.min_resources is None:
                     inqueue = True
-            if inqueue:
-                pg.status.phase = PodGroupPhase.Inqueue.value
-            queues_pq.push(qname)
+                else:
+                    min_vec = self._minres_vec(pg)
+                    if min_vec is None:
+                        # Unknown resource name: Resource-object fallback.
+                        min_req = Resource.from_resource_list(
+                            pg.min_resources
+                        )
+                        if (
+                            self._job_enqueueable_obj(qname, pg)
+                            and min_req.less_equal(self._res(idle))
+                        ):
+                            idle = idle - self._slots_vec(min_req)
+                            inqueue = True
+                    elif (
+                        self._job_enqueueable_vec(qname, pg, min_vec,
+                                                  q_cap_vec)
+                        and _vec_le(min_vec, idle, eps, scalar_slot)
+                    ):
+                        idle = idle - min_vec
+                        inqueue = True
+                if inqueue:
+                    pg.status.phase = PodGroupPhase.Inqueue.value
 
-    def _job_enqueueable(self, row: int, pg) -> bool:
+    def _job_enqueueable_vec(self, qname: str, pg, min_vec: np.ndarray,
+                             q_cap_vec: Dict) -> bool:
         """proportion's JobEnqueueable veto (proportion.go:231-247)."""
         if not self._has("proportion"):
             return True
-        qname = self.m.j_queue[row]
         queue = self.store.queues.get(qname)
-        if queue is None:
+        if queue is None or not queue.queue.capability:
             return True
-        if not queue.queue.capability:
+        if qname not in q_cap_vec:
+            q_cap_vec[qname] = self._slots_vec(
+                Resource.from_resource_list(queue.queue.capability)
+            )
+        qi = self.queue_index.get(qname)
+        allocated = self.q_alloc[qi] if qi is not None else 0.0
+        return _vec_le(min_vec + allocated, q_cap_vec[qname],
+                       self.eps, self.scalar_slot)
+
+    def _job_enqueueable_obj(self, qname: str, pg) -> bool:
+        if not self._has("proportion"):
+            return True
+        queue = self.store.queues.get(qname)
+        if queue is None or not queue.queue.capability:
             return True
         if pg is None or pg.min_resources is None:
             return True
         min_req = Resource.from_resource_list(pg.min_resources)
         qi = self.queue_index.get(qname)
-        allocated = self._res(self.q_alloc[qi]) if qi is not None else Resource.empty()
+        allocated = (
+            self._res(self.q_alloc[qi]) if qi is not None else Resource.empty()
+        )
         return min_req.add(allocated).less_equal(
             Resource.from_resource_list(queue.queue.capability)
         )
@@ -624,29 +716,71 @@ class FastCycle:
         return rows
 
     def _ordered_jobs(self) -> List[_JobProxy]:
+        """Namespace round-robin x queue order x job order, as sorted-list
+        merging (allocate.go:107-153).
+
+        Heap pops over total-ordered keys (the uid tie-break makes every
+        comparator total) produce exactly sorted order, so the object path's
+        PriorityQueues reduce to one lexsort per queue; the queue scan
+        keeps the object path's min-by-key-ties-by-insertion rule."""
         m = self.m
         rows = self._schedulable_rows()
+        if not rows:
+            return []
         drf_share = self._drf_shares()
         jkeys = self._job_keys(rows, drf_share)
-        proxies = [
-            _JobProxy(row, m.j_uid[row], m.j_ns[row], m.j_queue[row],
-                      jkeys[row])
-            for row in rows
-        ]
         ns_share = self._ns_shares(drf_share)
+        overused = self._overused_fn()
+        queue_order = self._queue_order_fn()
+        ns_order = self._namespace_order_fn(ns_share)
 
-        class _Ctx:
-            pass
+        # Group jobs: namespace -> queue -> sorted job list (insertion order
+        # of first job appearance defines dict order, as in the object path).
+        by_ns: Dict[str, Dict[str, List[int]]] = {}
+        for row in rows:
+            by_ns.setdefault(m.j_ns[row], {}).setdefault(
+                m.j_queue[row], []
+            ).append(row)
+        for queues in by_ns.values():
+            for qname, lst in queues.items():
+                lst.sort(key=lambda r: jkeys[r])
 
-        ctx = _Ctx()
-        ctx.queues = {
-            name: self.store.queues[name] for name in self.queue_names
-        }
-        ctx.job_order_fn = lambda l, r: l.key < r.key
-        ctx.queue_order_fn = self._queue_order_fn()
-        ctx.namespace_order_fn = self._namespace_order_fn(ns_share)
-        ctx.overused = self._overused_fn()
-        return AllocateAction._job_order(None, ctx, proxies)
+        namespaces = sorted(by_ns.keys(), key=_cmp_key(ns_order))
+        qinfo = self.store.queues
+        ordered: List[_JobProxy] = []
+        ptr: Dict[Tuple[str, str], int] = {}
+        active = {ns: dict(by_ns[ns]) for ns in namespaces}
+        while active:
+            progressed = False
+            for ns in list(namespaces):
+                queues = active.get(ns)
+                if not queues:
+                    active.pop(ns, None)
+                    continue
+                best_q = None
+                for qid in list(queues.keys()):
+                    if ptr.get((ns, qid), 0) >= len(queues[qid]):
+                        del queues[qid]
+                        continue
+                    q = qinfo[qid]
+                    if overused(q):
+                        del queues[qid]
+                        continue
+                    if best_q is None or queue_order(q, qinfo[best_q]):
+                        best_q = qid
+                if best_q is None:
+                    active.pop(ns, None)
+                    continue
+                i = ptr.get((ns, best_q), 0)
+                row = by_ns[ns][best_q][i]
+                ptr[(ns, best_q)] = i + 1
+                ordered.append(_JobProxy(
+                    row, m.j_uid[row], ns, best_q, jkeys[row]
+                ))
+                progressed = True
+            if not progressed and not any(active.values()):
+                break
+        return ordered
 
     def _pending_rows(self, ordered: List[_JobProxy]):
         """Pending task rows in processing order (job-contiguous)."""

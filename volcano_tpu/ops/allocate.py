@@ -133,6 +133,7 @@ class AllocResult(NamedTuple):
     fit_failed: jnp.ndarray  # [J] bool
     idle: jnp.ndarray  # [N, R] final idle
     q_alloc: jnp.ndarray  # [Q, R] final queue allocated (incl. pipelines)
+    iters: jnp.ndarray = None  # [] total attempt iterations (diagnostics)
 
 
 def _subset(bits_row, table):

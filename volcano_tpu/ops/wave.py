@@ -84,8 +84,9 @@ from .allocate import (
 from .resreq import less_equal
 from .scoring import ScoreWeights, node_score
 
-DEFAULT_WAVE = 4096
+DEFAULT_WAVE = 1024
 TOPK = 256  # diversification breadth: k-th contender takes its k-th best node
+SUBROUNDS = 16  # in-attempt re-walk rounds for conflict losers
 
 
 class SolveProfiles(NamedTuple):
@@ -127,6 +128,7 @@ class GState(NamedTuple):
     job_overskip: jnp.ndarray  # [J] bool (skipped for overuse only)
     assigned: jnp.ndarray  # [P] int32
     pipelined: jnp.ndarray  # [P] int32
+    iters: jnp.ndarray  # [] int32 total attempt iterations
 
 
 def _unpack_bits(words):
@@ -232,6 +234,7 @@ def _solve_wave(
         job_overskip=jnp.zeros((JP,), bool),
         assigned=jnp.full((P,), -1, jnp.int32),
         pipelined=jnp.full((P,), -1, jnp.int32),
+        iters=jnp.int32(0),
     )
 
     tril = jnp.tril(jnp.ones((W, W), bool), k=-1)  # strictly-earlier mask
@@ -421,205 +424,295 @@ def _solve_wave(
             )
             no_node = cand & ~any_feasible
 
-            # ---- capacity walk down the ranked list ------------------------
-            # Live capacity (copies of the profile) at each ranked node.
-            feas_k = jnp.take_along_axis(p_feasible, ranked, axis=1)
-            walk_k = walk_idle[ranked]  # [UM, K, R] small gather
-            per = jnp.where(
-                p_req_pos[:, None, :],
-                walk_k / jnp.maximum(p_req[:, None, :], 1e-9),
-                jnp.inf,
-            )
-            c_res = jnp.clip(jnp.min(per, axis=-1), 0.0, BIG)
-            nt_k = (s.ntasks + s.pip_ntasks)[ranked]
-            mt_k = nodes.max_tasks[ranked]
-            c_pods = jnp.where(
-                mt_k > 0, (mt_k - nt_k).astype(f32), BIG
-            )
-            c = jnp.where(
-                feas_k, jnp.minimum(jnp.floor(c_res), c_pods), 0.0
-            )
-            cumcap = jnp.cumsum(c, axis=1)  # [UM, K]
-
-            # m = my rank among this attempt's candidates of my profile.
-            m = jnp.sum(same_pid & tril & cand[None, :], axis=1).astype(f32)
-            rows_cc = jnp.matmul(onehot_u, cumcap)  # [W, K]
-            j = jnp.sum(
-                (rows_cc <= m[:, None]).astype(jnp.int32), axis=1
-            )
-            overflow = cand & any_feasible & (j >= K)
-            j = jnp.clip(j, 0, K - 1)
-            rows_rk = jnp.matmul(onehot_u, ranked.astype(f32))  # [W, K]
-            j1h = (j[:, None] == jnp.arange(K)[None, :]).astype(f32)
-            choice = jnp.round(jnp.sum(rows_rk * j1h, axis=1)).astype(
-                jnp.int32
-            )
-            choice = jnp.clip(choice, 0, N - 1)
-
             # Abort-in-order: a no-node task masks later tasks of its job
             # from this attempt's acceptance (allocate.go:189-193).
             same_job = jw[:, None] == jw[None, :]
             aborted = jnp.any(same_job & tril & no_node[None, :], axis=1)
-            live = cand & any_feasible & ~aborted & ~overflow
 
-            # ---- prefix acceptance in task order ---------------------------
-            same_node = (choice[:, None] == choice[None, :]) & tril
-            pre = (same_node & live[None, :]).astype(f32)
-            cum_req = jnp.matmul(pre, req_w)  # [W, R]
-            cum_cnt = jnp.sum(pre, axis=1).astype(jnp.int32)
+            # Hoisted per-attempt constants for the sub-round loop.
+            feas_k = jnp.take_along_axis(p_feasible, ranked, axis=1)
+            mt_k = nodes.max_tasks[ranked]
+            rows_rk = jnp.matmul(onehot_u, ranked.astype(f32))  # [W, K]
 
-            # One fused node gather for every per-choice read.
-            cols = [s.idle, (s.ntasks + s.pip_ntasks)[:, None].astype(f32),
-                    nodes.max_tasks[:, None].astype(f32)]
-            if has_future:
-                cols.append(future_idle)
-            g = jnp.concatenate(cols, axis=1)[choice]  # [W, C]
-            idle_c = g[:, :R]
-            ntasks_c = jnp.round(g[:, R]).astype(jnp.int32)
-            maxt_c = jnp.round(g[:, R + 1]).astype(jnp.int32)
-
-            fits_idle = less_equal(
-                init_req_w + cum_req, idle_c, eps, scalar_slot
-            )
-            tot_c = ntasks_c + cum_cnt
-            pods_fit = (maxt_c <= 0) | (tot_c < maxt_c)
-            clean = live & pods_fit
-            if has_ports:
-                # Port clash against earlier same-node accepted tasks.
-                pair_port = jnp.matmul(
-                    ports_w.astype(f32), ports_w.astype(f32).T
-                )
-                port_conf = jnp.any(
-                    same_node & live[None, :] & (pair_port > 0), axis=1
-                )
-                clean &= ~port_conf
+            # Contention groups: profiles whose rankings share most of
+            # their top nodes compete for the same capacity; rank their
+            # candidates jointly so the combined demand spreads over
+            # enough nodes in one pass instead of one profile per
+            # sub-round.  (Profiles with disjoint rankings keep
+            # per-profile ranks — joint ranking would over-spread them.)
+            TOPOV = min(16, K)
+            top = ranked[:, :TOPOV]  # [UM, TOPOV]
+            ov = jnp.sum(
+                (top[:, None, :, None] == top[None, :, None, :]),
+                axis=(-1, -2),
+            )  # [UM, UM] shared-top-node counts
+            grp = ov >= (TOPOV + 1) // 2
+            grp_pair = (
+                jnp.matmul(
+                    jnp.matmul(onehot_u, grp.astype(f32)), onehot_u.T
+                ) > 0
+            )  # [W, W] same-contention-group mask
             if has_aff:
-                # Same-domain affinity interaction with earlier wave tasks:
-                # conservative — any shared term in the same topology
-                # domain sends the later task to the next attempt.
-                dw = node_dom_t[choice]  # [W, E]
                 p_involved = p_t_req_aff | p_t_req_anti | (
                     jnp.abs(p_t_soft) > 0
                 )
-                involved = p_involved[pid_l] & (dw >= 0)  # [W, E]
-                gives = t_matches_w & (dw >= 0)
-                if E * W * W <= (1 << 27):
-                    hit = (
-                        involved[:, None, :] & gives[None, :, :]
-                        & (dw[:, None, :] == dw[None, :, :])
+                task_has_aff = jnp.any(p_involved[pid_l], axis=1)  # [W]
+
+            # ---- sub-rounds: rejected tasks re-walk against live capacity
+            # within the attempt, reusing this attempt's feasibility and
+            # ranking.  Capacity counts (c) and the fit checks always read
+            # the LIVE state, so acceptance stays exact; only the node
+            # *steering* uses attempt-start scores (the steering is already
+            # a documented heuristic).  This collapses the cross-profile
+            # conflict retries that previously cost one full attempt
+            # (predicates + scoring + ranking) each.  Tasks with inter-pod
+            # affinity terms only resolve in the first sub-round: their
+            # feasibility depends on count state that live_parts refreshes
+            # per attempt.
+            def sub_cond(sc):
+                (_s, done_sub, _al, _aw, _pw, si, progressed) = sc
+                return progressed & (si < SUBROUNDS) & jnp.any(
+                    cand & ~done_sub & ~aborted
+                )
+
+            def sub_body(sc):
+                (s_, done_sub, alloc_l_, assigned_w_, pipelined_w_, si,
+                 _progressed) = sc
+                cand_s = cand & ~done_sub & ~aborted
+                if has_aff:
+                    cand_s &= (si == 0) | ~task_has_aff
+
+                # Live capacity walk (copies of the profile per ranked node).
+                if has_future:
+                    walk_idle_ = (
+                        s_.idle + nodes.releasing - nodes.pipelined
+                        - s_.pip_extra
                     )
-                    aff_pair = jnp.any(hit, axis=-1)
                 else:
-                    # Large term tables: chunk the E axis to bound the
-                    # [W, W, C] intermediate.
-                    C = max(1, (1 << 27) // (W * W))
-                    EC = (E + C - 1) // C
-                    e_pad = EC * C - E
-                    inv_p = jnp.pad(involved, ((0, 0), (0, e_pad)))
-                    giv_p = jnp.pad(gives, ((0, 0), (0, e_pad)))
-                    dw_p = jnp.pad(
-                        dw, ((0, 0), (0, e_pad)), constant_values=-1
-                    )
+                    walk_idle_ = s_.idle
+                walk_k = walk_idle_[ranked]  # [UM, K, R] small gather
+                per = jnp.where(
+                    p_req_pos[:, None, :],
+                    walk_k / jnp.maximum(p_req[:, None, :], 1e-9),
+                    jnp.inf,
+                )
+                c_res = jnp.clip(jnp.min(per, axis=-1), 0.0, BIG)
+                nt_k = (s_.ntasks + s_.pip_ntasks)[ranked]
+                c_pods = jnp.where(
+                    mt_k > 0, (mt_k - nt_k).astype(f32), BIG
+                )
+                c = jnp.where(
+                    feas_k, jnp.minimum(jnp.floor(c_res), c_pods), 0.0
+                )
+                cumcap = jnp.cumsum(c, axis=1)  # [UM, K]
 
-                    def chunk_body(ci, acc):
-                        lo = ci * C
-                        inv_c = jax.lax.dynamic_slice_in_dim(
-                            inv_p, lo, C, 1
-                        )
-                        giv_c = jax.lax.dynamic_slice_in_dim(
-                            giv_p, lo, C, 1
-                        )
-                        dw_c = jax.lax.dynamic_slice_in_dim(dw_p, lo, C, 1)
+                # m = my rank among the remaining candidates of my
+                # contention group (>= my profile's own candidates).
+                m = jnp.sum(
+                    grp_pair & tril & cand_s[None, :], axis=1
+                ).astype(f32)
+                rows_cc = jnp.matmul(onehot_u, cumcap)  # [W, K]
+                j = jnp.sum(
+                    (rows_cc <= m[:, None]).astype(jnp.int32), axis=1
+                )
+                overflow = cand_s & any_feasible & (j >= K)
+                j = jnp.clip(j, 0, K - 1)
+                j1h = (j[:, None] == jnp.arange(K)[None, :]).astype(f32)
+                choice = jnp.round(jnp.sum(rows_rk * j1h, axis=1)).astype(
+                    jnp.int32
+                )
+                choice = jnp.clip(choice, 0, N - 1)
+                live = cand_s & any_feasible & ~overflow
+
+                # ---- prefix acceptance in task order -----------------------
+                same_node = (choice[:, None] == choice[None, :]) & tril
+                pre = (same_node & live[None, :]).astype(f32)
+                cum_req = jnp.matmul(pre, req_w)  # [W, R]
+                cum_cnt = jnp.sum(pre, axis=1).astype(jnp.int32)
+
+                # One fused node gather for every per-choice read.
+                cols = [
+                    s_.idle,
+                    (s_.ntasks + s_.pip_ntasks)[:, None].astype(f32),
+                    nodes.max_tasks[:, None].astype(f32),
+                ]
+                if has_future:
+                    cols.append(
+                        s_.idle + nodes.releasing - nodes.pipelined
+                        - s_.pip_extra
+                    )
+                g = jnp.concatenate(cols, axis=1)[choice]  # [W, C]
+                idle_c = g[:, :R]
+                ntasks_c = jnp.round(g[:, R]).astype(jnp.int32)
+                maxt_c = jnp.round(g[:, R + 1]).astype(jnp.int32)
+
+                fits_idle = less_equal(
+                    init_req_w + cum_req, idle_c, eps, scalar_slot
+                )
+                tot_c = ntasks_c + cum_cnt
+                pods_fit = (maxt_c <= 0) | (tot_c < maxt_c)
+                clean = live & pods_fit
+                if has_ports:
+                    # Pair clash within this sub-round + live clash against
+                    # everything already applied to the state.
+                    pair_port = jnp.matmul(
+                        ports_w.astype(f32), ports_w.astype(f32).T
+                    )
+                    port_conf = jnp.any(
+                        same_node & live[None, :] & (pair_port > 0), axis=1
+                    )
+                    used_bits_c = (
+                        s_.nport_bits | s_.pip_nport_bits
+                    )[choice]  # [W, B]
+                    port_live = jnp.any(ports_w & used_bits_c, axis=1)
+                    clean &= ~port_conf & ~port_live
+                if has_aff:
+                    # Same-domain affinity interaction with earlier wave
+                    # tasks: conservative — any shared term in the same
+                    # topology domain sends the later task to the next
+                    # attempt.
+                    dw = node_dom_t[choice]  # [W, E]
+                    involved = p_involved[pid_l] & (dw >= 0)  # [W, E]
+                    gives = t_matches_w & (dw >= 0)
+                    if E * W * W <= (1 << 27):
                         hit = (
-                            inv_c[:, None, :] & giv_c[None, :, :]
-                            & (dw_c[:, None, :] == dw_c[None, :, :])
-                            & (dw_c[None, :, :] >= 0)
+                            involved[:, None, :] & gives[None, :, :]
+                            & (dw[:, None, :] == dw[None, :, :])
                         )
-                        return acc | jnp.any(hit, axis=-1)
+                        aff_pair = jnp.any(hit, axis=-1)
+                    else:
+                        # Large term tables: chunk the E axis to bound the
+                        # [W, W, C] intermediate.
+                        C = max(1, (1 << 27) // (W * W))
+                        EC = (E + C - 1) // C
+                        e_pad = EC * C - E
+                        inv_p = jnp.pad(involved, ((0, 0), (0, e_pad)))
+                        giv_p = jnp.pad(gives, ((0, 0), (0, e_pad)))
+                        dw_p = jnp.pad(
+                            dw, ((0, 0), (0, e_pad)), constant_values=-1
+                        )
 
-                    aff_pair = jax.lax.fori_loop(
-                        0, EC, chunk_body, jnp.zeros((W, W), bool)
+                        def chunk_body(ci, acc):
+                            lo = ci * C
+                            inv_c = jax.lax.dynamic_slice_in_dim(
+                                inv_p, lo, C, 1
+                            )
+                            giv_c = jax.lax.dynamic_slice_in_dim(
+                                giv_p, lo, C, 1
+                            )
+                            dw_c = jax.lax.dynamic_slice_in_dim(
+                                dw_p, lo, C, 1
+                            )
+                            hit = (
+                                inv_c[:, None, :] & giv_c[None, :, :]
+                                & (dw_c[:, None, :] == dw_c[None, :, :])
+                                & (dw_c[None, :, :] >= 0)
+                            )
+                            return acc | jnp.any(hit, axis=-1)
+
+                        aff_pair = jax.lax.fori_loop(
+                            0, EC, chunk_body, jnp.zeros((W, W), bool)
+                        )
+                    aff_conf = jnp.any(
+                        tril & live[None, :] & aff_pair, axis=1
                     )
-                aff_conf = jnp.any(
-                    tril & live[None, :] & aff_pair, axis=1
-                )
-                clean &= ~aff_conf
+                    clean &= ~aff_conf
 
-            acc_alloc = clean & fits_idle
-            if has_future:
-                fut_c = g[:, R + 2:2 * R + 2]
-                fits_fut = less_equal(
-                    init_req_w + cum_req, fut_c, eps, scalar_slot
-                )
-                acc_pipe = clean & ~fits_idle & fits_fut
-            else:
-                acc_pipe = jnp.zeros_like(acc_alloc)
+                acc_alloc = clean & fits_idle
+                if has_future:
+                    fut_c = g[:, R + 2:2 * R + 2]
+                    fits_fut = less_equal(
+                        init_req_w + cum_req, fut_c, eps, scalar_slot
+                    )
+                    acc_pipe = clean & ~fits_idle & fits_fut
+                else:
+                    acc_pipe = jnp.zeros_like(acc_alloc)
 
-            # ---- apply ------------------------------------------------------
-            radd = req_w * acc_alloc[:, None]
-            s = s._replace(
-                idle=s.idle.at[choice].add(-radd),
-                ntasks=s.ntasks.at[choice].add(acc_alloc.astype(jnp.int32)),
-                q_alloc=s.q_alloc + jnp.matmul(onehot_jq.T, radd),
-            )
-            if has_future:
-                padd = req_w * acc_pipe[:, None]
-                s = s._replace(
-                    pip_extra=s.pip_extra.at[choice].add(padd),
-                    pip_ntasks=s.pip_ntasks.at[choice].add(
-                        acc_pipe.astype(jnp.int32)
+                # ---- apply --------------------------------------------------
+                radd = req_w * acc_alloc[:, None]
+                s_ = s_._replace(
+                    idle=s_.idle.at[choice].add(-radd),
+                    ntasks=s_.ntasks.at[choice].add(
+                        acc_alloc.astype(jnp.int32)
                     ),
-                    q_pip=s.q_pip + jnp.matmul(onehot_jq.T, padd),
-                )
-            if has_ports:
-                s = s._replace(
-                    nport_bits=s.nport_bits.at[choice].max(
-                        ports_w & acc_alloc[:, None]
-                    )
+                    q_alloc=s_.q_alloc + jnp.matmul(onehot_jq.T, radd),
                 )
                 if has_future:
-                    s = s._replace(
-                        pip_nport_bits=s.pip_nport_bits.at[choice].max(
-                            ports_w & acc_pipe[:, None]
+                    padd = req_w * acc_pipe[:, None]
+                    s_ = s_._replace(
+                        pip_extra=s_.pip_extra.at[choice].add(padd),
+                        pip_ntasks=s_.pip_ntasks.at[choice].add(
+                            acc_pipe.astype(jnp.int32)
+                        ),
+                        q_pip=s_.q_pip + jnp.matmul(onehot_jq.T, padd),
+                    )
+                if has_ports:
+                    s_ = s_._replace(
+                        nport_bits=s_.nport_bits.at[choice].max(
+                            ports_w & acc_alloc[:, None]
                         )
                     )
-            if has_aff:
-                flat_dom = term_arange[None, :] * D + jnp.maximum(dw, 0)
-                inc_base = t_matches_w & (dw >= 0)
-                cnt_alloc = (
-                    s.cnt_alloc.reshape(-1)
-                    .at[flat_dom.reshape(-1)]
-                    .add(
-                        (inc_base & acc_alloc[:, None])
-                        .astype(jnp.int32).reshape(-1)
-                    )
-                    .reshape(E, D)
-                )
-                s = s._replace(cnt_alloc=cnt_alloc)
-                if has_future:
-                    cnt_pip = (
-                        s.cnt_pip.reshape(-1)
+                    if has_future:
+                        s_ = s_._replace(
+                            pip_nport_bits=s_.pip_nport_bits.at[choice].max(
+                                ports_w & acc_pipe[:, None]
+                            )
+                        )
+                if has_aff:
+                    flat_dom = term_arange[None, :] * D + jnp.maximum(dw, 0)
+                    inc_base = t_matches_w & (dw >= 0)
+                    cnt_alloc = (
+                        s_.cnt_alloc.reshape(-1)
                         .at[flat_dom.reshape(-1)]
                         .add(
-                            (inc_base & acc_pipe[:, None])
+                            (inc_base & acc_alloc[:, None])
                             .astype(jnp.int32).reshape(-1)
                         )
                         .reshape(E, D)
                     )
-                    s = s._replace(cnt_pip=cnt_pip)
+                    s_ = s_._replace(cnt_alloc=cnt_alloc)
+                    if has_future:
+                        cnt_pip = (
+                            s_.cnt_pip.reshape(-1)
+                            .at[flat_dom.reshape(-1)]
+                            .add(
+                                (inc_base & acc_pipe[:, None])
+                                .astype(jnp.int32).reshape(-1)
+                            )
+                            .reshape(E, D)
+                        )
+                        s_ = s_._replace(cnt_pip=cnt_pip)
 
-            # Job-local bookkeeping as one [W, W] matmul.
-            jupd = jnp.matmul(
-                onehot_j.T,
-                jnp.stack([acc_alloc, no_node], axis=1).astype(f32),
-            )  # [W_job, 2]
-            alloc_l = alloc_l + jnp.round(jupd[:, 0]).astype(jnp.int32)
-            fitf_l = fitf_l | (jupd[:, 1] > 0)
-            skip_l = skip_l | (jupd[:, 1] > 0)
+                alloc_l_ = alloc_l_ + jnp.round(
+                    jnp.matmul(
+                        onehot_j.T, acc_alloc.astype(f32)[:, None]
+                    )[:, 0]
+                ).astype(jnp.int32)
+                assigned_w_ = jnp.where(acc_alloc, choice, assigned_w_)
+                pipelined_w_ = jnp.where(acc_pipe, choice, pipelined_w_)
+                resolved = acc_alloc | acc_pipe
+                return (
+                    s_, done_sub | resolved, alloc_l_, assigned_w_,
+                    pipelined_w_, si + 1, jnp.any(resolved),
+                )
 
-            assigned_w = jnp.where(acc_alloc, choice, assigned_w)
-            pipelined_w = jnp.where(acc_pipe, choice, pipelined_w)
-            new_done = acc_alloc | acc_pipe | no_node
+            (s, done_sub, alloc_l, assigned_w, pipelined_w, subs,
+             _prog) = jax.lax.while_loop(
+                sub_cond, sub_body,
+                (s, done, alloc_l, assigned_w, pipelined_w, jnp.int32(0),
+                 jnp.bool_(True)),
+            )
+
+            # Attempt-level job bookkeeping for fit failures.
+            fit_upd = (
+                jnp.matmul(
+                    onehot_j.T, no_node.astype(f32)[:, None]
+                )[:, 0] > 0
+            )
+            fitf_l = fitf_l | fit_upd
+            skip_l = skip_l | fit_upd
+
+            new_done = done_sub | no_node
             stalled = ~jnp.any(new_done & ~done) & jnp.all(
                 skip_l == skip_l0
             )
@@ -627,7 +720,7 @@ def _solve_wave(
 
             return (
                 s, done, alloc_l, fitf_l, skip_l, over_l,
-                assigned_w, pipelined_w, it + 1, stalled,
+                assigned_w, pipelined_w, it + jnp.maximum(subs, 1), stalled,
             )
 
         init = (
@@ -650,6 +743,7 @@ def _solve_wave(
         jupd_back = lambda g, l: jax.lax.dynamic_update_slice_in_dim(
             g, l, jlo, axis=0
         )
+        s = s._replace(iters=s.iters + _it)
         return s._replace(
             alloc_cnt=jupd_back(s.alloc_cnt, alloc_l),
             fit_failed=jupd_back(s.fit_failed, fitf_l),
@@ -684,6 +778,7 @@ def _solve_wave(
         fit_failed=state.fit_failed[:J],
         idle=idle,
         q_alloc=q_alloc + state.q_pip,
+        iters=state.iters,
     )
 
 
