@@ -1,0 +1,101 @@
+"""Leader election (ha.py) and store checkpoint/restore (persistence.py)."""
+
+import threading
+import time
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PriorityClass,
+    Queue,
+)
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.ha import LeaderElector
+from volcano_tpu.persistence import load_store, save_store
+from volcano_tpu.scheduler import Scheduler
+
+
+def _populated_store():
+    store = ClusterStore()
+    store.add_node(Node(name="n0", allocatable={"cpu": "8", "memory": "16Gi"}))
+    store.add_node(Node(name="n1", allocatable={"cpu": "8", "memory": "16Gi"},
+                        labels={"zone": "z1"}))
+    store.add_queue(Queue(name="gold", weight=4))
+    store.add_priority_class(PriorityClass(name="high", value=100))
+    store.add_pod_group(PodGroup(name="pg", min_member=2, queue="gold",
+                                 priority_class="high"))
+    for i in range(2):
+        store.add_pod(Pod(
+            name=f"p{i}", containers=[{"cpu": "1", "memory": "1Gi"}],
+            annotations={GROUP_NAME_ANNOTATION: "pg"},
+        ))
+    return store
+
+
+def test_checkpoint_roundtrip_schedules_identically(tmp_path):
+    path = str(tmp_path / "state.ckpt")
+    a = _populated_store()
+    save_store(a, path)
+    b = load_store(path)
+    assert set(b.pods) == set(a.pods)
+    assert set(b.pod_groups) == set(a.pod_groups)
+    assert set(b.raw_queues) == set(a.raw_queues)
+    assert b.jobs["default/pg"].priority == 100
+    Scheduler(a).run_once()
+    Scheduler(b).run_once()
+    assert b.binder.binds == a.binder.binds
+
+
+def test_checkpoint_after_scheduling(tmp_path):
+    """Bound state survives save/load (pods keep node_name)."""
+    path = str(tmp_path / "state.ckpt")
+    a = _populated_store()
+    Scheduler(a).run_once()
+    assert len(a.binder.binds) == 2
+    save_store(a, path)
+    b = load_store(path)
+    bound = [p for p in b.pods.values() if p.node_name]
+    assert len(bound) == 2
+    # A new cycle finds nothing pending.
+    Scheduler(b).run_once()
+    assert len(b.binder.binds) == 0  # fresh FakeBinder, nothing re-bound
+
+
+def test_leader_election_single_holder(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = LeaderElector(lease, identity="a", lease_duration=0.5,
+                      renew_deadline=0.3, retry_period=0.05)
+    b = LeaderElector(lease, identity="b", lease_duration=0.5,
+                      renew_deadline=0.3, retry_period=0.05)
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.renew()
+    # Expired lease transfers.
+    time.sleep(0.6)
+    assert b.try_acquire()
+    assert not a.renew()
+
+
+def test_leader_election_failover(tmp_path):
+    lease = str(tmp_path / "lease")
+    events = []
+    a = LeaderElector(lease, identity="a", lease_duration=0.4,
+                      renew_deadline=0.2, retry_period=0.05)
+    b = LeaderElector(lease, identity="b", lease_duration=0.4,
+                      renew_deadline=0.2, retry_period=0.05)
+    tb = threading.Thread(
+        target=lambda: b.run(lambda: events.append("b-lead"),
+                             lambda: events.append("b-stop"), once=True),
+        daemon=True,
+    )
+    assert a.try_acquire()
+    tb.start()
+    time.sleep(0.3)
+    assert not b.is_leader  # a holds
+    a.stop()  # releases the lease
+    time.sleep(0.5)
+    assert "b-lead" in events
+    b.stop()
+    tb.join(timeout=2)

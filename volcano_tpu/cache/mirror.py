@@ -147,9 +147,8 @@ class _PodFeat:
 
     req: Tuple[list, list]  # (slot idxs, values)
     init_req: Tuple[list, list]
-    sel: List[int]  # label-pair idxs (node selector + labels interned)
-    own_labels: List[int]  # pod's own label-pair idxs
-    tol: List[int]  # tolerated taint idxs
+    sel: List[int]  # queried label-pair idxs (node selector)
+    tol: List[int]  # toleration specs (matched lazily per cycle)
     ports: List[int]  # port idxs
     aff_alts: List[List[int]]  # required node-affinity alternatives
     pref: List[Tuple[List[int], float]]  # preferred node affinity
@@ -169,7 +168,12 @@ class StoreMirror:
     def __init__(self):
         # -------- dictionaries (append-only; shared across the store life)
         self.scalar_slots = Interner()  # scalar resource name -> slot-2
-        self.labels = Interner()  # (k, v) pairs
+        # Label bitset space: ONLY label pairs that appear in a selector /
+        # node-affinity term occupy bits — a pod's own labels never enter
+        # (they only matter for inter-pod term membership, matched against
+        # raw dicts).  Without this split, per-job app labels would blow
+        # the [N, LW]/[P, LW] bitset tables up quadratically at scale.
+        self.labels = Interner()  # QUERIED (k, v) pairs
         self.taints = Interner()  # (key, value, effect)
         self.ports = Interner()  # port number
         self.terms = Interner()  # inter-pod term key
@@ -177,7 +181,15 @@ class StoreMirror:
         self.topo_keys = Interner()  # topology key -> column
         # Term membership: per term, a growing list of pod rows whose labels
         # match the term (resident counting + t_matches are derived).
+        # Inverted indexes keep maintenance O(1)-ish per pod/term instead
+        # of O(pods x terms): candidate terms for a pod come from its label
+        # pairs / job id; candidate pods for a new term come from the
+        # pair->rows index.
         self.term_members: List[List[int]] = []
+        self._terms_by_pair: Dict[Tuple[str, str], List[int]] = {}
+        self._terms_by_job: Dict[str, List[int]] = {}
+        self._terms_all: List[int] = []  # empty-selector terms
+        self._pods_by_pair: Dict[Tuple[str, str], List[int]] = {}
         # Task profiles: pods with identical solver-relevant features share
         # a profile id, interned once at add time (replaces the wave
         # solver's per-cycle feature hashing).  The key deliberately
@@ -277,8 +289,7 @@ class StoreMirror:
                         vals.append(quant)
             return slots, vals
 
-        sel = [self.labels.intern(kv) for kv in pod.node_selector.items()]
-        own = [self.labels.intern(kv) for kv in pod.labels.items()]
+        sel = [self._intern_queried(kv) for kv in pod.node_selector.items()]
         tol = []
         for t in pod.tolerations:
             # A toleration row gates taints; intern every (key,value,effect)
@@ -287,11 +298,11 @@ class StoreMirror:
             tol.append(t)
         ports = [self.ports.intern(p) for p in pod.host_ports]
         aff_alts = [
-            [self.labels.intern(kv) for kv in alt.items()]
+            [self._intern_queried(kv) for kv in alt.items()]
             for alt in pod.required_node_affinity
         ]
         pref = [
-            ([self.labels.intern(kv) for kv in sel_d.items()], float(w))
+            ([self._intern_queried(kv) for kv in sel_d.items()], float(w))
             for sel_d, w in pod.preferred_node_affinity
         ]
 
@@ -313,7 +324,6 @@ class StoreMirror:
             req=req_pair,
             init_req=init_pair,
             sel=sel,
-            own_labels=own,
             tol=tol,
             ports=ports,
             aff_alts=aff_alts,
@@ -350,6 +360,22 @@ class StoreMirror:
             pass
         return feat
 
+    def _intern_queried(self, kv: Tuple[str, str]) -> int:
+        """Intern a selector-queried label pair; nodes carrying a newly
+        queried pair are re-encoded so their bitset row gains the bit."""
+        before = len(self.labels)
+        idx = self.labels.intern(kv)
+        if len(self.labels) != before:
+            k, v = kv
+            for row, node in enumerate(self.node_objs):
+                if (
+                    node is not None
+                    and self.n_alive[row]
+                    and node.labels.get(k) == v
+                ):
+                    self.upsert_node(node)
+        return idx
+
     def _intern_term(self, term, task_ns: str) -> int:
         ns = tuple(sorted(term.namespaces)) if term.namespaces else (task_ns,)
         key = (tuple(sorted(term.match_labels.items())), term.topology_key, ns)
@@ -357,9 +383,14 @@ class StoreMirror:
         e = self.terms.intern(key)
         if len(self.terms) != before:
             self.topo_keys.intern(term.topology_key)
-            self.term_info.append((dict(term.match_labels),
-                                   term.topology_key, set(ns)))
+            sel = dict(term.match_labels)
+            self.term_info.append((sel, term.topology_key, set(ns)))
             self.term_members.append([])
+            if sel:
+                for kv in sel.items():
+                    self._terms_by_pair.setdefault(kv, []).append(e)
+            else:
+                self._terms_all.append(e)
             self._backfill_term(e)
             self._node_dom_dirty = True
         return e
@@ -372,6 +403,7 @@ class StoreMirror:
             self.topo_keys.intern(topo_key)
             self.term_info.append(({JOB_SELECTOR: job_id}, topo_key, None))
             self.term_members.append([])
+            self._terms_by_job.setdefault(job_id, []).append(e)
             self._backfill_term(e)
             self._node_dom_dirty = True
         return e
@@ -386,12 +418,34 @@ class StoreMirror:
         return all(labels.get(k) == v for k, v in sel.items())
 
     def _backfill_term(self, e: int) -> None:
-        """A new term must learn which existing pods match it."""
+        """A new term must learn which existing pods match it — resolved
+        from the inverted indexes, not a full pod scan."""
         members = self.term_members[e]
-        for row, uid in enumerate(self.p_uid):
-            if uid is None or not self.p_alive[row]:
+        sel, _key, _ns = self.term_info[e]
+        if JOB_SELECTOR in sel:
+            jrow = self.j_row.get(sel[JOB_SELECTOR])
+            if jrow is None:
+                return
+            rows = np.flatnonzero(
+                (self.p_job[:len(self.p_uid)] == jrow)
+                & self.p_alive[:len(self.p_uid)]
+            )
+            members.extend(int(r) for r in rows)
+            return
+        if sel:
+            # Candidates: rows carrying the rarest selector pair.
+            lists = [self._pods_by_pair.get(kv, []) for kv in sel.items()]
+            candidates = min(lists, key=len)
+        else:
+            candidates = [
+                r for r in range(len(self.p_uid)) if self.p_alive[r]
+            ]
+        pods = self._pods_ref or {}
+        for row in candidates:
+            if not self.p_alive[row]:
                 continue
-            pod = self._pods_ref.get(uid) if self._pods_ref else None
+            uid = self.p_uid[row]
+            pod = pods.get(uid) if uid else None
             if pod is None:
                 continue
             jrow = self.p_job[row]
@@ -486,10 +540,17 @@ class StoreMirror:
             self.c_ip_soft.append(si, sv)
         else:
             self.c_ip_soft.append([], [])
-        # Term membership of this pod's own labels.
+        # Inverted index + term membership via candidate lookup.
+        for kv in pod.labels.items():
+            self._pods_by_pair.setdefault(kv, []).append(row)
         if len(self.terms):
             juid = jid or ""
-            for e in range(len(self.terms)):
+            cand: set = set(self._terms_all)
+            if juid:
+                cand.update(self._terms_by_job.get(juid, ()))
+            for kv in pod.labels.items():
+                cand.update(self._terms_by_pair.get(kv, ()))
+            for e in cand:
                 if self._term_matches(e, pod.namespace, pod.labels, juid):
                     self.term_members[e].append(row)
 
@@ -536,7 +597,12 @@ class StoreMirror:
                 if quant:
                     slots.append(2 + self.scalar_slots.intern(name))
                     vals.append(quant)
-        labels = [self.labels.intern(kv) for kv in node.labels.items()]
+        # Only queried pairs occupy bitset space; a node label pair that no
+        # selector has ever referenced carries no bit.
+        lbl_index = self.labels.index
+        labels = [
+            lbl_index[kv] for kv in node.labels.items() if kv in lbl_index
+        ]
         taints = [
             self.taints.intern((t.key, t.value, t.effect))
             for t in node.taints
@@ -658,6 +724,7 @@ class StoreMirror:
         # Dictionaries and node/job tables carry over untouched.
         for attr in ("scalar_slots", "labels", "taints", "ports", "terms",
                      "term_info", "topo_keys", "profiles",
+                     "_terms_by_pair", "_terms_by_job", "_terms_all",
                      "n_name", "n_row", "n_ready",
                      "n_alive", "n_maxtasks", "c_n_alloc", "c_n_labels",
                      "c_n_taints", "node_objs", "domains", "j_uid", "j_row",
@@ -718,6 +785,10 @@ class StoreMirror:
             [int(remap[m]) for m in members if remap[m] >= 0]
             for members in old.term_members
         ]
+        fresh._pods_by_pair = {
+            kv: [int(remap[r]) for r in rows if remap[r] >= 0]
+            for kv, rows in old._pods_by_pair.items()
+        }
         self.__dict__.update(fresh.__dict__)
 
     # ---------------------------------------------------------- inspection

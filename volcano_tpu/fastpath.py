@@ -151,6 +151,11 @@ class FastCycle:
             return False
         if not set(self.plugin_opts) <= FAST_PLUGINS:
             return False
+        args = get_action_args(self.conf.configurations, "allocate")
+        if args and args.get_str("solver", "wave") != "wave":
+            # The exact sequential solver needs dense per-task affinity
+            # inputs; the object path provides them.
+            return False
         return True
 
     def _tier_opts(self, flag: str):
@@ -680,10 +685,10 @@ class FastCycle:
             if prep is None:
                 return
             solve_jobs, task_rows = prep
-            inputs, pid = self._solve_inputs(solve_jobs, task_rows)
+            inputs, pid, profiles = self._solve_inputs(solve_jobs, task_rows)
             t0 = time.perf_counter()
             if solver == "wave":
-                result = solve_fn(*inputs, pid=pid)
+                result = solve_fn(*inputs, pid=pid, profiles=profiles)
             else:
                 result = solve_fn(*inputs)
             assigned = np.asarray(result.assigned)[:len(task_rows)]
@@ -1073,122 +1078,254 @@ class FastCycle:
         q_alloc[:self.Qn] = self.q_alloc
         queues = SolveQueues(deserved=deserved, allocated=q_alloc)
 
-        aff = self._affinity_args(task_rows, Np, Pp)
+        aff, pid, profiles = self._affinity_and_profiles(
+            task_rows, tasks, Np
+        )
         weights = self._score_weights()
-        pid = self._refined_pid(task_rows, aff, P)
         return (
             (nodes, tasks, jobs, queues, weights, self.eps,
              self.scalar_slot, aff),
             pid,
+            profiles,
         )
 
-    def _refined_pid(self, task_rows: np.ndarray, aff: AffinityArgs,
-                     P: int) -> np.ndarray:
-        """Store-interned profile ids, split further wherever per-cycle
-        inter-pod term membership (t_matches) differs within a profile —
-        the one profile input that can depend on *other* pods of the job
-        (a sibling's topology-spread term matches every pod of the job)."""
-        pid = self.m.p_prof[task_rows].astype(np.int64)
-        t_matches = np.asarray(aff.t_matches)[:P]
-        if t_matches.shape[1] <= 1 or not t_matches.any():
-            return pid
-        E = t_matches.shape[1]
-        rng = np.random.RandomState(0x7A5E)
-        coef = rng.randint(1, 1 << 20, size=(E, 2)).astype(np.float64)
-        h = (t_matches.astype(np.float64) @ coef).astype(np.int64)
-        combo = pid * np.int64(1_000_003) + h[:, 0] + h[:, 1] * np.int64(8191)
-        _, first, inv = np.unique(combo, return_index=True,
-                                  return_inverse=True)
-        refined = first[inv]
-        # Exactness check (hash-collision guard): every member must agree
-        # with its representative's membership row.
-        if not np.array_equal(t_matches, t_matches[refined]):
-            # Fall back to exact grouping on (pid, row bytes).
-            key = np.ascontiguousarray(
-                np.concatenate(
-                    [pid[:, None].view(np.uint8).reshape(P, -1),
-                     t_matches.view(np.uint8).reshape(P, -1)], axis=1
-                )
-            )
-            _, first, inv = np.unique(
-                key.view([("", np.uint8)] * key.shape[1]).ravel(),
-                return_index=True, return_inverse=True,
-            )
-            refined = first[inv]
-        return refined.astype(np.int64)
+    def _affinity_and_profiles(self, task_rows: np.ndarray, tasks,
+                               Np: int):
+        """Affinity inputs + refined profile ids + SolveProfiles, all at
+        profile granularity — nothing dense in [P, E] is ever built.
 
-    def _affinity_args(self, task_rows: np.ndarray, Np: int,
-                       Pp: int) -> AffinityArgs:
+        - Active-term compaction: only terms some pending task is involved
+          with enter the solve; inactive terms cannot influence it (their
+          counts are neither gated on nor scored).
+        - Profile refinement: store-interned profile ids split wherever
+          per-cycle term membership differs within a profile (a sibling's
+          topology-spread term matches every pod of the job).  Membership
+          hashes are accumulated sparsely from the term member lists; the
+          collision probability of the two independent 20-bit-coefficient
+          hashes is ~2^-40 per pair.
+        """
+        from .ops.wave import SolveProfiles
+
         m = self.m
-        E = len(m.terms)
-        if E == 0:
-            return empty_affinity(Np, Pp)
         P = len(task_rows)
-        # Any pending task with terms, or any resident counted?  Cheap test:
-        has_any = bool(m.p_has_ip[:self.Pn][task_rows].any())
-        Ep = _pow2(E, 1)
-        K = max(1, len(m.topo_keys))
-        node_dom_raw = m.node_dom()
-        D = max(1, len(m.domains))
-        node_dom = np.full((Np, K), -1, I)
-        node_dom[:len(node_dom_raw)] = node_dom_raw
-        term_key = np.zeros((Ep,), I)
-        for e, (_sel, key, _ns) in enumerate(m.term_info):
-            term_key[e] = m.topo_keys.index.get(key, 0)
+        pid_raw = m.p_prof[task_rows].astype(np.int64)
 
-        # Resident counts per (term, domain).
-        cnt0 = np.zeros((Ep, D), I)
-        resident = self.resident
-        node = m.p_node[:self.Pn]
-        any_resident = False
-        for e in range(E):
-            members = np.array(
-                [r for r in m.term_members[e] if r < self.Pn], np.int64
+        # ---- active terms: union of pending tasks' involvement ----------
+        er_a, ei_a = m.c_ip_aff.gather(task_rows)
+        er_n, ei_n = m.c_ip_anti.gather(task_rows)
+        er_s, ei_s, ev_s = m.c_ip_soft.gather(task_rows)
+        active = np.unique(np.concatenate([ei_a, ei_n, ei_s]))
+        E = len(active)
+        if E == 0:
+            aff = empty_affinity(Np, 1)
+            profiles = self._profiles_from_rows(
+                tasks, task_rows, pid_raw, None, aff, P
             )
-            if not len(members):
-                continue
-            members = members[resident[members]]
-            if not len(members):
-                continue
-            dom = node_dom_raw[node[members], term_key[e]]
-            dom = dom[dom >= 0]
-            if len(dom):
-                np.add.at(cnt0[e], dom, 1)
-                any_resident = True
-        if not has_any and not any_resident:
-            return empty_affinity(Np, Pp)
+            return aff, self._pid_out, profiles
 
-        t_req_aff = np.zeros((Pp, Ep), bool)
-        t_req_anti = np.zeros((Pp, Ep), bool)
-        t_matches = np.zeros((Pp, Ep), bool)
-        t_soft = np.zeros((Pp, Ep), F)
-        er, ei = m.c_ip_aff.gather(task_rows)
-        t_req_aff[er, ei] = True
-        er, ei = m.c_ip_anti.gather(task_rows)
-        t_req_anti[er, ei] = True
-        er, ei, ev = m.c_ip_soft.gather(task_rows)
-        np.add.at(t_soft, (er, ei), ev)
-        # t_matches from term membership lists.
+        # Renumber active terms by first reference in task order so each
+        # wave's terms form a narrow window (the solver slices every
+        # [*, E] tensor to that window — wave.py _term_windows).
         local = np.full(self.Pn, -1, np.int64)
         local[task_rows] = np.arange(P)
-        for e in range(E):
-            members = np.array(
-                [r for r in m.term_members[e] if r < self.Pn], np.int64
-            )
-            if not len(members):
-                continue
-            loc = local[members]
-            loc = loc[loc >= 0]
-            if len(loc):
-                t_matches[loc, e] = True
-        return AffinityArgs(
+        first_ref = np.full(len(m.terms), P, np.int64)
+        if len(ei_a):
+            np.minimum.at(first_ref, ei_a, er_a)
+        if len(ei_n):
+            np.minimum.at(first_ref, ei_n, er_n)
+        if len(ei_s):
+            np.minimum.at(first_ref, ei_s, er_s)
+        for e in active:
+            members = np.asarray(m.term_members[int(e)], np.int64)
+            if len(members):
+                loc = local[members[members < self.Pn]]
+                loc = loc[loc >= 0]
+                if len(loc):
+                    first_ref[e] = min(first_ref[e], int(loc.min()))
+        active = active[np.argsort(first_ref[active], kind="stable")]
+
+        term_local = np.full(len(m.terms), -1, np.int64)
+        term_local[active] = np.arange(E)
+        Ep = _pow2(E, 1)
+
+        # ---- sparse membership hash + per-term local membership ---------
+        rng = np.random.RandomState(0x7A5E)
+        coef = rng.randint(1, 1 << 20, size=(E, 2)).astype(np.int64)
+        h1 = np.zeros(P, np.int64)
+        h2 = np.zeros(P, np.int64)
+        member_locs: List[np.ndarray] = []
+        node = m.p_node[:self.Pn]
+        node_dom_raw = m.node_dom()
+        K = max(1, len(m.topo_keys))
+        D = max(1, len(m.domains))
+        term_key = np.zeros((Ep,), I)
+        cnt0 = np.zeros((Ep, D), I)
+        for le in range(E):
+            e = int(active[le])
+            _sel, key, _ns = m.term_info[e]
+            term_key[le] = m.topo_keys.index.get(key, 0)
+            members = np.asarray(m.term_members[e], np.int64)
+            members = members[members < self.Pn] if len(members) else members
+            if len(members):
+                loc = local[members]
+                loc = loc[loc >= 0]
+                if len(loc):
+                    h1[loc] += coef[le, 0]
+                    h2[loc] += coef[le, 1]
+                member_locs.append(loc)
+                residents = members[self.resident[members]]
+                if len(residents):
+                    dom = node_dom_raw[node[residents], term_key[le]]
+                    dom = dom[dom >= 0]
+                    if len(dom):
+                        np.add.at(cnt0[le], dom, 1)
+            else:
+                member_locs.append(np.zeros(0, np.int64))
+
+        combo = (
+            pid_raw * np.int64(1_000_003)
+            + h1 * np.int64(8191)
+            + h2
+        )
+        profiles = self._profiles_from_rows(
+            tasks, task_rows, combo, (member_locs, term_local, Ep,
+                                      er_a, ei_a, er_n, ei_n,
+                                      er_s, ei_s, ev_s, pid_raw), None, P
+        )
+        node_dom = np.full((Np, K), -1, I)
+        node_dom[:len(node_dom_raw)] = node_dom_raw
+        aff = AffinityArgs(
             node_dom=node_dom,
             term_key=term_key,
             cnt0=cnt0,
-            t_req_aff=t_req_aff,
-            t_req_anti=t_req_anti,
-            t_matches=t_matches,
-            t_soft=t_soft,
+            t_req_aff=np.zeros((1, Ep), bool),
+            t_req_anti=np.zeros((1, Ep), bool),
+            t_matches=np.zeros((1, Ep), bool),
+            t_soft=np.zeros((1, Ep), F),
+        )
+        return aff, self._pid_out, profiles
+
+    def _verify_membership_grouping(self, pid, u, combo, term_parts, P):
+        """Hash-collision guard: every task's term-membership set must
+        equal its profile representative's (the coefficients are fixed per
+        process, so an unchecked collision would repeat every cycle).
+        Sparse O(memberships) check; exact regrouping on mismatch."""
+        (member_locs, _tl, _Ep, _ea, _eia, _en, _ein, _es, _eis, _evs,
+         pid_raw) = term_parts
+        if not any(len(loc) for loc in member_locs):
+            return pid, u
+        t_all = np.concatenate([loc for loc in member_locs if len(loc)])
+        e_all = np.concatenate([
+            np.full(len(loc), le, np.int64)
+            for le, loc in enumerate(member_locs) if len(loc)
+        ])
+        order = np.lexsort((e_all, t_all))
+        pt, pe = t_all[order], e_all[order]
+        counts = np.bincount(pt, minlength=P)
+        offs = np.concatenate(([0], np.cumsum(counts)))
+        rep = u[pid]
+        ok = bool((counts == counts[rep]).all())
+        if ok:
+            sel = np.flatnonzero(counts > 0)
+            if len(sel):
+                lens = counts[sel]
+                cum = np.concatenate(([0], np.cumsum(lens)[:-1]))
+                base = np.arange(int(lens.sum())) - np.repeat(cum, lens)
+                pos_t = base + np.repeat(offs[sel], lens)
+                pos_r = base + np.repeat(offs[rep[sel]], lens)
+                ok = bool((pe[pos_t] == pe[pos_r]).all())
+        if ok:
+            return pid, u
+        log.warning("profile membership hash collision; exact regrouping")
+        keys = {}
+        pid2 = np.zeros(P, np.int64)
+        u2 = []
+        for t in range(P):
+            key = (int(pid_raw[t]),
+                   tuple(pe[offs[t]:offs[t + 1]].tolist()))
+            got = keys.get(key)
+            if got is None:
+                got = len(u2)
+                keys[key] = got
+                u2.append(t)
+            pid2[t] = got
+        return pid2, np.asarray(u2, np.int64)
+
+    def _profiles_from_rows(self, tasks, task_rows: np.ndarray,
+                            combo: np.ndarray, term_parts, aff_empty,
+                            P: int):
+        """Renumber combo ids by first occurrence and gather one profile
+        row per distinct id (plus sparse [U, E] term columns)."""
+        from .ops.wave import SolveProfiles
+
+        _, first, inv = np.unique(combo, return_index=True,
+                                  return_inverse=True)
+        order = np.argsort(first, kind="stable")
+        rank = np.empty(len(order), np.int64)
+        rank[order] = np.arange(len(order))
+        pid = rank[inv]
+        u = first[order]  # local first-occurrence row per profile
+        if term_parts is not None:
+            pid, u = self._verify_membership_grouping(
+                pid, u, combo, term_parts, P
+            )
+        self._pid_out = pid
+        U = len(u)
+
+        def g(a):
+            return np.asarray(a)[u]
+
+        if term_parts is None:
+            Ep = 1
+            u_req_aff = np.zeros((U, 1), bool)
+            u_req_anti = np.zeros((U, 1), bool)
+            u_matches = np.zeros((U, 1), bool)
+            u_soft = np.zeros((U, 1), F)
+        else:
+            (member_locs, term_local, Ep, er_a, ei_a, er_n, ei_n,
+             er_s, ei_s, ev_s, _pid_raw) = term_parts
+            u_index = np.full(P, -1, np.int64)
+            u_index[u] = np.arange(U)
+            u_req_aff = np.zeros((U, Ep), bool)
+            u_req_anti = np.zeros((U, Ep), bool)
+            u_matches = np.zeros((U, Ep), bool)
+            u_soft = np.zeros((U, Ep), F)
+            for le, loc in enumerate(member_locs):
+                if len(loc):
+                    sel = u_index[loc]
+                    sel = sel[sel >= 0]
+                    if len(sel):
+                        u_matches[sel, le] = True
+
+            def scatter(er, ei, out, val=None):
+                ur = u_index[er]
+                keep = ur >= 0
+                lei = term_local[ei[keep]]
+                urk = ur[keep]
+                ok = lei >= 0
+                if val is None:
+                    out[urk[ok], lei[ok]] = True
+                else:
+                    np.add.at(out, (urk[ok], lei[ok]), val[keep][ok])
+
+            scatter(er_a, ei_a, u_req_aff)
+            scatter(er_n, ei_n, u_req_anti)
+            scatter(er_s, ei_s, u_soft, val=ev_s)
+
+        return SolveProfiles(
+            req=g(tasks.req),
+            init_req=g(tasks.init_req),
+            ports=g(tasks.ports),
+            sel_bits=g(tasks.sel_bits),
+            aff_bits=g(tasks.aff_bits),
+            aff_terms=g(tasks.aff_terms),
+            tol_bits=g(tasks.tol_bits),
+            pref_bits=g(tasks.pref_bits),
+            pref_w=g(tasks.pref_w),
+            t_req_aff=u_req_aff,
+            t_req_anti=u_req_anti,
+            t_matches=u_matches,
+            t_soft=u_soft,
         )
 
     # -------------------------------------------------------------- commit

@@ -148,7 +148,7 @@ def _subset_mm(rows_bits, table_missing_f):
     return viol == 0
 
 
-@partial(jax.jit, static_argnames=("wave", "n_waves", "features"))
+@partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -162,8 +162,10 @@ def _solve_wave(
     pid: jnp.ndarray,  # [P] int32 global profile id per task
     wave_prof: jnp.ndarray,  # [NW, U_MAX] int32 profile ids present per wave
     pid_local: jnp.ndarray,  # [P] int32 index into the wave's profile list
+    wave_terms: jnp.ndarray,  # [NW, EW] int32 term ids per wave (pad=dummy)
     wave: int,
     n_waves: int,
+    ew: int,
     features: tuple = (True, True, True, True, True),
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
@@ -182,6 +184,7 @@ def _solve_wave(
     W = wave
     NW = n_waves
     UM = wave_prof.shape[1]
+    EW = ew
     K = min(TOPK, N)
     JP = J + W  # job axis padded so any wave's window slice stays in range
     f32 = jnp.float32
@@ -199,9 +202,6 @@ def _solve_wave(
     #  - a stalled attempt (no placement and no new skip) leaves the state
     #    bit-identical, so the loop exits; the unresolved tasks stay
     #    Pending for the cycle (see attempt_cond).
-
-    node_dom_t = aff.node_dom[:, aff.term_key]  # [N, E] domain per term
-    term_arange = jnp.arange(E)
 
     # Unpacked-bit tables (f32 complements feed the matmul subset checks).
     label_missing_f = (~_unpack_bits(nodes.label_bits)).astype(f32)
@@ -274,11 +274,22 @@ def _solve_wave(
             p_has_ports = jnp.any(p_ports, axis=-1)
             ports_w = p_ports[pid_l]  # [W, B] per-task view
         if has_aff:
-            p_t_req_aff = prof.t_req_aff[pids]  # [UM, E]
-            p_t_req_anti = prof.t_req_anti[pids]
-            p_t_matches = prof.t_matches[pids]
-            p_t_soft = prof.t_soft[pids]
-            t_matches_w = p_t_matches[pid_l]  # [W, E]
+            # Term window: gather this wave's referenced terms (tasks are
+            # job-contiguous, terms per-jobish), so every [*, E] tensor
+            # below is bounded by terms-per-wave — the tiling that keeps
+            # the affinity machinery scalable to 50k x 500k (SURVEY.md
+            # section 7 hard parts).
+            wterms = wave_terms[w]  # [EW], padded with the dummy row
+            tk_w = aff.term_key[wterms]
+            node_dom_t = jnp.take(aff.node_dom, tk_w, axis=1)  # [N, EW]
+            term_arange = jnp.arange(EW)
+            esl = lambda a: jnp.take(a, wterms, axis=1)
+            p_t_req_aff = esl(prof.t_req_aff[pids])  # [UM, EW]
+            p_t_req_anti = esl(prof.t_req_anti[pids])
+            p_t_matches = esl(prof.t_matches[pids])
+            p_t_soft = esl(prof.t_soft[pids])
+            t_matches_w = p_t_matches[pid_l]  # [W, EW]
+
 
         # ---- static predicate masks, hoisted out of the attempt loop ----
         p_ok = node_ready[None, :] & _subset_mm(
@@ -310,7 +321,7 @@ def _solve_wave(
             pref_match * prof.pref_w[pids][:, :, None], axis=1
         )  # [UM, N]
 
-        def live_parts(s: GState):
+        def live_parts(s: GState, cw_a, cw_p):
             """Per-attempt dynamic feasibility [UM, N] (+ cval for aff)."""
             if has_future:
                 future_idle = (
@@ -337,10 +348,10 @@ def _solve_wave(
                 p_feasible &= ~p_has_ports[:, None] | (port_clash == 0)
             cval = None
             if has_aff:
-                cnt = s.cnt_alloc + s.cnt_pip  # [E, D]
+                cnt = cw_a + cw_p
                 cval = cnt[term_arange[None, :], jnp.maximum(node_dom_t, 0)]
-                cval = jnp.where(node_dom_t >= 0, cval, 0)  # [N, E]
-                total = jnp.sum(cnt, axis=-1)  # [E]
+                cval = jnp.where(node_dom_t >= 0, cval, 0)  # [N, EW]
+                total = jnp.sum(cnt, axis=-1)  # [EW]
                 # Required affinity: every required term needs a resident
                 # match in the node's domain (or the self-match rule).
                 selfok = (total == 0)[None, :] & p_t_matches  # [UM, E]
@@ -369,13 +380,16 @@ def _solve_wave(
                     p_t_soft, cval.T.astype(f32)
                 )
             p_score = jnp.where(p_feasible, p_score, NEG)
-            order = jnp.argsort(-p_score, axis=1, stable=True)
-            return order[:, :K].astype(jnp.int32)
+            # top_k is the partial sort: ties prefer lower node index,
+            # matching the stable argsort it replaces.
+            _scores, order = jax.lax.top_k(p_score, K)
+            return order.astype(jnp.int32)
 
         done0 = ~real_w
 
         def attempt_cond(carry):
-            _s, done, _al, _ff, skip_l, _ov, _aw, _pw, it, stalled = carry
+            (_s, _cwa, _cwp, done, _al, _ff, skip_l, _ov, _aw, _pw, it,
+             stalled) = carry
             skip_t = (
                 jnp.matmul(onehot_j, skip_l.astype(f32)[:, None])[:, 0] > 0
             )
@@ -391,8 +405,8 @@ def _solve_wave(
             return jnp.any(~done & ~skip_t) & ~stalled & (it < 2 * W + 64)
 
         def attempt_body(carry):
-            (s, done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
-             pipelined_w, it, _stalled) = carry
+            (s, cw_a, cw_p, done, alloc_l, fitf_l, skip_l, over_l,
+             assigned_w, pipelined_w, it, _stalled) = carry
             skip_l0 = skip_l
 
             if has_overuse:
@@ -415,7 +429,9 @@ def _solve_wave(
             )
             cand = ~done & ~skip_t
 
-            p_feasible, future_idle, walk_idle, cval = live_parts(s)
+            p_feasible, future_idle, walk_idle, cval = live_parts(
+                s, cw_a, cw_p
+            )
             ranked = rank_nodes(s, p_feasible, cval)
 
             p_any = jnp.any(p_feasible, axis=1)
@@ -430,7 +446,7 @@ def _solve_wave(
             aborted = jnp.any(same_job & tril & no_node[None, :], axis=1)
 
             # Hoisted per-attempt constants for the sub-round loop.
-            feas_k = jnp.take_along_axis(p_feasible, ranked, axis=1)
+            feas_k_att = jnp.take_along_axis(p_feasible, ranked, axis=1)
             mt_k = nodes.max_tasks[ranked]
             rows_rk = jnp.matmul(onehot_u, ranked.astype(f32))  # [W, K]
 
@@ -453,10 +469,11 @@ def _solve_wave(
                 ) > 0
             )  # [W, W] same-contention-group mask
             if has_aff:
-                p_involved = p_t_req_aff | p_t_req_anti | (
-                    jnp.abs(p_t_soft) > 0
-                )
-                task_has_aff = jnp.any(p_involved[pid_l], axis=1)  # [W]
+                # Only REQUIRED terms gate pair-wise conflicts: soft
+                # (preferred/spread) terms influence scores, never
+                # feasibility, so same-domain soft interactions place in
+                # one pass with attempt-start scores.
+                p_involved = p_t_req_aff | p_t_req_anti
 
             # ---- sub-rounds: rejected tasks re-walk against live capacity
             # within the attempt, reusing this attempt's feasibility and
@@ -470,17 +487,56 @@ def _solve_wave(
             # feasibility depends on count state that live_parts refreshes
             # per attempt.
             def sub_cond(sc):
-                (_s, done_sub, _al, _aw, _pw, si, progressed) = sc
+                (_s, _cwa, _cwp, _fk, _dirty, done_sub, _al, _aw, _pw, si,
+                 progressed) = sc
                 return progressed & (si < SUBROUNDS) & jnp.any(
                     cand & ~done_sub & ~aborted
                 )
 
             def sub_body(sc):
-                (s_, done_sub, alloc_l_, assigned_w_, pipelined_w_, si,
-                 _progressed) = sc
+                (s_, cw_a_, cw_p_, feas_k_c, aff_dirty, done_sub, alloc_l_,
+                 assigned_w_, pipelined_w_, si, _progressed) = sc
                 cand_s = cand & ~done_sub & ~aborted
+
                 if has_aff:
-                    cand_s &= (si == 0) | ~task_has_aff
+                    # Live affinity steering: after an affinity-relevant
+                    # acceptance, recompute the profile-level required-
+                    # (anti)affinity feasibility against the sub-round
+                    # count window, so once a sibling claims a domain the
+                    # rest of the gang walks only nodes of that domain
+                    # instead of re-discovering it one attempt at a time.
+                    # Gated on a dirty flag: waves without affinity
+                    # activity skip the [N, EW] work entirely.
+                    def steer(_):
+                        cnt_live_n = cw_a_ + cw_p_  # [EW, D]
+                        cval_live = cnt_live_n[
+                            term_arange[None, :], jnp.maximum(node_dom_t, 0)
+                        ]
+                        cval_live = jnp.where(node_dom_t >= 0, cval_live, 0)
+                        total_live_n = jnp.sum(cnt_live_n, axis=-1)
+                        selfok_p = (
+                            (total_live_n == 0)[None, :] & p_t_matches
+                        )  # [UM, EW]
+                        need_l = (p_t_req_aff & ~selfok_p).astype(f32)
+                        aff_viol_l = jnp.matmul(
+                            need_l, (cval_live == 0).astype(f32).T
+                        )
+                        anti_viol_l = jnp.matmul(
+                            p_t_req_anti.astype(f32),
+                            (cval_live > 0).astype(f32).T,
+                        )
+                        p_feas_sub = p_feasible & (aff_viol_l == 0) & (
+                            anti_viol_l == 0
+                        )
+                        return jnp.take_along_axis(
+                            p_feas_sub, ranked, axis=1
+                        )
+
+                    feas_k = jax.lax.cond(
+                        aff_dirty, steer, lambda _: feas_k_c, None
+                    )
+                else:
+                    feas_k = feas_k_c
 
                 # Live capacity walk (copies of the profile per ranked node).
                 if has_future:
@@ -504,6 +560,13 @@ def _solve_wave(
                 c = jnp.where(
                     feas_k, jnp.minimum(jnp.floor(c_res), c_pods), 0.0
                 )
+                if has_aff:
+                    # A profile that anti-affines against its own labels
+                    # holds at most one copy per domain; cap the walk at
+                    # one per node so siblings spread instead of stacking
+                    # on one node and serializing through reject/retry.
+                    self_anti = jnp.any(p_t_req_anti & p_t_matches, axis=1)
+                    c = jnp.where(self_anti[:, None], jnp.minimum(c, 1.0), c)
                 cumcap = jnp.cumsum(c, axis=1)  # [UM, K]
 
                 # m = my rank among the remaining candidates of my
@@ -567,14 +630,44 @@ def _solve_wave(
                     port_live = jnp.any(ports_w & used_bits_c, axis=1)
                     clean &= ~port_conf & ~port_live
                 if has_aff:
-                    # Same-domain affinity interaction with earlier wave
-                    # tasks: conservative — any shared term in the same
-                    # topology domain sends the later task to the next
-                    # attempt.
-                    dw = node_dom_t[choice]  # [W, E]
-                    involved = p_involved[pid_l] & (dw >= 0)  # [W, E]
+                    # Live per-task recheck against the sub-round count
+                    # window: a sibling placed in an earlier sub-round
+                    # already satisfies (or violates) required terms here,
+                    # so involved tasks resolve within the attempt instead
+                    # of one per attempt.
+                    dw = node_dom_t[choice]  # [W, EW]
+                    cnt_live = cw_a_ + cw_p_  # [EW, D]
+                    total_live = jnp.sum(cnt_live, axis=-1)  # [EW]
+                    cval_t = cnt_live[
+                        term_arange[None, :], jnp.maximum(dw, 0)
+                    ]
+                    cval_t = jnp.where(dw >= 0, cval_t, 0)  # [W, EW]
+                    req_aff_t = p_t_req_aff[pid_l]  # [W, EW]
+                    selfok_t = (total_live == 0)[None, :] & t_matches_w
+                    aff_ok = ~jnp.any(
+                        req_aff_t & ~selfok_t & (cval_t == 0), axis=1
+                    )
+                    anti_ok = ~jnp.any(
+                        p_t_req_anti[pid_l] & (cval_t > 0), axis=1
+                    )
+                    clean &= aff_ok & anti_ok
+                    # Same-domain interaction with earlier tasks of THIS
+                    # sub-round stays conservative (their count updates
+                    # are not applied yet).  A task relying on the
+                    # self-match rule additionally conflicts with ANY
+                    # earlier giver of the term, whatever its domain —
+                    # otherwise two siblings could each claim "first" and
+                    # split the gang across domains (the sequential path
+                    # serializes them).
+                    involved = p_involved[pid_l] & (dw >= 0)  # [W, EW]
                     gives = t_matches_w & (dw >= 0)
-                    if E * W * W <= (1 << 27):
+                    uses_selfok = (
+                        req_aff_t & selfok_t & (cval_t == 0)
+                    )  # [W, EW]
+                    selfok_hit = jnp.matmul(
+                        uses_selfok.astype(f32), gives.astype(f32).T
+                    ) > 0
+                    if EW * W * W <= (1 << 27):
                         hit = (
                             involved[:, None, :] & gives[None, :, :]
                             & (dw[:, None, :] == dw[None, :, :])
@@ -584,8 +677,8 @@ def _solve_wave(
                         # Large term tables: chunk the E axis to bound the
                         # [W, W, C] intermediate.
                         C = max(1, (1 << 27) // (W * W))
-                        EC = (E + C - 1) // C
-                        e_pad = EC * C - E
+                        EC = (EW + C - 1) // C
+                        e_pad = EC * C - EW
                         inv_p = jnp.pad(involved, ((0, 0), (0, e_pad)))
                         giv_p = jnp.pad(gives, ((0, 0), (0, e_pad)))
                         dw_p = jnp.pad(
@@ -614,7 +707,8 @@ def _solve_wave(
                             0, EC, chunk_body, jnp.zeros((W, W), bool)
                         )
                     aff_conf = jnp.any(
-                        tril & live[None, :] & aff_pair, axis=1
+                        tril & live[None, :] & (aff_pair | selfok_hit),
+                        axis=1,
                     )
                     clean &= ~aff_conf
 
@@ -659,29 +753,27 @@ def _solve_wave(
                             )
                         )
                 if has_aff:
+                    # Window-local count update: the wave only touches its
+                    # own term rows, so updates stay on the [EW, D] window
+                    # carried through the loops; the global state is
+                    # written back once per wave.
                     flat_dom = term_arange[None, :] * D + jnp.maximum(dw, 0)
                     inc_base = t_matches_w & (dw >= 0)
-                    cnt_alloc = (
-                        s_.cnt_alloc.reshape(-1)
-                        .at[flat_dom.reshape(-1)]
-                        .add(
-                            (inc_base & acc_alloc[:, None])
-                            .astype(jnp.int32).reshape(-1)
-                        )
-                        .reshape(E, D)
-                    )
-                    s_ = s_._replace(cnt_alloc=cnt_alloc)
-                    if has_future:
-                        cnt_pip = (
-                            s_.cnt_pip.reshape(-1)
+
+                    def cnt_apply(cw, acc):
+                        return (
+                            cw.reshape(-1)
                             .at[flat_dom.reshape(-1)]
                             .add(
-                                (inc_base & acc_pipe[:, None])
+                                (inc_base & acc[:, None])
                                 .astype(jnp.int32).reshape(-1)
                             )
-                            .reshape(E, D)
+                            .reshape(EW, D)
                         )
-                        s_ = s_._replace(cnt_pip=cnt_pip)
+
+                    cw_a_ = cnt_apply(cw_a_, acc_alloc)
+                    if has_future:
+                        cw_p_ = cnt_apply(cw_p_, acc_pipe)
 
                 alloc_l_ = alloc_l_ + jnp.round(
                     jnp.matmul(
@@ -691,16 +783,30 @@ def _solve_wave(
                 assigned_w_ = jnp.where(acc_alloc, choice, assigned_w_)
                 pipelined_w_ = jnp.where(acc_pipe, choice, pipelined_w_)
                 resolved = acc_alloc | acc_pipe
+                if has_aff:
+                    term_required = jnp.any(
+                        p_t_req_aff | p_t_req_anti, axis=0
+                    )  # [EW]
+                    giver_rel = jnp.any(
+                        t_matches_w & term_required[None, :], axis=1
+                    )
+                    involved_any = jnp.any(p_involved[pid_l], axis=1)
+                    dirty_next = jnp.any(
+                        resolved & (involved_any | giver_rel)
+                    )
+                else:
+                    dirty_next = jnp.bool_(False)
                 return (
-                    s_, done_sub | resolved, alloc_l_, assigned_w_,
-                    pipelined_w_, si + 1, jnp.any(resolved),
+                    s_, cw_a_, cw_p_, feas_k, dirty_next,
+                    done_sub | resolved, alloc_l_,
+                    assigned_w_, pipelined_w_, si + 1, jnp.any(resolved),
                 )
 
-            (s, done_sub, alloc_l, assigned_w, pipelined_w, subs,
-             _prog) = jax.lax.while_loop(
+            (s, cw_a, cw_p, _fk, _dirty, done_sub, alloc_l, assigned_w,
+             pipelined_w, subs, _prog) = jax.lax.while_loop(
                 sub_cond, sub_body,
-                (s, done, alloc_l, assigned_w, pipelined_w, jnp.int32(0),
-                 jnp.bool_(True)),
+                (s, cw_a, cw_p, feas_k_att, jnp.bool_(False), done, alloc_l,
+                 assigned_w, pipelined_w, jnp.int32(0), jnp.bool_(True)),
             )
 
             # Attempt-level job bookkeeping for fit failures.
@@ -719,12 +825,22 @@ def _solve_wave(
             done = done | new_done
 
             return (
-                s, done, alloc_l, fitf_l, skip_l, over_l,
+                s, cw_a, cw_p, done, alloc_l, fitf_l, skip_l, over_l,
                 assigned_w, pipelined_w, it + jnp.maximum(subs, 1), stalled,
             )
 
+        # Per-wave count windows (the wave only touches its own term rows).
+        if has_aff:
+            cw_a0 = state.cnt_alloc[wterms]
+            cw_p0 = state.cnt_pip[wterms]
+        else:
+            cw_a0 = jnp.zeros((1, 1), jnp.int32)
+            cw_p0 = jnp.zeros((1, 1), jnp.int32)
+
         init = (
             state,
+            cw_a0,
+            cw_p0,
             done0,
             jsl(state.alloc_cnt),
             jsl(state.fit_failed),
@@ -735,10 +851,17 @@ def _solve_wave(
             jnp.int32(0),
             jnp.bool_(False),
         )
-        (s, _done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
+        (s, cw_a, cw_p, _done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
          pipelined_w, _it, _stalled) = jax.lax.while_loop(
             attempt_cond, attempt_body, init
         )
+        if has_aff:
+            # Real rows are unique in wterms; duplicate writes only hit
+            # the dummy scratch row.
+            s = s._replace(
+                cnt_alloc=s.cnt_alloc.at[wterms].set(cw_a),
+                cnt_pip=s.cnt_pip.at[wterms].set(cw_p),
+            )
 
         jupd_back = lambda g, l: jax.lax.dynamic_update_slice_in_dim(
             g, l, jlo, axis=0
@@ -902,27 +1025,88 @@ def _profiles_from_pid(tasks: SolveTasks, aff: AffinityArgs,
     return profiles, pid
 
 
-def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
-    """Per-wave profile lists as [min, min+UM) id ranges.
+def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
+                  pid: np.ndarray, wave_prof: np.ndarray, n_waves: int):
+    """Per-wave lists of the affinity terms the wave's profiles reference.
 
-    Because pid is numbered by first occurrence and tasks are
-    job-contiguous, the profiles of one wave form a narrow id range; the
-    wave's profile list is just that range (padded to a power-of-two width
-    across waves to bound recompilation).  Returns (wave_prof [NW, UM],
+    Every [*, E] tensor in the kernel is gathered down to the wave's term
+    list, bounding the affinity machinery by terms-per-wave instead of
+    total terms.  One dummy scratch row is appended to the term axis and
+    used as list padding, so the windowed count write-back scatters to
+    unique real rows (duplicates only hit the dummy).
+    Returns (profiles, aff, wave_terms [NW, EW], EW).
+    """
+    t_req_aff = _np(profiles.t_req_aff)
+    E = t_req_aff.shape[1]
+    iom = (
+        t_req_aff | _np(profiles.t_req_anti) | _np(profiles.t_matches)
+        | (_np(profiles.t_soft) != 0)
+    )
+    # Append the dummy scratch term row E.
+    def zc(a):
+        a = _np(a)
+        return np.concatenate(
+            [a, np.zeros((*a.shape[:-1], 1), a.dtype)], axis=-1
+        )
+
+    profiles = profiles._replace(
+        t_req_aff=zc(profiles.t_req_aff),
+        t_req_anti=zc(profiles.t_req_anti),
+        t_matches=zc(profiles.t_matches),
+        t_soft=zc(profiles.t_soft),
+    )
+    aff = aff._replace(
+        term_key=np.concatenate([_np(aff.term_key), np.zeros(1, np.int32)]),
+        cnt0=np.concatenate(
+            [_np(aff.cnt0),
+             np.zeros((1, _np(aff.cnt0).shape[1]), _np(aff.cnt0).dtype)]
+        ),
+    )
+    wp = _np(wave_prof)
+    U = iom.shape[0]
+    term_lists = []
+    ew = 1
+    for w in range(n_waves):
+        pids = np.unique(np.clip(wp[w], 0, U - 1))
+        terms = np.flatnonzero(iom[pids].any(axis=0))
+        term_lists.append(terms)
+        ew = max(ew, len(terms))
+    EW = 1
+    while EW < ew:
+        EW *= 2
+    wave_terms = np.full((n_waves, EW), E, np.int32)  # pad = dummy row
+    for w, terms in enumerate(term_lists):
+        wave_terms[w, :len(terms)] = terms
+    return profiles, aff, wave_terms, int(EW)
+
+
+def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
+    """Per-wave lists of the profiles actually PRESENT in each wave.
+
+    Shared profiles recur across the whole task list, so id *ranges* per
+    wave degenerate to the full profile table at scale; explicit presence
+    lists keep UM at (distinct profiles per wave), padded to a power of
+    two across waves to bound recompilation.  Padding repeats the wave's
+    first profile (read-only duplication).  Returns (wave_prof [NW, UM],
     pid_local [P]).
     """
-    U = int(pid.max()) + 1 if len(pid) else 1
     seg = pid.reshape(n_waves, wave)
-    lo = seg.min(axis=1)  # [NW]
-    hi = seg.max(axis=1)
-    um = int((hi - lo).max()) + 1
+    lists = []
+    invs = []
+    um = 1
+    for w in range(n_waves):
+        u, inv = np.unique(seg[w], return_inverse=True)
+        lists.append(u)
+        invs.append(inv)
+        um = max(um, len(u))
     UM = 1
     while UM < um:
         UM *= 2
-    wave_prof = np.minimum(
-        lo[:, None] + np.arange(UM, dtype=np.int32)[None, :], U - 1
-    ).astype(np.int32)
-    pid_local = (pid - np.repeat(lo, wave)).astype(np.int32)
+    wave_prof = np.zeros((n_waves, UM), np.int32)
+    for w, u in enumerate(lists):
+        wave_prof[w, :len(u)] = u
+        wave_prof[w, len(u):] = u[0]
+    pid_local = np.concatenate(invs).astype(np.int32)
     return wave_prof, pid_local
 
 
@@ -975,6 +1159,7 @@ def solve_wave(
     aff: AffinityArgs,
     wave: int = DEFAULT_WAVE,
     pid=None,
+    profiles: SolveProfiles = None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -982,19 +1167,36 @@ def solve_wave(
     deduplicates tasks into profiles host-side, and truncates the result
     back to the caller's task count.  ``pid`` (optional [P] int32) supplies
     precomputed profile ids — tasks with equal ids must have identical
-    per-task solver inputs — and skips the feature-hashing pass.
+    per-task solver inputs — and skips the feature-hashing pass.  With
+    ``profiles`` also given (rows aligned to the pid numbering, which must
+    be by first occurrence), nothing per-task is recomputed here and
+    ``aff``'s task-level fields may be dummies.
     """
     P = int(_np(tasks.req).shape[0])
     wave = int(min(wave, max(1, P)))
     pad = (-P) % wave
     if pad:
         tasks = _pad_tasks(tasks, pad)
-        aff = _pad_aff(aff, pad)
+        if profiles is None:
+            aff = _pad_aff(aff, pad)
     n_waves = (P + pad) // wave
-    if pid is not None:
+    if profiles is not None and pid is not None:
         pid = np.asarray(pid, np.int64)
         if pad:
-            # Padded rows are all-zero features: give them a fresh profile.
+            # Padded rows are all-zero features: append a fresh profile.
+            fresh = int(pid.max() + 1) if len(pid) else 0
+            pid = np.concatenate([pid, np.full(pad, fresh, np.int64)])
+            profiles = SolveProfiles(*[
+                np.concatenate(
+                    [_np(a), np.zeros((1, *np.asarray(a).shape[1:]),
+                                      np.asarray(a).dtype)]
+                )
+                for a in profiles
+            ])
+        pid = pid.astype(np.int32)
+    elif pid is not None:
+        pid = np.asarray(pid, np.int64)
+        if pad:
             fresh = (pid.max() + 1) if len(pid) else 0
             pid = np.concatenate([pid, np.full(pad, fresh, np.int64)])
         profiles, pid = _profiles_from_pid(tasks, aff, pid)
@@ -1013,6 +1215,9 @@ def solve_wave(
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),
     )
+    profiles, aff, wave_terms, ew = _term_windows(
+        profiles, aff, pid, wave_prof, n_waves
+    )
     # Exact f32 matmuls are load-bearing: the one-hot matmuls carry node
     # indices, resource sums, and 0/1 predicate counts that are compared
     # with == / <=; the TPU default (bf16 MXU passes) rounds node ids above
@@ -1021,8 +1226,8 @@ def solve_wave(
     with jax.default_matmul_precision("float32"):
         res = _solve_wave(
             nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
-            profiles, pid, wave_prof, pid_local,
-            wave=wave, n_waves=n_waves, features=features,
+            profiles, pid, wave_prof, pid_local, wave_terms,
+            wave=wave, n_waves=n_waves, ew=ew, features=features,
         )
     if pad:
         res = res._replace(

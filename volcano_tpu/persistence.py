@@ -1,0 +1,112 @@
+"""Store checkpoint / restore.
+
+The reference keeps all durable state in the API server (etcd) and
+rebuilds in-memory caches from informers on restart (``cache.Run`` +
+``WaitForCacheSync``, ``pkg/scheduler/cache/cache.go:376-417``); there is
+no separate checkpoint subsystem (SURVEY.md section 5.4).  The rebuild's
+store is its own system of record, so durability = serializing the spec
+objects and replaying them through the event API on load — the informer
+resync, replayed from a file instead of a watch stream.
+
+Only *spec* objects are persisted (pods, pod groups, queues, nodes,
+priority classes, namespace weights, batch jobs, commands, config maps,
+secrets, services); every derived structure (JobInfo/NodeInfo, the array
+mirror, controller caches) rebuilds through the normal mutation path.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import tempfile
+from typing import Optional
+
+from .cache import ClusterStore
+
+FORMAT_VERSION = 1
+
+# Derived caches attached to spec objects (mirror feature blobs, resource
+# caches).  Their interned indices are only valid for the store that
+# created them, so they never enter a checkpoint.
+_CACHE_ATTRS = ("_mirror_feat", "_req_cache", "_init_req_cache",
+                "_minres_vec")
+
+
+def _clean(obj):
+    o = copy.copy(obj)
+    d = getattr(o, "__dict__", None)
+    if d is not None:
+        for attr in _CACHE_ATTRS:
+            d.pop(attr, None)
+    return o
+
+
+def save_store(store: ClusterStore, path: str) -> None:
+    """Atomically write a point-in-time snapshot of the store's specs."""
+    with store._lock:
+        payload = {
+            "version": FORMAT_VERSION,
+            "nodes": [
+                ni.node for ni in store.nodes.values() if ni.node is not None
+            ],
+            "queues": list(store.raw_queues.values()),
+            "pod_groups": [_clean(pg) for pg in store.pod_groups.values()],
+            "pods": [_clean(p) for p in store.pods.values()],
+            "priority_classes": list(store.priority_classes.values()),
+            "namespace_weights": dict(store.namespace_weights),
+            "batch_jobs": list(store.batch_jobs.values()),
+            "commands": list(store.commands.values()),
+            "config_maps": dict(store.config_maps),
+            "secrets": dict(store.secrets),
+            "services": dict(store.services),
+        }
+        # Serialize while still holding the lock: the payload holds live
+        # object references that scheduler/controller threads mutate.
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".vctpu-ckpt-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_store(path: str, store: Optional[ClusterStore] = None) -> ClusterStore:
+    """Rehydrate a store by replaying the snapshot through the event API
+    (the informer-replay analog — derived state rebuilds naturally)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint version {payload.get('version')!r}"
+        )
+    store = store or ClusterStore()
+    for node in payload["nodes"]:
+        store.add_node(node)
+    for queue in payload["queues"]:
+        store.add_queue(queue)
+    for pc in payload["priority_classes"]:
+        store.add_priority_class(pc)
+    for pg in payload["pod_groups"]:
+        store.add_pod_group(pg)
+    for pod in payload["pods"]:
+        # Replayed pods carry stale feature-cache attrs only if the same
+        # object was pickled with them; the mirror recomputes as needed.
+        store.add_pod(pod)
+    with store._lock:
+        store.namespace_weights.update(payload["namespace_weights"])
+        for job in payload["batch_jobs"]:
+            store.batch_jobs[job.key] = job
+        for cmd in payload["commands"]:
+            store.commands[cmd.name] = cmd
+        store.config_maps.update(payload["config_maps"])
+        store.secrets.update(payload["secrets"])
+        store.services.update(payload["services"])
+    return store

@@ -37,11 +37,15 @@ class Scheduler:
         conf_path: Optional[str] = None,
         conf_str: Optional[str] = None,
         schedule_period: float = 1.0,
+        gate=None,
     ):
         self.store = store
         self.conf_path = conf_path
         self.conf_str = conf_str
         self.schedule_period = schedule_period
+        # Optional leadership gate: the periodic loop skips cycles while it
+        # returns False (active/passive HA, see volcano_tpu.ha).
+        self.gate = gate
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_conf = None
@@ -121,7 +125,8 @@ class Scheduler:
         while not self._stop.is_set():
             t0 = time.time()
             try:
-                self.run_once()
+                if self.gate is None or self.gate():
+                    self.run_once()
             except Exception:
                 log.exception("Scheduling cycle failed")
             elapsed = time.time() - t0

@@ -111,18 +111,42 @@ class Service:
         schedule_period: float = 1.0,
         controller_period: float = 0.2,
         simulate: bool = False,
+        state_path: Optional[str] = None,
+        checkpoint_period: float = 30.0,
+        lease_path: Optional[str] = None,
     ):
         self.store = store or ClusterStore()
+        self.state_path = state_path
+        self.checkpoint_period = checkpoint_period
+        if state_path:
+            import os
+
+            if os.path.exists(state_path):
+                from .persistence import load_store
+
+                load_store(state_path, self.store)
         self.admitted = AdmittedStore(self.store)
         self.controllers = ControllerManager(self.store)
         self.scheduler = Scheduler(
-            self.store, conf_path=conf_path, schedule_period=schedule_period
+            self.store, conf_path=conf_path, schedule_period=schedule_period,
+            gate=self.is_leader,
         )
         self.simulator = ClusterSimulator(self.store) if simulate else None
         self.controller_period = controller_period
         self._stop = threading.Event()
         self._threads = []
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # Active/passive HA: with a lease path, the control loops only run
+        # while this replica holds the lease (cmd/scheduler/app/server.go
+        # leaderelection semantics); the HTTP endpoint always serves.
+        self.elector = None
+        if lease_path:
+            from .ha import LeaderElector
+
+            self.elector = LeaderElector(lease_path)
+        self._leading = threading.Event()
+        if self.elector is None:
+            self._leading.set()
 
     # ----------------------------------------------------------------- loops
 
@@ -131,22 +155,61 @@ class Service:
         t = threading.Thread(target=self._controller_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if self.state_path:
+            ct = threading.Thread(target=self._checkpoint_loop, daemon=True)
+            ct.start()
+            self._threads.append(ct)
+        if self.elector is not None:
+            et = threading.Thread(
+                target=lambda: self.elector.run(
+                    self._leading.set, self._leading.clear
+                ),
+                daemon=True,
+            )
+            et.start()
+            self._threads.append(et)
         port = self._start_http(http_port)
         return port
+
+    def is_leader(self) -> bool:
+        return self._leading.is_set()
 
     def _controller_loop(self):
         while not self._stop.is_set():
             try:
-                self.controllers.process()
-                if self.simulator is not None:
-                    self.simulator.step()
+                if self._leading.is_set():
+                    self.controllers.process()
+                    if self.simulator is not None:
+                        self.simulator.step()
             except Exception:
                 log.exception("controller pump failed")
             self._stop.wait(self.controller_period)
 
+    def _checkpoint_loop(self):
+        from .persistence import save_store
+
+        while not self._stop.wait(self.checkpoint_period):
+            # Only the active replica checkpoints: a standby's store is
+            # stale and must never clobber the leader's snapshot.
+            if not self._leading.is_set():
+                continue
+            try:
+                save_store(self.store, self.state_path)
+            except Exception:
+                log.exception("checkpoint failed")
+
     def stop(self):
         self._stop.set()
         self.scheduler.stop()
+        if self.elector is not None:
+            self.elector.stop()
+        if self.state_path and self._leading.is_set():
+            from .persistence import save_store
+
+            try:
+                save_store(self.store, self.state_path)
+            except Exception:
+                log.exception("final checkpoint failed")
         if self._httpd is not None:
             self._httpd.shutdown()
 
@@ -283,3 +346,47 @@ class Service:
         t.start()
         self._threads.append(t)
         return actual_port
+
+
+def main(argv=None) -> int:
+    """Daemon entry point (the vc-scheduler + vc-controller-manager pair in
+    one process; flags mirror cmd/scheduler/app/options/options.go)."""
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(prog="vtpu-service")
+    p.add_argument("--scheduler-conf", default=None,
+                   help="scheduler YAML config path (hot-reloaded per cycle)")
+    p.add_argument("--schedule-period", type=float, default=1.0)
+    p.add_argument("--listen-port", type=int, default=11250)
+    p.add_argument("--state-path", default=None,
+                   help="checkpoint file; loaded on start, saved periodically")
+    p.add_argument("--checkpoint-period", type=float, default=30.0)
+    p.add_argument("--lease-path", default=None,
+                   help="leader-election lease file for active/passive HA")
+    p.add_argument("--simulate", action="store_true",
+                   help="run the built-in cluster simulator (dev mode)")
+    args = p.parse_args(argv)
+
+    svc = Service(
+        conf_path=args.scheduler_conf,
+        schedule_period=args.schedule_period,
+        simulate=args.simulate,
+        state_path=args.state_path,
+        checkpoint_period=args.checkpoint_period,
+        lease_path=args.lease_path,
+    )
+    port = svc.start(http_port=args.listen_port)
+    log.info("vtpu-service listening on 127.0.0.1:%d", port)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    try:
+        done.wait()
+    finally:
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
