@@ -1,0 +1,54 @@
+"""The shipped examples parse and run end-to-end."""
+
+import pathlib
+
+import yaml
+
+from volcano_tpu.framework import parse_scheduler_conf
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.service import job_from_dict
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def test_job_yaml_runs():
+    from volcano_tpu.api import Node
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.controllers import ControllerManager
+
+    data = yaml.safe_load((EXAMPLES / "job.yaml").read_text())
+    job = job_from_dict(data)
+    assert job.min_available == 3
+    assert job.tasks[0].replicas == 6
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "4", "memory": "8Gi"}))
+    cm = ControllerManager(store)
+    store.add_batch_job(job)
+    sched = Scheduler(store)
+    for _ in range(6):
+        cm.process()
+        sched.run_once()
+    assert len(store.binder.binds) == 6
+
+
+def test_dist_job_parses():
+    data = yaml.safe_load((EXAMPLES / "tensorflow-dist.yaml").read_text())
+    job = job_from_dict(data)
+    assert {t.name for t in job.tasks} == {"ps", "worker"}
+    assert "svc" in job.plugins
+
+
+def test_scheduler_confs_parse():
+    for name in ("scheduler-conf.yaml", "preempt-conf.yaml"):
+        conf = parse_scheduler_conf((EXAMPLES / name).read_text())
+        assert conf.actions
+        assert conf.tiers
+    conf = parse_scheduler_conf(
+        (EXAMPLES / "scheduler-conf.yaml").read_text()
+    )
+    binpack = [
+        o for t in conf.tiers for o in t.plugins if o.name == "binpack"
+    ][0]
+    assert binpack.arguments["binpack.weight"] == "10"
