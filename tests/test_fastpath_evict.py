@@ -1,0 +1,79 @@
+"""Fast-path preempt/reclaim parity with the object-session path."""
+
+import os
+
+import pytest
+
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import preempt_cluster, synthetic_cluster
+
+CONF_PREEMPT = """
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _run(store, fast: bool):
+    os.environ["VOLCANO_TPU_FASTPATH"] = "1" if fast else "0"
+    try:
+        Scheduler(store, conf_str=CONF_PREEMPT).run_once()
+    finally:
+        os.environ.pop("VOLCANO_TPU_FASTPATH", None)
+    return store
+
+
+def _state(store):
+    return (
+        dict(store.binder.binds),
+        sorted(store.evictor.evicts),
+        {uid: pg.status.phase
+         for uid, pg in sorted(store.pod_groups.items())},
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_preempt_parity(seed):
+    a = _run(preempt_cluster(n_nodes=8, n_pending=12, seed=seed), fast=False)
+    b = _run(preempt_cluster(n_nodes=8, n_pending=12, seed=seed), fast=True)
+    sa, sb = _state(a), _state(b)
+    assert sb[0] == sa[0]  # binds
+    assert sb[1] == sa[1]  # evictions
+    assert sb[2] == sa[2]  # phases
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_preempt_parity_multiqueue(seed):
+    kw = dict(n_nodes=10, n_pods=40, gang_size=4, n_queues=3,
+              queue_weights=(1, 2, 4), seed=seed)
+    a = _run(synthetic_cluster(**kw), fast=False)
+    b = _run(synthetic_cluster(**kw), fast=True)
+    sa, sb = _state(a), _state(b)
+    assert sb[0] == sa[0]
+    assert sb[1] == sa[1]
+    assert sb[2] == sa[2]
+
+
+def test_preempt_fast_path_used(monkeypatch):
+    import volcano_tpu.fastpath_evict as fe
+
+    called = {}
+    orig = fe.FastEvictor.preempt
+
+    def spy(self):
+        called["yes"] = True
+        return orig(self)
+
+    monkeypatch.setattr(fe.FastEvictor, "preempt", spy)
+    store = preempt_cluster(n_nodes=4, n_pending=6, seed=0)
+    Scheduler(store, conf_str=CONF_PREEMPT).run_once()
+    assert called.get("yes")

@@ -791,6 +791,19 @@ class StoreMirror:
         }
         self.__dict__.update(fresh.__dict__)
 
+    def resync_status(self, pods: Dict[str, "Pod"]) -> None:
+        """Re-derive every live row's dynamic state from the pod records
+        (the system of record).  Recovery path: a failed fast cycle may
+        leave uncommitted status mutations in the mirror."""
+        for uid, row in self.p_row.items():
+            pod = pods.get(uid)
+            if pod is None:
+                continue
+            self.p_status[row] = int(pod.task_status())
+            self.p_node[row] = (
+                self.n_row.get(pod.node_name, -1) if pod.node_name else -1
+            )
+
     # ---------------------------------------------------------- inspection
 
     @property

@@ -56,7 +56,7 @@ log = logging.getLogger(__name__)
 F = np.float32
 I = np.int32
 
-FAST_ACTIONS = {"enqueue", "allocate", "backfill"}
+FAST_ACTIONS = {"enqueue", "allocate", "backfill", "preempt", "reclaim"}
 FAST_PLUGINS = {
     "priority", "gang", "conformance", "drf", "proportion",
     "predicates", "nodeorder", "binpack",
@@ -515,15 +515,36 @@ class FastCycle:
         self.derive()
         self._proportion()
         self.new_conditions: Dict[int, PodGroupCondition] = {}
-        for name in self.action_names:
-            with metrics.action_timer(name):
-                if name == "enqueue":
-                    self._enqueue()
-                elif name == "allocate":
-                    self._allocate()
-                elif name == "backfill":
-                    self._backfill()
+        self._evictor = None
+        try:
+            for name in self.action_names:
+                with metrics.action_timer(name):
+                    if name == "enqueue":
+                        self._enqueue()
+                    elif name == "allocate":
+                        self._allocate()
+                    elif name == "backfill":
+                        self._backfill()
+                    elif name == "preempt":
+                        self._evict_machinery().preempt()
+                    elif name == "reclaim":
+                        self._evict_machinery().reclaim()
+        except BaseException:
+            # A failed cycle may leave uncommitted status mutations in the
+            # mirror (evictions mid-statement); re-derive dynamic state
+            # from the pod records before the caller falls back.
+            self.m.resync_status(self.store.pods)
+            raise
+        if self._evictor is not None:
+            self._evictor.st.flush()
         self._close()
+
+    def _evict_machinery(self):
+        if self._evictor is None:
+            from .fastpath_evict import FastEvictor
+
+            self._evictor = FastEvictor(self)
+        return self._evictor
 
     # ------------------------------------------------------------- enqueue
 

@@ -1,0 +1,1118 @@
+"""Fast-path preempt + reclaim: the victim-selection actions over the
+array mirror.
+
+The object-path actions (``actions/preempt.py``, ``actions/reclaim.py``)
+walk every (preemptor x node x predicate) in Python — O(P x N) Python
+calls that take minutes at 10k nodes.  This module keeps the reference's
+control flow at task/victim granularity (the part that is inherently
+sequential: evictions change what later preemptors see) but evaluates the
+node-level math — predicates, scores, future-idle checks — as [N] numpy
+expressions over the FastCycle's derived arrays, exactly as SURVEY.md
+section 7 (M3) prescribes: victim-selection kernels over per-node victim
+prefix state.
+
+Semantics reproduced from preempt.go:41-262 / reclaim.go:40-189 +
+session_plugins.go:110-193 (tiered victim intersection):
+
+- preempt phase 1: per queue, job-ordered preemptors, statement-wrapped;
+  commit iff the job reaches Pipelined, else every eviction/pipeline of
+  the statement is rolled back (an undo log over the arrays).
+- preempt phase 2: intra-job task preemption, committed unconditionally.
+- reclaim: queue-ordered round-robin, immediate (unwrapped) evictions,
+  victims only from Reclaimable queues.
+- victim sets: tier-by-tier intersection across the enabled plugins
+  (priority / gang / conformance / drf for preempt; gang / proportion /
+  conformance for reclaim), stopping at the first tier boundary with a
+  non-empty set — including Go's nil-slice quirk (an initialized-empty
+  set keeps poisoning later tiers).
+- victims are evicted lowest-task-order-first until FutureIdle covers the
+  preemptor; the preemptor is pipelined onto the node.
+
+Pipelines are session-scoped (they never reach the store — the reference
+recomputes them each cycle); committed evictions mark the store pods
+deleting and dispatch the evictor, as ``cache.Evict`` does.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .api import PodGroupPhase, TaskStatus
+from .utils.priority_queue import PriorityQueue
+
+log = logging.getLogger(__name__)
+
+F = np.float32
+
+ST_PENDING = int(TaskStatus.Pending)
+ST_RUNNING = int(TaskStatus.Running)
+ST_RELEASING = int(TaskStatus.Releasing)
+
+SYSTEM_CLUSTER_CRITICAL = "system-cluster-critical"
+SYSTEM_NODE_CRITICAL = "system-node-critical"
+SYSTEM_NAMESPACE = "kube-system"
+
+
+class EvictState:
+    """Per-cycle state for the eviction actions (lazy, built on first
+    preempt/reclaim execution)."""
+
+    def __init__(self, cyc):
+        self.cyc = cyc
+        m = cyc.m
+        Pn, Nn, R = cyc.Pn, cyc.Nn, cyc.R
+        self.req = np.zeros((Pn, R), F)
+        self.init_req = np.zeros((Pn, R), F)
+        rows = np.flatnonzero(m.p_alive[:Pn])
+        if len(rows):
+            er, si, v = m.c_req.gather(rows)
+            self.req[rows[er], si] = v
+            er, si, v = m.c_init_req.gather(rows)
+            self.init_req[rows[er], si] = v
+        self.req_empty = (m.c_req.lens(np.arange(Pn)) == 0) if Pn else \
+            np.zeros(0, bool)
+        # Session-scoped node deltas.
+        self.n_pipelined = np.zeros((Nn, R), F)
+        self.pipelined_rows: List[int] = []  # rows pipelined this cycle
+        self.pipe_node = np.full(Pn, -1, np.int64)
+        self.j_waiting = np.zeros(cyc.Jn, np.int64)
+        # Critical (conformance-exempt) pods, resident rows only.
+        self.critical = np.zeros(Pn, bool)
+        pods = cyc.store.pods
+        for r in np.flatnonzero(cyc.resident):
+            uid = m.p_uid[r]
+            pod = pods.get(uid) if uid else None
+            if pod is None:
+                continue
+            if (
+                pod.priority_class in (SYSTEM_CLUSTER_CRITICAL,
+                                       SYSTEM_NODE_CRITICAL)
+                or pod.namespace == SYSTEM_NAMESPACE
+            ):
+                self.critical[r] = True
+        # Residents grouped per node, in row order (NodeInfo.tasks
+        # iteration order == pod arrival order).
+        self.node_rows: List[List[int]] = [[] for _ in range(Nn)]
+        node = m.p_node[:Pn]
+        for r in np.flatnonzero(cyc.resident):
+            self.node_rows[node[r]].append(int(r))
+        # Victim base vectors (resident, non-empty-request rows): the
+        # aggregate evictable caches build from these with numpy masks.
+        vr = np.flatnonzero(cyc.resident & ~self.req_empty[:Pn])
+        self.v_rows = vr
+        self.v_node = m.p_node[:Pn][vr].astype(np.int64)
+        self.v_job = m.p_job[:Pn][vr].astype(np.int64)
+        self.v_qi = np.where(
+            self.v_job >= 0, cyc.q_of_job[np.maximum(self.v_job, 0)], -1
+        )
+        self.v_req = self.req[vr]
+        # Committed evictions (flushed to the store at cycle end).
+        self.evicted_rows: List[int] = []
+        # Monotonic state version: bumped by every evict/unevict/
+        # pipeline/unpipeline; memoized shares key off it.
+        self.version = 0
+        # Callback (set by FastEvictor) keeping aggregate evictable-
+        # capacity caches incremental: on_change(row, sign).
+        self.on_change = None
+        # Per-job mutation stamps (DRF share memoization granularity).
+        self.j_version = np.zeros(cyc.Jn, np.int64)
+
+    # ------------------------------------------------------------ futures
+
+    def future_idle(self, n: int) -> np.ndarray:
+        c = self.cyc
+        return c.n_idle[n] + c.n_releasing[n] - self.n_pipelined[n]
+
+    # ------------------------------------------------------------- events
+
+    def evict(self, row: int, log_: Optional[list]) -> None:
+        """Session-level evict (session.go:334-380): Running -> Releasing;
+        node releasing grows; shares shrink."""
+        c = self.cyc
+        m = c.m
+        n = int(m.p_node[row])
+        req = self.req[row]
+        m.p_status[row] = ST_RELEASING
+        c.n_releasing[n] += req
+        jr = int(m.p_job[row])
+        if jr >= 0:
+            self.j_version[jr] += 1
+            c.j_cnt_alloc[jr] -= 1
+            c.j_cnt_run[jr] -= 1
+            c.j_cnt_releasing[jr] += 1
+            c.j_ready_base[jr] -= 1
+            c.j_alloc_res[jr] -= req
+            qi = c.q_of_job[jr]
+            if qi >= 0:
+                c.q_alloc[qi] -= req
+        self.version += 1
+        if self.on_change is not None:
+            self.on_change(row, -1)
+        if log_ is not None:
+            log_.append(("evict", row, n, jr))
+
+    def unevict(self, row: int, n: int, jr: int) -> None:
+        c = self.cyc
+        m = c.m
+        req = self.req[row]
+        m.p_status[row] = ST_RUNNING
+        c.n_releasing[n] -= req
+        if jr >= 0:
+            self.j_version[jr] += 1
+            c.j_cnt_alloc[jr] += 1
+            c.j_cnt_run[jr] += 1
+            c.j_cnt_releasing[jr] -= 1
+            c.j_ready_base[jr] += 1
+            c.j_alloc_res[jr] += req
+            qi = c.q_of_job[jr]
+            if qi >= 0:
+                c.q_alloc[qi] += req
+        self.version += 1
+        if self.on_change is not None:
+            self.on_change(row, 1)
+
+    def pipeline(self, row: int, n: int, log_: Optional[list]) -> None:
+        """Session-level pipeline: future capacity claim + share growth
+        (session.go:207-249)."""
+        c = self.cyc
+        m = c.m
+        req = self.req[row]
+        self.n_pipelined[n] += req
+        self.pipe_node[row] = n
+        c.n_ntasks[n] += 1
+        jr = int(m.p_job[row])
+        if jr >= 0:
+            self.j_version[jr] += 1
+            self.j_waiting[jr] += 1
+            c.j_cnt_pending[jr] -= 1
+            c.j_alloc_res[jr] += req
+            qi = c.q_of_job[jr]
+            if qi >= 0:
+                c.q_alloc[qi] += req
+        self.version += 1
+        self.pipelined_rows.append(row)
+        self.node_rows[n].append(row)
+        if log_ is not None:
+            log_.append(("pipeline", row, n, jr))
+
+    def unpipeline(self, row: int, n: int, jr: int) -> None:
+        c = self.cyc
+        m = c.m
+        req = self.req[row]
+        self.n_pipelined[n] -= req
+        self.pipe_node[row] = -1
+        c.n_ntasks[n] -= 1
+        if jr >= 0:
+            self.j_version[jr] += 1
+            self.j_waiting[jr] -= 1
+            c.j_cnt_pending[jr] += 1
+            c.j_alloc_res[jr] -= req
+            qi = c.q_of_job[jr]
+            if qi >= 0:
+                c.q_alloc[qi] -= req
+        self.version += 1
+        self.pipelined_rows.remove(row)
+        try:
+            self.node_rows[n].remove(row)
+        except ValueError:
+            pass
+
+    def rollback(self, log_: list) -> None:
+        for op in reversed(log_):
+            if op[0] == "evict":
+                _, row, n, jr = op
+                self.unevict(row, n, jr)
+            else:
+                _, row, n, jr = op
+                self.unpipeline(row, n, jr)
+
+    def commit(self, log_: list) -> None:
+        for op in log_:
+            if op[0] == "evict":
+                self.evicted_rows.append(op[1])
+
+    # -------------------------------------------------------- commit/store
+
+    def flush(self) -> None:
+        """Apply committed evictions to the store (cache.Evict semantics:
+        pod marked deleting, evictor dispatched)."""
+        c = self.cyc
+        m = c.m
+        store = c.store
+        for row in self.evicted_rows:
+            uid = m.p_uid[row]
+            pod = store.pods.get(uid) if uid else None
+            if pod is None:
+                continue
+            pod.deleting = True
+            try:
+                pod._mirror_feat = pod._mirror_feat  # keep feature cache
+            except Exception:
+                pass
+            store.evictor.evict(pod)
+            if store._watchers:
+                store._notify("Pod", "evict", pod)
+        if self.evicted_rows:
+            store.mark_objects_stale()
+
+
+class FastEvictor:
+    """Shared machinery for fast preempt + reclaim over one FastCycle."""
+
+    def __init__(self, cyc):
+        self.cyc = cyc
+        self.st = EvictState(cyc)
+        self._score_w = self._collect_score_args()
+        self._share_cache: Dict[int, tuple] = {}
+        self._qshare_cache: Dict[int, tuple] = {}
+        self._profile_scores: Dict[int, np.ndarray] = {}
+        self._profile_static: Dict[int, np.ndarray] = {}
+        self._evictable: Dict[tuple, np.ndarray] = {}
+        self.st.on_change = self._evictable_update
+        # Tier-ordered plugin-name lists per victim registry (precomputed:
+        # the per-victim intersection walks these thousands of times).
+        self._tiers_preempt = [
+            [o.name for o in t.plugins if o.enabled_preemptable]
+            for t in cyc.conf.tiers
+        ]
+        self._tiers_reclaim = [
+            [o.name for o in t.plugins if o.enabled_reclaimable]
+            for t in cyc.conf.tiers
+        ]
+
+    # -------------------------------------------------------------- session
+
+    def job_pipelined(self, jr: int) -> bool:
+        """Gang JobPipelined veto (gang.go: waiting + ready >= min)."""
+        c = self.cyc
+        if not c._has("gang"):
+            return True
+        return bool(
+            self.st.j_waiting[jr] + c.j_ready_base[jr] >= c.m.j_minav[jr]
+        )
+
+    # ------------------------------------------------------------ ordering
+
+    def _job_order_less(self, l: int, r: int) -> bool:
+        """Live tier-ordered job comparator (shares move during the
+        action, so keys cannot be frozen as in allocate)."""
+        c = self.cyc
+        m = c.m
+        for opt in c._tier_opts("enabled_job_order"):
+            if opt.name == "priority":
+                if m.j_prio[l] != m.j_prio[r]:
+                    return m.j_prio[l] > m.j_prio[r]
+            elif opt.name == "gang":
+                lr = c.j_ready_base[l] >= m.j_minav[l]
+                rr = c.j_ready_base[r] >= m.j_minav[r]
+                if lr != rr:
+                    return rr  # non-ready first
+            elif opt.name == "drf":
+                ls = self._drf_share(l)
+                rs = self._drf_share(r)
+                if ls != rs:
+                    return ls < rs
+        if m.j_create[l] != m.j_create[r]:
+            return m.j_create[l] < m.j_create[r]
+        return m.j_uid[l] < m.j_uid[r]
+
+    def _drf_share(self, jr: int) -> float:
+        cache = self._share_cache
+        hit = cache.get(jr)
+        if hit is not None and hit[0] == self.st.j_version[jr]:
+            return hit[1]
+        c = self.cyc
+        total = c.total_res
+        alloc = c.j_alloc_res[jr]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratio = np.where(total > 0, alloc / np.where(total > 0, total, 1),
+                             np.where(alloc > 0, 1.0, 0.0))
+        out = float(ratio.max()) if len(ratio) else 0.0
+        cache[jr] = (self.st.j_version[jr], out)
+        return out
+
+    def _queue_share(self, qi: int) -> float:
+        cache = self._qshare_cache
+        hit = cache.get(qi)
+        if hit is not None and hit[0] == self.st.version:
+            return hit[1]
+        c = self.cyc
+        des = c.q_deserved_res.get(qi)
+        if des is None:
+            return 0.0
+        alloc = c._res(c.q_alloc[qi])
+        s = 0.0
+        from .api.resource import share as _share
+
+        for rn in des.resource_names():
+            v = _share(alloc.get(rn), des.get(rn))
+            if v > s:
+                s = v
+        self._qshare_cache[qi] = (self.st.version, s)
+        return s
+
+    def _queue_order_less(self, l: str, r: str) -> bool:
+        c = self.cyc
+        has_prop = c._has("proportion") and any(
+            opt.name == "proportion"
+            for opt in c._tier_opts("enabled_queue_order")
+        )
+        if has_prop:
+            ls = self._queue_share(c.queue_index.get(l, -1))
+            rs = self._queue_share(c.queue_index.get(r, -1))
+            if ls != rs:
+                return ls < rs
+        lq = c.store.queues[l]
+        rq = c.store.queues[r]
+        if lq.queue.creation_timestamp != rq.queue.creation_timestamp:
+            return lq.queue.creation_timestamp < rq.queue.creation_timestamp
+        return lq.uid < rq.uid
+
+    def _task_rows_sorted(self, jr: int) -> List[int]:
+        """Pending task rows of a job, task-ordered."""
+        c = self.cyc
+        m = c.m
+        rows = np.flatnonzero(
+            m.p_alive[:c.Pn] & (c.jobr == jr)
+            & (m.p_status[:c.Pn] == ST_PENDING) & ~self.st.req_empty[:c.Pn]
+            & (self.st.pipe_node[:c.Pn] < 0)
+        )
+        prio_enabled = any(
+            opt.name == "priority"
+            for opt in c._tier_opts("enabled_task_order")
+        )
+        prio = -m.p_prio[rows] if prio_enabled else np.zeros(len(rows))
+        uids = np.array([m.p_uid[r] for r in rows])
+        order = np.lexsort((uids, m.p_create[rows], prio))
+        return [int(r) for r in rows[order]]
+
+    # ---------------------------------------------------------- predicates
+
+    def feasible_mask(self, row: int) -> np.ndarray:
+        """[N] host-predicate feasibility for one pending task
+        (predicates.go:144-293 minus resource fit).  Static parts
+        (selector / node affinity / taints) are cached per profile;
+        pod-count, ports, and inter-pod terms are live."""
+        c = self.cyc
+        m = c.m
+        N = c.Nn
+        if not c._has("predicates"):
+            return c.n_alive.copy()
+        feat = m.p_feat[row]
+        pod = c.store.pods.get(m.p_uid[row])
+        if pod is None:
+            return np.zeros(N, bool)
+        pidr = int(m.p_prof[row])
+        static = self._profile_static.get(pidr)
+        if static is None:
+            static = self._static_mask(feat)
+            self._profile_static[pidr] = static
+        ok = static & ((c.n_maxtasks <= 0) | (c.n_ntasks < c.n_maxtasks))
+        # Host ports.
+        if feat.ports:
+            myports = set(feat.ports)
+            for n in range(N):
+                if not ok[n]:
+                    continue
+                for r in self.st.node_rows[n]:
+                    f = m.p_feat[r]
+                    if f is not None and myports & set(f.ports):
+                        ok[n] = False
+                        break
+        # Inter-pod required affinity (domain-count based, live counts
+        # maintained by the allocate/preempt events this cycle are NOT
+        # consulted here: matches the host path, which checks resident
+        # node.tasks — evicted residents still count until deleted).
+        if feat.ip_req_aff or feat.ip_req_anti:
+            ok &= self._interpod_ok(row, feat)
+        return ok
+
+    def _static_mask(self, feat) -> np.ndarray:
+        c = self.cyc
+        m = c.m
+        ok = c.n_ready.copy()
+        labels_tbl = self._node_labels()
+        if feat.sel:
+            ok &= self._nodes_with_all(feat.sel, labels_tbl)
+        if feat.aff_alts:
+            any_alt = np.zeros(c.Nn, bool)
+            for alt in feat.aff_alts:
+                any_alt |= self._nodes_with_all(alt, labels_tbl)
+            ok &= any_alt
+        if len(m.taints):
+            tol_idx = self._tolerated(feat)
+            for k in range(len(m.taints.items)):
+                if k not in tol_idx:
+                    ok &= ~self._nodes_with_taint(k)
+        return ok
+
+    def _node_labels(self):
+        cache = getattr(self, "_labels_cache", None)
+        if cache is None:
+            m = self.cyc.m
+            cache = self._labels_cache = [
+                (m.node_objs[n].labels if m.node_objs[n] is not None else {})
+                for n in range(self.cyc.Nn)
+            ]
+        return cache
+
+    def _nodes_with_all(self, sel_idx: List[int], labels_tbl) -> np.ndarray:
+        m = self.cyc.m
+        key = ("sel", tuple(sorted(sel_idx)))
+        cache = getattr(self, "_mask_cache", None)
+        if cache is None:
+            cache = self._mask_cache = {}
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        pairs = [m.labels.items[i] for i in sel_idx]
+        out = np.fromiter(
+            (all(lbl.get(k) == v for k, v in pairs) for lbl in labels_tbl),
+            bool, count=len(labels_tbl),
+        )
+        cache[key] = out
+        return out
+
+    def _nodes_with_taint(self, k: int) -> np.ndarray:
+        cache = getattr(self, "_taint_cache", None)
+        if cache is None:
+            cache = self._taint_cache = {}
+        hit = cache.get(k)
+        if hit is not None:
+            return hit
+        m = self.cyc.m
+        tkey, tval, teff = m.taints.items[k]
+        out = np.fromiter(
+            (
+                any(t.key == tkey and t.value == tval and t.effect == teff
+                    for t in (m.node_objs[n].taints
+                              if m.node_objs[n] is not None else []))
+                for n in range(self.cyc.Nn)
+            ),
+            bool, count=self.cyc.Nn,
+        )
+        cache[k] = out
+        return out
+
+    def _tolerated(self, feat) -> set:
+        m = self.cyc.m
+        idx = set()
+        for k, (tkey, tval, teff) in enumerate(m.taints.items):
+            for tol in feat.tol:
+                if tol.operator == "Exists":
+                    key_ok = tol.key == "" or tol.key == tkey
+                else:
+                    key_ok = tol.key == tkey and tol.value == tval
+                if key_ok and (tol.effect == "" or tol.effect == teff):
+                    idx.add(k)
+                    break
+        return idx
+
+    def _interpod_ok(self, row: int, feat) -> np.ndarray:
+        """Required inter-pod (anti)affinity per node for one task, from
+        the term membership lists (resident pods incl. Releasing +
+        session pipelines, matching the host predicate)."""
+        c = self.cyc
+        m = c.m
+        N = c.Nn
+        node_dom = m.node_dom()
+        ok = np.ones(N, bool)
+        for e in feat.ip_req_aff:
+            dom_col = m.topo_keys.index.get(m.term_info[e][1], 0)
+            doms = node_dom[:N, dom_col]
+            counts = self._term_node_counts(e, row)
+            total = counts.sum()
+            if total == 0:
+                # self-match rule
+                jr = int(m.p_job[row])
+                juid = m.j_uid[jr] if jr >= 0 else ""
+                pod = c.store.pods.get(m.p_uid[row])
+                if pod is not None and m._term_matches(
+                    e, pod.namespace, pod.labels, juid or ""
+                ):
+                    continue
+                ok &= False
+                continue
+            ok &= np.where(doms >= 0, counts[np.maximum(doms, 0)] > 0, False)
+        for e in feat.ip_req_anti:
+            dom_col = m.topo_keys.index.get(m.term_info[e][1], 0)
+            doms = node_dom[:N, dom_col]
+            counts = self._term_node_counts(e, row)
+            ok &= ~np.where(doms >= 0, counts[np.maximum(doms, 0)] > 0,
+                            False)
+        return ok
+
+    def _term_node_counts(self, e: int, skip_row: int) -> np.ndarray:
+        """[D] resident-match counts per domain for term e (incl.
+        session pipelines, excl. the task itself)."""
+        c = self.cyc
+        m = c.m
+        D = max(1, len(m.domains))
+        counts = np.zeros(D, np.int64)
+        node_dom = m.node_dom()
+        dom_col = m.topo_keys.index.get(m.term_info[e][1], 0)
+        for r in m.term_members[e]:
+            if r == skip_row or r >= c.Pn:
+                continue
+            n = int(m.p_node[r]) if self.st.pipe_node[r] < 0 else \
+                int(self.st.pipe_node[r])
+            if n < 0:
+                continue
+            if not (c.resident[r] or self.st.pipe_node[r] >= 0):
+                continue
+            d = node_dom[n, dom_col]
+            if d >= 0:
+                counts[d] += 1
+        return counts
+
+    # -------------------------------------------------------------- scores
+
+    def _collect_score_args(self):
+        from .framework.arguments import Arguments
+
+        c = self.cyc
+        out = {"binpack": None, "nodeorder": None}
+        for opt in c._tier_opts("enabled_node_order"):
+            if opt.name in out and out[opt.name] is None:
+                out[opt.name] = Arguments(opt.arguments)
+        return out
+
+    def scores(self, row: int) -> np.ndarray:
+        """[N] additive node-order score (binpack.go:200-260 +
+        nodeorder.go:38-84), vectorized.  Cached per task profile:
+        node used/allocatable never change during preempt/reclaim
+        (evictions move resources to Releasing, not back to idle)."""
+        pidr = int(self.cyc.m.p_prof[row])
+        hit = self._profile_scores.get(pidr)
+        if hit is not None:
+            return hit
+        out = self._scores_uncached(row)
+        self._profile_scores[pidr] = out
+        return out
+
+    def _scores_uncached(self, row: int) -> np.ndarray:
+        c = self.cyc
+        N = c.Nn
+        req = self.st.req[row]
+        s = np.zeros(N, F)
+        bp = self._score_w.get("binpack")
+        if bp is not None:
+            weight = max(bp.get_int("binpack.weight", 1), 1)
+            w = np.zeros(c.R, F)
+            w[0] = max(bp.get_int("binpack.cpu", 1), 0)
+            w[1] = max(bp.get_int("binpack.memory", 1), 0)
+            for name in (bp.get("binpack.resources") or "").split(","):
+                name = name.strip()
+                idx = c.m.scalar_slots.index.get(name) if name else None
+                if idx is not None:
+                    w[2 + idx] = max(
+                        bp.get_int(f"binpack.resources.{name}", 1), 0
+                    )
+            used_f = c.n_used + req[None, :]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                per = np.where(
+                    (req[None, :] > 0) & (c.n_alloc > 0)
+                    & (used_f <= c.n_alloc) & (w[None, :] > 0),
+                    used_f * w[None, :] / np.where(c.n_alloc > 0,
+                                                   c.n_alloc, 1.0),
+                    0.0,
+                )
+            # weight_sum counts weights of requested-and-known resources.
+            wsum = float(w[req > 0].sum())
+            if wsum > 0:
+                s += per.sum(axis=1) / wsum * 10.0 * weight
+        no = self._score_w.get("nodeorder")
+        if no is not None:
+            least = no.get_int("leastrequested.weight", 1)
+            most = no.get_int("mostrequested.weight", 0)
+            balanced = no.get_int("balancedresource.weight", 1)
+            cap_cpu = c.n_alloc[:, 0]
+            cap_mem = c.n_alloc[:, 1]
+            req_cpu = c.n_used[:, 0] + req[0]
+            req_mem = c.n_used[:, 1] + req[1]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                if least:
+                    pc = np.where(cap_cpu > 0,
+                                  np.maximum(cap_cpu - req_cpu, 0)
+                                  * 10.0 / np.where(cap_cpu > 0, cap_cpu, 1),
+                                  0.0)
+                    pm = np.where(cap_mem > 0,
+                                  np.maximum(cap_mem - req_mem, 0)
+                                  * 10.0 / np.where(cap_mem > 0, cap_mem, 1),
+                                  0.0)
+                    s += (pc + pm) / 2.0 * least
+                if most:
+                    pc = np.where((cap_cpu > 0) & (req_cpu <= cap_cpu),
+                                  req_cpu * 10.0
+                                  / np.where(cap_cpu > 0, cap_cpu, 1), 0.0)
+                    pm = np.where((cap_mem > 0) & (req_mem <= cap_mem),
+                                  req_mem * 10.0
+                                  / np.where(cap_mem > 0, cap_mem, 1), 0.0)
+                    s += (pc + pm) / 2.0 * most
+                if balanced:
+                    cf = np.where(cap_cpu > 0, req_cpu
+                                  / np.where(cap_cpu > 0, cap_cpu, 1), 1.0)
+                    mf = np.where(cap_mem > 0, req_mem
+                                  / np.where(cap_mem > 0, cap_mem, 1), 1.0)
+                    bal = np.where((cf > 1.0) | (mf > 1.0), 0.0,
+                                   (1.0 - np.abs(cf - mf)) * 10.0)
+                    s += bal * balanced
+        return s
+
+    # ----------------------------------------------- evictable prefilter
+
+    def _le_rows(self, l: np.ndarray, r: np.ndarray) -> np.ndarray:
+        """Row-wise epsilon Resource.less_equal: l [R] vs r [N, R]."""
+        c = self.cyc
+        per = (
+            (l[None, :] < r)
+            | (np.abs(l[None, :] - r) < c.eps[None, :])
+            | (c.scalar_slot[None, :] & (l[None, :] <= c.eps[None, :]))
+        )
+        return per.all(axis=1)
+
+    def _key_qualifies(self, key: tuple, row: int, jr: int) -> bool:
+        """Would this Running victim row count toward the key's
+        aggregate?  (Upper bound: gang caps and conformance are checked
+        exactly downstream.)"""
+        c = self.cyc
+        m = c.m
+        kind = key[0]
+        if kind == "pq":
+            # Upper bound: own-job and higher-priority victims stay
+            # included (the exact walk filters them) so one cache serves
+            # every preemptor of the queue.
+            return m.j_queue[jr] == key[1]
+        if kind == "job":
+            return jr == key[1]
+        if kind == "rq":
+            if m.j_queue[jr] == key[1]:
+                return False
+            vq = c.store.queues.get(m.j_queue[jr])
+            return vq is not None and vq.reclaimable()
+        return False
+
+    def _evictable_for(self, key: tuple) -> np.ndarray:
+        arr = self._evictable.get(key)
+        if arr is not None:
+            return arr
+        c = self.cyc
+        m = c.m
+        st = self.st
+        mask = (m.p_status[:c.Pn][st.v_rows] == ST_RUNNING) & (st.v_job >= 0)
+        kind = key[0]
+        if kind == "pq":
+            qi = c.queue_index.get(key[1], -1)
+            mask &= st.v_qi == qi
+        elif kind == "job":
+            mask &= st.v_job == key[1]
+        elif kind == "rq":
+            qi = c.queue_index.get(key[1], -1)
+            reclaimable = np.zeros(c.Qn + 1, bool)
+            for name, i in c.queue_index.items():
+                q = c.store.queues.get(name)
+                reclaimable[i] = bool(q is not None and q.reclaimable())
+            mask &= (st.v_qi != qi) & (st.v_qi >= 0) \
+                & reclaimable[np.maximum(st.v_qi, 0)]
+        arr = np.zeros((c.Nn, c.R), F)
+        sel = np.flatnonzero(mask)
+        if len(sel):
+            np.add.at(arr, st.v_node[sel], st.v_req[sel])
+        self._evictable[key] = arr
+        return arr
+
+    def _evictable_update(self, row: int, sign: int) -> None:
+        c = self.cyc
+        m = c.m
+        jr = int(m.p_job[row])
+        if jr < 0:
+            return
+        n = int(m.p_node[row])
+        req = self.st.req[row]
+        for key, arr in self._evictable.items():
+            if self._key_qualifies(key, row, jr):
+                arr[n] += sign * req
+
+    # -------------------------------------------------------------- victims
+
+    def _victims(self, preemptor_row: int, cand: List[int],
+                 registry: str) -> List[int]:
+        """Tiered victim intersection (session_plugins.go:110-193)."""
+        c = self.cyc
+        victims: List[int] = []
+        init = False
+        tiers = (self._tiers_preempt if registry == "preempt"
+                 else self._tiers_reclaim)
+        for tier in tiers:
+            for pname in tier:
+                sel = self._plugin_victims(pname, preemptor_row, cand,
+                                           registry)
+                if sel is None:
+                    continue
+                if not init:
+                    victims = list(sel)
+                    init = True
+                else:
+                    keep = set(sel)
+                    victims = [v for v in victims if v in keep]
+            if victims:
+                return victims
+            if init:
+                return victims
+        return victims
+
+    def _plugin_victims(self, name: str, prow: int, cand: List[int],
+                        registry: str) -> Optional[List[int]]:
+        c = self.cyc
+        m = c.m
+        st = self.st
+        if name == "priority" and registry == "preempt":
+            pj = int(m.p_job[prow])
+            ppri = m.j_prio[pj] if pj >= 0 else 0
+            return [r for r in cand
+                    if m.j_prio[max(int(m.p_job[r]), 0)] < ppri
+                    and int(m.p_job[r]) >= 0]
+        if name == "gang":
+            occupied: Dict[int, int] = {}
+            out = []
+            for r in cand:
+                jr = int(m.p_job[r])
+                if jr < 0:
+                    continue
+                cnt = occupied.get(jr)
+                if cnt is None:
+                    cnt = int(c.j_ready_base[jr])
+                min_av = int(m.j_minav[jr])
+                if min_av <= cnt - 1 or min_av == 1:
+                    occupied[jr] = cnt - 1
+                    out.append(r)
+                else:
+                    occupied[jr] = cnt
+            return out
+        if name == "conformance":
+            return [r for r in cand if not st.critical[r]]
+        if name == "drf" and registry == "preempt":
+            pj = int(m.p_job[prow])
+            total = c.total_res
+            l_alloc = c.j_alloc_res[pj] + st.req[prow]
+            ls = self._share_of(l_alloc, total)
+            allocations: Dict[int, np.ndarray] = {}
+            out = []
+            for r in cand:
+                jr = int(m.p_job[r])
+                if jr not in allocations:
+                    allocations[jr] = c.j_alloc_res[jr].copy()
+                allocations[jr] = allocations[jr] - st.req[r]
+                rs = self._share_of(allocations[jr], total)
+                if ls < rs or abs(ls - rs) <= 1e-6:
+                    out.append(r)
+            return out
+        if name == "proportion" and registry == "reclaim":
+            from .api.resource import Resource
+
+            allocations: Dict[int, object] = {}
+            out = []
+            for r in cand:
+                jr = int(m.p_job[r])
+                qi = int(c.q_of_job[jr]) if jr >= 0 else -1
+                if qi < 0:
+                    continue
+                des = c.q_deserved_res.get(qi)
+                if des is None:
+                    continue
+                if qi not in allocations:
+                    allocations[qi] = c._res(c.q_alloc[qi])
+                allocated = allocations[qi]
+                victim_req = c._res(st.req[r])
+                if allocated.less(victim_req):
+                    continue
+                allocated.sub(victim_req)
+                if des.less_equal_strict(allocated):
+                    out.append(r)
+            return out
+        return None
+
+    @staticmethod
+    def _share_of(alloc: np.ndarray, total: np.ndarray) -> float:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ratio = np.where(total > 0, alloc / np.where(total > 0, total, 1),
+                             np.where(alloc > 0, 1.0, 0.0))
+        return float(ratio.max()) if len(ratio) else 0.0
+
+    # ------------------------------------------------------------- preempt
+
+    def _try_preempt(self, prow: int, cand_filter, stmt: Optional[list],
+                     evict_key: tuple) -> bool:
+        """One preemptor against all nodes (preempt.go:183-262)."""
+        c = self.cyc
+        m = c.m
+        st = self.st
+        eps = c.eps
+        scalar = c.scalar_slot
+        from .fastpath import _vec_le
+
+        init_req = st.init_req[prow]
+        feasible = self.feasible_mask(prow)
+        # Necessary-condition prefilter: the node's future idle plus ALL
+        # its in-scope victims' resources must cover the preemptor —
+        # otherwise the exact walk below cannot succeed there.
+        ev = self._evictable_for(evict_key)
+        fi = c.n_idle + c.n_releasing - st.n_pipelined
+        feasible = feasible & self._le_rows(init_req, fi + ev)
+        rows_f = np.flatnonzero(feasible & c.n_alive)
+        if not len(rows_f):
+            return False
+        sc = self.scores(prow)[rows_f]
+        order = rows_f[np.argsort(-sc, kind="stable")]
+        for n in order:
+            cand = [r for r in st.node_rows[n]
+                    if m.p_status[r] == ST_RUNNING
+                    and not st.req_empty[r] and cand_filter(r)]
+            if not cand:
+                continue
+            victims = self._victims(prow, cand, "preempt")
+            if not victims:
+                continue
+            # validate_victims: victims' resources must suffice.
+            fut = st.future_idle(n)
+            vsum = st.req[victims].sum(axis=0)
+            if not _vec_le(init_req, fut + vsum, eps, scalar):
+                continue
+            # Evict lowest task order first: inverse of task_order.
+            prio_enabled = any(
+                opt.name == "priority"
+                for opt in c._tier_opts("enabled_task_order")
+            )
+            vp = [(-int(m.p_prio[r]) if prio_enabled else 0,
+                   m.p_create[r], m.p_uid[r], r) for r in victims]
+            vp.sort(reverse=True)  # lowest order popped first
+            for _pk, _ck, _uk, r in vp:
+                if _vec_le(init_req, st.future_idle(n), eps, scalar):
+                    break
+                st.evict(r, stmt)
+            if _vec_le(init_req, st.future_idle(n), eps, scalar):
+                st.pipeline(prow, int(n), stmt)
+                return True
+        return False
+
+    def preempt(self) -> None:
+        """preempt.go:41-177."""
+        c = self.cyc
+        m = c.m
+        st = self.st
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        tasks_map: Dict[int, List[int]] = {}
+        under_request: List[int] = []
+        queue_seq: List[str] = []
+        seen_q = set()
+        for jr in self._schedulable_jobs():
+            qname = m.j_queue[jr]
+            if qname not in seen_q:
+                seen_q.add(qname)
+                queue_seq.append(qname)
+            pending = self._task_rows_sorted(jr)
+            if pending and not self.job_pipelined(jr):
+                preemptors_map.setdefault(
+                    qname, PriorityQueue(self._job_order_less)
+                ).push(jr)
+                under_request.append(jr)
+                tasks_map[jr] = pending
+        for qname in queue_seq:
+            preemptors = preemptors_map.get(qname)
+            # Phase 1: inter-job preemption within the queue.
+            while preemptors is not None and not preemptors.empty():
+                jr = preemptors.pop()
+                stmt: list = []
+                assigned = False
+                tasks = tasks_map.get(jr, [])
+                while True:
+                    if self.job_pipelined(jr):
+                        break
+                    if not tasks:
+                        break
+                    prow = tasks.pop(0)
+                    pq = m.j_queue[jr]
+
+                    def job_filter(r: int) -> bool:
+                        vjr = int(m.p_job[r])
+                        if vjr < 0:
+                            return False
+                        return (m.j_queue[vjr] == pq) and vjr != jr
+
+                    if self._try_preempt(prow, job_filter, stmt,
+                                          ("pq", pq)):
+                        assigned = True
+                if self.job_pipelined(jr):
+                    st.commit(stmt)
+                else:
+                    st.rollback(stmt)
+                    continue
+                if assigned:
+                    preemptors.push(jr)
+            # Phase 2: intra-job task preemption (the reference iterates
+            # ALL under-request jobs inside each queue pass; the shared
+            # task lists make it drain once).
+            for jr in under_request:
+                tasks = tasks_map.get(jr, [])
+                while tasks:
+                    prow = tasks.pop(0)
+                    stmt2: list = []
+
+                    def task_filter(r: int) -> bool:
+                        return int(m.p_job[r]) == jr
+
+                    assigned = self._try_preempt(
+                        prow, task_filter, stmt2, ("job", jr)
+                    )
+                    st.commit(stmt2)
+                    if not assigned:
+                        break
+
+    def _schedulable_jobs(self) -> List[int]:
+        c = self.cyc
+        m = c.m
+        out = []
+        for jr in c.session_jobs:
+            pg = c.store.pod_groups.get(m.j_uid[jr])
+            if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
+                continue
+            if c._has("gang") and c.j_valid[jr] < m.j_minav[jr]:
+                continue
+            if m.j_queue[jr] not in c.store.queues:
+                continue
+            out.append(jr)
+        return out
+
+    # ------------------------------------------------------------- reclaim
+
+    def _reclaim_possible(self, qname: str) -> bool:
+        """True when some OTHER reclaimable queue still has slack above
+        its deserved share (necessary for any proportion-admitted victim;
+        trivially true when proportion is not in the reclaim tiers)."""
+        c = self.cyc
+        # The veto only gates when proportion sits in the FIRST tier that
+        # contains any reclaimable-registered plugin: an earlier tier
+        # producing victims stops the walk before proportion is consulted
+        # (session_plugins.go tier-boundary semantics).
+        registered = {"gang", "conformance", "proportion"}
+        first = next(
+            (t for t in self._tiers_reclaim if registered & set(t)), None
+        )
+        if first is None or "proportion" not in first:
+            return True
+        cache = getattr(self, "_reclaim_poss_cache", None)
+        if cache is not None and cache[0] == self.st.version:
+            verdicts = cache[1]
+        else:
+            verdicts = {}
+            self._reclaim_poss_cache = (self.st.version, verdicts)
+        hit = verdicts.get(qname)
+        if hit is not None:
+            return hit
+        out = False
+        for name, qi in c.queue_index.items():
+            if name == qname:
+                continue
+            q = c.store.queues.get(name)
+            if q is None or not q.reclaimable():
+                continue
+            des = c.q_deserved_res.get(qi)
+            if des is None:
+                continue
+            if des.less_equal_strict(c._res(c.q_alloc[qi])):
+                out = True
+                break
+        verdicts[qname] = out
+        return out
+
+    def reclaim(self) -> None:
+        """reclaim.go:40-189: cross-queue eviction, immediate."""
+        c = self.cyc
+        m = c.m
+        st = self.st
+        from .fastpath import _vec_le
+
+        queues_pq = PriorityQueue(self._queue_order_less)
+        seen_q = set()
+        jobs_map: Dict[str, PriorityQueue] = {}
+        tasks_map: Dict[int, List[int]] = {}
+        for jr in self._schedulable_jobs():
+            qname = m.j_queue[jr]
+            if qname not in seen_q:
+                seen_q.add(qname)
+                queues_pq.push(qname)
+            pending = self._task_rows_sorted(jr)
+            if pending:
+                jobs_map.setdefault(
+                    qname, PriorityQueue(self._job_order_less)
+                ).push(jr)
+                tasks_map[jr] = pending
+
+        overused = c._overused_fn()
+        while not queues_pq.empty():
+            qname = queues_pq.pop()
+            if overused(c.store.queues[qname]):
+                continue
+            jobs = jobs_map.get(qname)
+            if jobs is None or jobs.empty():
+                continue
+            jr = jobs.pop()
+            tasks = tasks_map.get(jr, [])
+            if not tasks:
+                continue
+            prow = tasks.pop(0)
+
+            assigned = False
+            if not self._reclaim_possible(qname):
+                # Necessary condition: proportion only admits a victim
+                # while its queue stays at/above deserved after the
+                # eviction; once no reclaimable queue has slack, no node
+                # can yield victims (proportion.go:209-211) — skip the
+                # node walk wholesale.
+                queues_pq.push(qname)
+                continue
+            feasible = self.feasible_mask(prow)
+            init_req = st.init_req[prow]
+            # Reclaim requires the NEWLY reclaimed resources alone to
+            # cover the task (reclaim.go:166-168: `resreq.less_equal(
+            # reclaimed)`), so the prefilter is on evictable capacity
+            # only — exhausted nodes drop out as their victims go.
+            ev = self._evictable_for(("rq", qname))
+            feasible = feasible & self._le_rows(init_req, ev)
+            for n in np.flatnonzero(feasible & c.n_alive):
+                n = int(n)
+                cand = []
+                for r in st.node_rows[n]:
+                    if m.p_status[r] != ST_RUNNING or st.req_empty[r]:
+                        continue
+                    vjr = int(m.p_job[r])
+                    if vjr < 0 or m.j_queue[vjr] == qname:
+                        continue
+                    vq = c.store.queues.get(m.j_queue[vjr])
+                    if vq is None or not vq.reclaimable():
+                        continue
+                    cand.append(r)
+                victims = self._victims(prow, cand, "reclaim")
+                if not victims:
+                    continue
+                fut = st.future_idle(n)
+                vsum = st.req[victims].sum(axis=0)
+                if not _vec_le(init_req, fut + vsum, c.eps, c.scalar_slot):
+                    continue
+                reclaimed = np.zeros(c.R, F)
+                for r in victims:
+                    st.evict(r, None)
+                    st.evicted_rows.append(r)
+                    reclaimed += st.req[r]
+                    if _vec_le(init_req, reclaimed, c.eps, c.scalar_slot):
+                        break
+                if _vec_le(init_req, reclaimed, c.eps, c.scalar_slot):
+                    st.pipeline(prow, n, None)
+                    assigned = True
+                    break
+            if assigned:
+                jobs.push(jr)
+            queues_pq.push(qname)
