@@ -58,6 +58,14 @@ class FakeBinder:
                 self.binds[key] = hostname
                 self.channel.append(key)
 
+    def bind_keys(self, keys, hostnames) -> None:
+        """Key-level batched dispatch: the caller supplies precomputed
+        "ns/name" keys, so the whole batch lands via C-level dict/list
+        operations."""
+        with self._lock:
+            self.binds.update(zip(keys, hostnames))
+            self.channel.extend(keys)
+
 
 class FakeEvictor:
     """Records evictions (test_utils.go:119-143)."""

@@ -200,6 +200,7 @@ class StoreMirror:
         # ------------------------------------------------------- pod table
         cap = 1024
         self.p_uid: List[Optional[str]] = []
+        self.p_key: List[str] = []  # "ns/name" bind key per row
         self.p_feat: List[Optional[_PodFeat]] = []
         self.p_row: Dict[str, int] = {}
         self.p_status = np.zeros(cap, np.int16)
@@ -487,6 +488,7 @@ class StoreMirror:
             self.remove_pod(pod.uid)
         row = len(self.p_uid)
         self.p_uid.append(pod.uid)
+        self.p_key.append(f"{pod.namespace}/{pod.name}")
         self.p_feat.append(feat)
         self.p_row[pod.uid] = row
         n = row + 1
@@ -739,6 +741,7 @@ class StoreMirror:
         for r in live:
             uid = old.p_uid[r]
             fresh.p_uid.append(uid)
+            fresh.p_key.append(old.p_key[r])
             fresh.p_feat.append(old.p_feat[r])
             fresh.p_row[uid] = len(fresh.p_uid) - 1
         n = len(live)

@@ -1409,26 +1409,31 @@ class FastCycle:
         # Pod records + bind dispatch (async in the reference,
         # cache.go:536-552; here one batched dispatch).
         binder = store.binder
-        bind_batch = getattr(binder, "bind_batch", None)
+        bind_keys = getattr(binder, "bind_keys", None)
         pods = store.pods
         notify = store._watchers
-        pairs = []
         n_name = m.n_name
+        p_uid = m.p_uid
+        p_key = m.p_key
+        keys = []
+        hosts = []
+        bound_pods = []
         for row, nrow in zip(rows.tolist(), nodes_c.tolist()):
-            uid = m.p_uid[row]
-            pod = pods.get(uid)
+            pod = pods.get(p_uid[row])
             if pod is None:
                 continue
             hostname = n_name[nrow]
             pod.node_name = hostname
-            pairs.append((pod, hostname))
-        if bind_batch is not None:
-            bind_batch(pairs)
+            keys.append(p_key[row])
+            hosts.append(hostname)
+            bound_pods.append(pod)
+        if bind_keys is not None:
+            bind_keys(keys, hosts)
         else:
-            for pod, hostname in pairs:
+            for pod, hostname in zip(bound_pods, hosts):
                 binder.bind(pod, hostname)
         if notify:
-            for pod, _ in pairs:
+            for pod in bound_pods:
                 store._notify("Pod", "bind", pod)
 
         store.mark_objects_stale()
