@@ -1,0 +1,13 @@
+# Container image for the vtpu-service control plane (the reference ships
+# vc-scheduler / vc-controller-manager / vc-webhook-manager images via its
+# installer; the rebuild packs the combined daemon + CLI into one image).
+FROM python:3.12-slim
+
+WORKDIR /opt/volcano-tpu
+COPY pyproject.toml README.md ./
+COPY volcano_tpu ./volcano_tpu
+RUN pip install --no-cache-dir .
+
+EXPOSE 11250
+ENTRYPOINT ["vtpu-service"]
+CMD ["--listen-port", "11250", "--state-path", "/var/lib/vtpu/state.ckpt"]

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Dev-cluster bring-up (the analog of hack/local-up-volcano.sh): starts the
+# control plane with the built-in cluster simulator, registers a few nodes,
+# and submits the example job.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${PORT:-11250}"
+python -m volcano_tpu.service --simulate --listen-port "$PORT" &
+SVC_PID=$!
+trap 'kill $SVC_PID 2>/dev/null || true' EXIT
+sleep 2
+
+for i in 0 1 2; do
+  curl -fsS -X POST "http://127.0.0.1:$PORT/apis/nodes" \
+    -d "{\"name\": \"node-$i\", \"allocatable\": {\"cpu\": \"8\", \"memory\": \"16Gi\"}}" \
+    >/dev/null 2>&1 || true
+done
+
+python -m volcano_tpu.cli --server "http://127.0.0.1:$PORT" \
+  job run -f examples/job.yaml
+sleep 3
+python -m volcano_tpu.cli --server "http://127.0.0.1:$PORT" job list
+echo "control plane on http://127.0.0.1:$PORT (ctrl-c to stop)"
+wait $SVC_PID

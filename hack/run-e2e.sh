@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# E2E suite against the simulated cluster (the rebuild's kind analog,
+# hack/run-e2e-kind.sh): full control-plane + scheduler + fake kubelet.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
+  tests/test_admission_cli.py tests/test_examples.py -q "$@"
