@@ -6,8 +6,10 @@ FROM python:3.12-slim
 WORKDIR /opt/volcano-tpu
 COPY pyproject.toml README.md ./
 COPY volcano_tpu ./volcano_tpu
-RUN pip install --no-cache-dir .
+RUN pip install --no-cache-dir . && mkdir -p /var/lib/vtpu
 
+VOLUME /var/lib/vtpu
 EXPOSE 11250
 ENTRYPOINT ["vtpu-service"]
-CMD ["--listen-port", "11250", "--state-path", "/var/lib/vtpu/state.ckpt"]
+CMD ["--bind-address", "0.0.0.0", "--listen-port", "11250", \
+     "--state-path", "/var/lib/vtpu/state.ckpt"]

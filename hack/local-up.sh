@@ -9,12 +9,18 @@ PORT="${PORT:-11250}"
 python -m volcano_tpu.service --simulate --listen-port "$PORT" &
 SVC_PID=$!
 trap 'kill $SVC_PID 2>/dev/null || true' EXIT
-sleep 2
+
+# Wait for the HTTP server (jax import can take a while on first start).
+for _ in $(seq 1 60); do
+  curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
+  sleep 1
+done
+curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null
 
 for i in 0 1 2; do
   curl -fsS -X POST "http://127.0.0.1:$PORT/apis/nodes" \
     -d "{\"name\": \"node-$i\", \"allocatable\": {\"cpu\": \"8\", \"memory\": \"16Gi\"}}" \
-    >/dev/null 2>&1 || true
+    >/dev/null
 done
 
 python -m volcano_tpu.cli --server "http://127.0.0.1:$PORT" \

@@ -224,3 +224,39 @@ def main(argv=None) -> int:
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+# ---------------------------------------------------------------- v-binaries
+# Standalone entry points mirroring the reference's vsub/vjobs/vqueues/
+# vcancel/vsuspend/vresume binaries (cmd/cli/ subdirs): each is the
+# corresponding subcommand with the same flags.
+
+def _shim(prefix):
+    def entry(argv=None):
+        args = list(sys.argv[1:] if argv is None else argv)
+        # --server is a root-parser flag: lift it in front of the
+        # injected subcommand; everything else stays behind it.
+        pre, rest = [], []
+        i = 0
+        while i < len(args):
+            a = args[i]
+            if a == "--server" and i + 1 < len(args):
+                pre.extend(args[i:i + 2])
+                i += 2
+                continue
+            if a.startswith("--server="):
+                pre.append(a)
+            else:
+                rest.append(a)
+            i += 1
+        return main(pre + prefix + rest)
+
+    return entry
+
+
+vsub = _shim(["job", "run"])
+vjobs = _shim(["job", "list"])
+vcancel = _shim(["job", "delete"])
+vsuspend = _shim(["job", "suspend"])
+vresume = _shim(["job", "resume"])
+vqueues = _shim(["queue", "list"])

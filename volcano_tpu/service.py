@@ -150,7 +150,8 @@ class Service:
 
     # ----------------------------------------------------------------- loops
 
-    def start(self, http_port: int = 11250) -> int:
+    def start(self, http_port: int = 11250,
+              bind_address: str = "127.0.0.1") -> int:
         self.scheduler.run()
         t = threading.Thread(target=self._controller_loop, daemon=True)
         t.start()
@@ -168,7 +169,7 @@ class Service:
             )
             et.start()
             self._threads.append(et)
-        port = self._start_http(http_port)
+        port = self._start_http(http_port, bind_address)
         return port
 
     def is_leader(self) -> bool:
@@ -215,7 +216,8 @@ class Service:
 
     # ------------------------------------------------------------------ http
 
-    def _start_http(self, port: int) -> int:
+    def _start_http(self, port: int,
+                    bind_address: str = "127.0.0.1") -> int:
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -340,7 +342,7 @@ class Service:
                 except Exception as err:  # pragma: no cover
                     self._json(500, {"error": str(err)})
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = ThreadingHTTPServer((bind_address, port), Handler)
         actual_port = self._httpd.server_address[1]
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
@@ -359,6 +361,8 @@ def main(argv=None) -> int:
                    help="scheduler YAML config path (hot-reloaded per cycle)")
     p.add_argument("--schedule-period", type=float, default=1.0)
     p.add_argument("--listen-port", type=int, default=11250)
+    p.add_argument("--bind-address", default="127.0.0.1",
+                   help="HTTP bind address (0.0.0.0 for containers)")
     p.add_argument("--state-path", default=None,
                    help="checkpoint file; loaded on start, saved periodically")
     p.add_argument("--checkpoint-period", type=float, default=30.0)
@@ -376,8 +380,9 @@ def main(argv=None) -> int:
         checkpoint_period=args.checkpoint_period,
         lease_path=args.lease_path,
     )
-    port = svc.start(http_port=args.listen_port)
-    log.info("vtpu-service listening on 127.0.0.1:%d", port)
+    port = svc.start(http_port=args.listen_port,
+                     bind_address=args.bind_address)
+    log.info("vtpu-service listening on %s:%d", args.bind_address, port)
     done = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: done.set())
     signal.signal(signal.SIGINT, lambda *_: done.set())
