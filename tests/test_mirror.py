@@ -59,6 +59,7 @@ tiers:
     from volcano_tpu.framework import parse_scheduler_conf
 
     store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+    assert fp.FastCycle(store, parse_scheduler_conf(conf)).eligible()
     parsed = parse_scheduler_conf(conf_custom)
     assert not fp.FastCycle(store, parsed).eligible()
     Scheduler(store, conf_str=conf_custom).run_once()

@@ -664,53 +664,37 @@ def _solve_wave(
                     uses_selfok = (
                         req_aff_t & selfok_t & (cval_t == 0)
                     )  # [W, EW]
-                    selfok_hit = jnp.matmul(
-                        uses_selfok.astype(f32), gives.astype(f32).T
-                    ) > 0
-                    if EW * W * W <= (1 << 27):
-                        hit = (
-                            involved[:, None, :] & gives[None, :, :]
-                            & (dw[:, None, :] == dw[None, :, :])
-                        )
-                        aff_pair = jnp.any(hit, axis=-1)
-                    else:
-                        # Large term tables: chunk the E axis to bound the
-                        # [W, W, C] intermediate.
-                        C = max(1, (1 << 27) // (W * W))
-                        EC = (EW + C - 1) // C
-                        e_pad = EC * C - EW
-                        inv_p = jnp.pad(involved, ((0, 0), (0, e_pad)))
-                        giv_p = jnp.pad(gives, ((0, 0), (0, e_pad)))
-                        dw_p = jnp.pad(
-                            dw, ((0, 0), (0, e_pad)), constant_values=-1
-                        )
-
-                        def chunk_body(ci, acc):
-                            lo = ci * C
-                            inv_c = jax.lax.dynamic_slice_in_dim(
-                                inv_p, lo, C, 1
-                            )
-                            giv_c = jax.lax.dynamic_slice_in_dim(
-                                giv_p, lo, C, 1
-                            )
-                            dw_c = jax.lax.dynamic_slice_in_dim(
-                                dw_p, lo, C, 1
-                            )
-                            hit = (
-                                inv_c[:, None, :] & giv_c[None, :, :]
-                                & (dw_c[:, None, :] == dw_c[None, :, :])
-                                & (dw_c[None, :, :] >= 0)
-                            )
-                            return acc | jnp.any(hit, axis=-1)
-
-                        aff_pair = jax.lax.fori_loop(
-                            0, EC, chunk_body, jnp.zeros((W, W), bool)
-                        )
-                    aff_conf = jnp.any(
-                        tril & live[None, :] & (aff_pair | selfok_hit),
-                        axis=1,
+                    # Pair conflicts via scatter-min over (term, domain)
+                    # keys instead of an O(W^2 * EW) pair tensor: task i
+                    # conflicts iff some earlier live giver shares one of
+                    # i's involved (term, domain) keys — i.e. the minimum
+                    # giver index of the key is < i.  Self-match users
+                    # conflict with ANY earlier giver of the term (any
+                    # domain), via a per-term scatter-min.
+                    jidx = jnp.arange(W, dtype=jnp.int32)
+                    gmask = gives & live[:, None]  # [W, EW]
+                    keyv = term_arange[None, :] * D + jnp.maximum(dw, 0)
+                    scratch = EW * D
+                    keys_g = jnp.where(gmask, keyv, scratch)
+                    gm = (
+                        jnp.full((EW * D + 1,), W, jnp.int32)
+                        .at[keys_g.reshape(-1)]
+                        .min(jnp.broadcast_to(
+                            jidx[:, None], (W, EW)
+                        ).reshape(-1))
                     )
-                    clean &= ~aff_conf
+                    conflict_dom = jnp.any(
+                        involved & (gm[keyv] < jidx[:, None]), axis=1
+                    )
+                    # Per-term giver minimum: every gives entry has a
+                    # domain, so the min over domains of gm is exactly the
+                    # per-term scatter-min — no second scatter needed.
+                    gt = gm[:EW * D].reshape(EW, D).min(axis=1)
+                    conflict_self = jnp.any(
+                        uses_selfok
+                        & (gt[None, :] < jidx[:, None]), axis=1
+                    )
+                    clean &= ~(conflict_dom | conflict_self)
 
                 acc_alloc = clean & fits_idle
                 if has_future:
