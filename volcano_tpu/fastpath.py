@@ -140,6 +140,7 @@ class FastCycle:
             a.strip() for a in conf.actions.split(",") if a.strip()
         ]
         self.plugin_opts: Dict[str, object] = {}
+        self._tier_opts_cache: Dict[str, list] = {}
         for tier in conf.tiers:
             for opt in tier.plugins:
                 self.plugin_opts.setdefault(opt.name, opt)
@@ -159,10 +160,18 @@ class FastCycle:
         return True
 
     def _tier_opts(self, flag: str):
-        for tier in self.conf.tiers:
-            for opt in tier.plugins:
-                if getattr(opt, flag, None):
-                    yield opt
+        # Config is immutable for the cycle; the evict comparators consult
+        # this hundreds of thousands of times, so cache per flag.
+        cache = self._tier_opts_cache
+        hit = cache.get(flag)
+        if hit is None:
+            hit = cache[flag] = [
+                opt
+                for tier in self.conf.tiers
+                for opt in tier.plugins
+                if getattr(opt, flag, None)
+            ]
+        return hit
 
     def _has(self, name: str) -> bool:
         return name in self.plugin_opts
@@ -712,9 +721,21 @@ class FastCycle:
                 result = solve_fn(*inputs, pid=pid, profiles=profiles)
             else:
                 result = solve_fn(*inputs)
-            assigned = np.asarray(result.assigned)[:len(task_rows)]
-            never_ready = np.asarray(result.never_ready)
-            fit_failed = np.asarray(result.fit_failed)
+            # One batched device->host fetch: through a remote-TPU tunnel
+            # each fetch RPC carries ~100 ms fixed latency, so three
+            # sequential np.asarray() calls triple the cycle's floor.
+            import jax
+
+            for arr in (result.assigned, result.never_ready,
+                        result.fit_failed):
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+            assigned, never_ready, fit_failed = jax.device_get(
+                (result.assigned, result.never_ready, result.fit_failed)
+            )
+            assigned = assigned[:len(task_rows)]
             metrics.device_solve_latency.observe(
                 (time.perf_counter() - t0) * 1e3
             )

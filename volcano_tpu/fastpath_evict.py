@@ -271,6 +271,7 @@ class FastEvictor:
         self._profile_scores: Dict[int, np.ndarray] = {}
         self._profile_static: Dict[int, np.ndarray] = {}
         self._evictable: Dict[tuple, np.ndarray] = {}
+        self._rq_keys: List[tuple] = []
         self.st.on_change = self._evictable_update
         # Tier-ordered plugin-name lists per victim registry (precomputed:
         # the per-victim intersection walks these thousands of times).
@@ -282,6 +283,31 @@ class FastEvictor:
             [o.name for o in t.plugins if o.enabled_reclaimable]
             for t in cyc.conf.tiers
         ]
+        # Comparator hot-path constants (config is static for the cycle).
+        self._job_order_names = [
+            o.name for o in cyc._tier_opts("enabled_job_order")
+        ]
+        self._task_prio_enabled = any(
+            o.name == "priority" for o in cyc._tier_opts("enabled_task_order")
+        )
+        # Per-job pending rows, task-ordered, built in one grouped pass
+        # (replaces a full pod-axis scan per job).
+        self._job_pending: Dict[int, List[int]] = {}
+        c = cyc
+        m = c.m
+        rows = np.flatnonzero(
+            m.p_alive[:c.Pn] & (m.p_status[:c.Pn] == ST_PENDING)
+            & ~self.st.req_empty[:c.Pn] & (self.st.pipe_node[:c.Pn] < 0)
+        )
+        if len(rows):
+            prio = (-m.p_prio[rows] if self._task_prio_enabled
+                    else np.zeros(len(rows)))
+            uids = np.array([m.p_uid[r] for r in rows])
+            order = np.lexsort((uids, m.p_create[rows], prio))
+            for r in rows[order]:
+                self._job_pending.setdefault(
+                    int(c.jobr[r]), []
+                ).append(int(r))
 
     # -------------------------------------------------------------- session
 
@@ -301,16 +327,18 @@ class FastEvictor:
         action, so keys cannot be frozen as in allocate)."""
         c = self.cyc
         m = c.m
-        for opt in c._tier_opts("enabled_job_order"):
-            if opt.name == "priority":
-                if m.j_prio[l] != m.j_prio[r]:
-                    return m.j_prio[l] > m.j_prio[r]
-            elif opt.name == "gang":
+        for name in self._job_order_names:
+            if name == "priority":
+                lp = m.j_prio[l]
+                rp = m.j_prio[r]
+                if lp != rp:
+                    return lp > rp
+            elif name == "gang":
                 lr = c.j_ready_base[l] >= m.j_minav[l]
                 rr = c.j_ready_base[r] >= m.j_minav[r]
                 if lr != rr:
                     return rr  # non-ready first
-            elif opt.name == "drf":
+            elif name == "drf":
                 ls = self._drf_share(l)
                 rs = self._drf_share(r)
                 if ls != rs:
@@ -372,22 +400,14 @@ class FastEvictor:
         return lq.uid < rq.uid
 
     def _task_rows_sorted(self, jr: int) -> List[int]:
-        """Pending task rows of a job, task-ordered."""
-        c = self.cyc
-        m = c.m
-        rows = np.flatnonzero(
-            m.p_alive[:c.Pn] & (c.jobr == jr)
-            & (m.p_status[:c.Pn] == ST_PENDING) & ~self.st.req_empty[:c.Pn]
-            & (self.st.pipe_node[:c.Pn] < 0)
-        )
-        prio_enabled = any(
-            opt.name == "priority"
-            for opt in c._tier_opts("enabled_task_order")
-        )
-        prio = -m.p_prio[rows] if prio_enabled else np.zeros(len(rows))
-        uids = np.array([m.p_uid[r] for r in rows])
-        order = np.lexsort((uids, m.p_create[rows], prio))
-        return [int(r) for r in rows[order]]
+        """Pending task rows of a job, task-ordered (from the grouped
+        index; rows pipelined since init are filtered live)."""
+        m = self.cyc.m
+        pipe = self.st.pipe_node
+        return [
+            r for r in self._job_pending.get(jr, ())
+            if pipe[r] < 0 and m.p_status[r] == ST_PENDING
+        ]
 
     # ---------------------------------------------------------- predicates
 
@@ -665,35 +685,17 @@ class FastEvictor:
     # ----------------------------------------------- evictable prefilter
 
     def _le_rows(self, l: np.ndarray, r: np.ndarray) -> np.ndarray:
-        """Row-wise epsilon Resource.less_equal: l [R] vs r [N, R]."""
-        c = self.cyc
-        per = (
-            (l[None, :] < r)
-            | (np.abs(l[None, :] - r) < c.eps[None, :])
-            | (c.scalar_slot[None, :] & (l[None, :] <= c.eps[None, :]))
-        )
-        return per.all(axis=1)
+        """Row-wise epsilon Resource.less_equal: l [R] vs r [N, R].
 
-    def _key_qualifies(self, key: tuple, row: int, jr: int) -> bool:
-        """Would this Running victim row count toward the key's
-        aggregate?  (Upper bound: gang caps and conformance are checked
-        exactly downstream.)"""
+        (l < r) | (|l - r| < eps) is equivalent to r > l - eps, and
+        scalar slots with l <= eps pass unconditionally, so only the
+        remaining columns need the comparison."""
         c = self.cyc
-        m = c.m
-        kind = key[0]
-        if kind == "pq":
-            # Upper bound: own-job and higher-priority victims stay
-            # included (the exact walk filters them) so one cache serves
-            # every preemptor of the queue.
-            return m.j_queue[jr] == key[1]
-        if kind == "job":
-            return jr == key[1]
-        if kind == "rq":
-            if m.j_queue[jr] == key[1]:
-                return False
-            vq = c.store.queues.get(m.j_queue[jr])
-            return vq is not None and vq.reclaimable()
-        return False
+        cols = ~(c.scalar_slot & (l <= c.eps))
+        if not cols.any():
+            return np.ones(r.shape[0], bool)
+        thresh = l - c.eps
+        return (r[:, cols] > thresh[cols]).all(axis=1)
 
     def _evictable_for(self, key: tuple) -> np.ndarray:
         arr = self._evictable.get(key)
@@ -722,9 +724,19 @@ class FastEvictor:
         if len(sel):
             np.add.at(arr, st.v_node[sel], st.v_req[sel])
         self._evictable[key] = arr
+        if kind == "rq":
+            self._rq_keys.append(key)
         return arr
 
     def _evictable_update(self, row: int, sign: int) -> None:
+        """Direct-addressed cache update: a Running victim row counts
+        toward at most its own ("pq", queue) key (an upper bound — own-job
+        and higher-priority victims stay included; the exact walk filters
+        them, so one cache serves every preemptor of the queue), its own
+        ("job", job) key, and the "rq" keys of OTHER queues when the
+        victim's queue is reclaimable — O(1 + #rq keys) instead of a scan
+        over every cached key.  Gang caps and conformance are checked
+        exactly downstream."""
         c = self.cyc
         m = c.m
         jr = int(m.p_job[row])
@@ -732,9 +744,20 @@ class FastEvictor:
             return
         n = int(m.p_node[row])
         req = self.st.req[row]
-        for key, arr in self._evictable.items():
-            if self._key_qualifies(key, row, jr):
-                arr[n] += sign * req
+        ev = self._evictable
+        jq = m.j_queue[jr]
+        arr = ev.get(("pq", jq))
+        if arr is not None:
+            arr[n] += sign * req
+        arr = ev.get(("job", jr))
+        if arr is not None:
+            arr[n] += sign * req
+        if self._rq_keys:
+            vq = c.store.queues.get(jq)
+            if vq is not None and vq.reclaimable():
+                for key in self._rq_keys:
+                    if key[1] != jq:
+                        ev[key][n] += sign * req
 
     # -------------------------------------------------------------- victims
 
@@ -882,10 +905,7 @@ class FastEvictor:
             if not _vec_le(init_req, fut + vsum, eps, scalar):
                 continue
             # Evict lowest task order first: inverse of task_order.
-            prio_enabled = any(
-                opt.name == "priority"
-                for opt in c._tier_opts("enabled_task_order")
-            )
+            prio_enabled = self._task_prio_enabled
             vp = [(-int(m.p_prio[r]) if prio_enabled else 0,
                    m.p_create[r], m.p_uid[r], r) for r in victims]
             vp.sort(reverse=True)  # lowest order popped first
