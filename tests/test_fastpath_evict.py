@@ -77,3 +77,29 @@ def test_preempt_fast_path_used(monkeypatch):
     store = preempt_cluster(n_nodes=4, n_pending=6, seed=0)
     Scheduler(store, conf_str=CONF_PREEMPT).run_once()
     assert called.get("yes")
+
+
+CONF_INTERLEAVED = CONF_PREEMPT.replace(
+    '"enqueue, allocate, preempt, reclaim, backfill"',
+    '"enqueue, preempt, allocate, reclaim, backfill"',
+)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_evictor_resync_across_interleaved_allocate(seed):
+    """An allocate action between two evict actions mutates n_idle and
+    n_ntasks; the evictor created by the earlier action must resync its
+    future-idle/slot caches instead of overestimating capacity."""
+    a_store = preempt_cluster(n_nodes=8, n_pending=12, seed=seed)
+    b_store = preempt_cluster(n_nodes=8, n_pending=12, seed=seed)
+    os.environ["VOLCANO_TPU_FASTPATH"] = "0"
+    try:
+        Scheduler(a_store, conf_str=CONF_INTERLEAVED).run_once()
+    finally:
+        os.environ.pop("VOLCANO_TPU_FASTPATH", None)
+    os.environ["VOLCANO_TPU_FASTPATH"] = "1"
+    try:
+        Scheduler(b_store, conf_str=CONF_INTERLEAVED).run_once()
+    finally:
+        os.environ.pop("VOLCANO_TPU_FASTPATH", None)
+    assert _state(b_store) == _state(a_store)

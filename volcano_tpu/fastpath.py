@@ -553,6 +553,10 @@ class FastCycle:
             from .fastpath_evict import FastEvictor
 
             self._evictor = FastEvictor(self)
+        else:
+            # Action order is free-form: an allocate/backfill action may
+            # have mutated n_idle/n_ntasks since the evictor snapshot.
+            self._evictor.resync()
         return self._evictor
 
     # ------------------------------------------------------------- enqueue
@@ -1649,19 +1653,39 @@ class FastCycle:
     def _gang_message(self, row: int, fit_failed: bool) -> str:
         """Replicates gang.go's unschedulable message via job.fit_error()."""
         m = self.m
-        rows = np.flatnonzero(
-            m.p_alive[:self.Pn] & (self.jobr == row)
-        )
-        reasons = {}
-        for st in m.p_status[rows]:
-            name = TaskStatus(int(st)).name
-            reasons[name] = reasons.get(name, 0) + 1
-        reasons["minAvailable"] = int(m.j_minav[row])
-        parts = sorted(f"{v} {k}" for k, v in reasons.items())
-        fit = f"pod group is not ready, {', '.join(parts)}."
+        counts = getattr(self, "_status_counts", None)
+        if counts is None:
+            # One scatter pass over the pod axis serves every job (a
+            # per-job flatnonzero scan is O(jobs x pods)).
+            n_status = int(m.p_status[:self.Pn].max(initial=0)) + 1
+            counts = np.zeros((self.Jn, n_status), np.int64)
+            alive = np.flatnonzero(m.p_alive[:self.Pn] & (self.jobr >= 0))
+            np.add.at(
+                counts,
+                (self.jobr[alive], m.p_status[:self.Pn][alive]),
+                1,
+            )
+            self._status_counts = counts
         unready = int(m.j_minav[row] - self.j_ready_base[row])
         total = int(self.j_cnt_total[row])
-        return f"{unready}/{total} tasks in gang unschedulable: {fit}"
+        key = (counts[row].tobytes(), int(m.j_minav[row]), unready, total)
+        memo = getattr(self, "_gang_msg_memo", None)
+        if memo is None:
+            memo = self._gang_msg_memo = {}
+        msg = memo.get(key)
+        if msg is None:
+            reasons = {
+                TaskStatus(int(st)).name: int(n)
+                for st, n in enumerate(counts[row])
+                if n
+            }
+            reasons["minAvailable"] = int(m.j_minav[row])
+            parts = sorted(f"{v} {k}" for k, v in reasons.items())
+            fit = f"pod group is not ready, {', '.join(parts)}."
+            msg = memo[key] = (
+                f"{unready}/{total} tasks in gang unschedulable: {fit}"
+            )
+        return msg
 
 
 def run_cycle_fast(store, conf) -> bool:

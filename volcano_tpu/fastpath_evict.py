@@ -40,8 +40,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+import heapq
+
 from .api import PodGroupPhase, TaskStatus
-from .utils.priority_queue import PriorityQueue
 
 log = logging.getLogger(__name__)
 
@@ -76,6 +77,10 @@ class EvictState:
             np.zeros(0, bool)
         # Session-scoped node deltas.
         self.n_pipelined = np.zeros((Nn, R), F)
+        # Incrementally-maintained FutureIdle = idle + releasing -
+        # pipelined (node_info.go:56-58); n_idle is static while the
+        # evict actions run, so only the event methods touch this.
+        self.fi = cyc.n_idle + cyc.n_releasing
         self.pipelined_rows: List[int] = []  # rows pipelined this cycle
         self.pipe_node = np.full(Pn, -1, np.int64)
         self.j_waiting = np.zeros(cyc.Jn, np.int64)
@@ -123,8 +128,7 @@ class EvictState:
     # ------------------------------------------------------------ futures
 
     def future_idle(self, n: int) -> np.ndarray:
-        c = self.cyc
-        return c.n_idle[n] + c.n_releasing[n] - self.n_pipelined[n]
+        return self.fi[n]
 
     # ------------------------------------------------------------- events
 
@@ -137,6 +141,7 @@ class EvictState:
         req = self.req[row]
         m.p_status[row] = ST_RELEASING
         c.n_releasing[n] += req
+        self.fi[n] += req
         jr = int(m.p_job[row])
         if jr >= 0:
             self.j_version[jr] += 1
@@ -160,6 +165,7 @@ class EvictState:
         req = self.req[row]
         m.p_status[row] = ST_RUNNING
         c.n_releasing[n] -= req
+        self.fi[n] -= req
         if jr >= 0:
             self.j_version[jr] += 1
             c.j_cnt_alloc[jr] += 1
@@ -181,6 +187,7 @@ class EvictState:
         m = c.m
         req = self.req[row]
         self.n_pipelined[n] += req
+        self.fi[n] -= req
         self.pipe_node[row] = n
         c.n_ntasks[n] += 1
         jr = int(m.p_job[row])
@@ -203,6 +210,7 @@ class EvictState:
         m = c.m
         req = self.req[row]
         self.n_pipelined[n] -= req
+        self.fi[n] += req
         self.pipe_node[row] = -1
         c.n_ntasks[n] -= 1
         if jr >= 0:
@@ -259,6 +267,37 @@ class EvictState:
             store.mark_objects_stale()
 
 
+class _LazyHeap:
+    """Priority queue over live keys without Python comparator callbacks.
+
+    Entries carry the key frozen at push time (heap sifts are then C-level
+    tuple compares); pop re-derives the key and re-pushes when it went
+    stale, so the element actually returned is ordered by its CURRENT key
+    — at least as fresh as the comparator-driven heap it replaces, whose
+    sift decisions also mix pre- and post-mutation views."""
+
+    __slots__ = ("key_fn", "h")
+
+    def __init__(self, key_fn):
+        self.key_fn = key_fn
+        self.h: list = []
+
+    def push(self, item) -> None:
+        heapq.heappush(self.h, (self.key_fn(item), item))
+
+    def pop(self):
+        h = self.h
+        while True:
+            key, item = heapq.heappop(h)
+            fresh = self.key_fn(item)
+            if fresh == key:
+                return item
+            heapq.heappush(h, (fresh, item))
+
+    def empty(self) -> bool:
+        return not self.h
+
+
 class FastEvictor:
     """Shared machinery for fast preempt + reclaim over one FastCycle."""
 
@@ -272,6 +311,10 @@ class FastEvictor:
         self._profile_static: Dict[int, np.ndarray] = {}
         self._evictable: Dict[tuple, np.ndarray] = {}
         self._rq_keys: List[tuple] = []
+        self._qorder_has_prop = None
+        self._zero_nr: Optional[np.ndarray] = None
+        self._slots_cache = None
+        self._total_list = None
         self.st.on_change = self._evictable_update
         # Tier-ordered plugin-name lists per victim registry (precomputed:
         # the per-victim intersection walks these thousands of times).
@@ -311,6 +354,15 @@ class FastEvictor:
 
     # -------------------------------------------------------------- session
 
+    def resync(self) -> None:
+        """Re-derive caches of FastCycle state that an allocate/backfill
+        action may have mutated since the last evict action (fi snapshots
+        n_idle; the slot mask snapshots n_ntasks)."""
+        st = self.st
+        c = self.cyc
+        st.fi = c.n_idle + c.n_releasing - st.n_pipelined
+        self._slots_cache = None
+
     def job_pipelined(self, jr: int) -> bool:
         """Gang JobPipelined veto (gang.go: waiting + ready >= min)."""
         c = self.cyc
@@ -322,30 +374,26 @@ class FastEvictor:
 
     # ------------------------------------------------------------ ordering
 
-    def _job_order_less(self, l: int, r: int) -> bool:
-        """Live tier-ordered job comparator (shares move during the
-        action, so keys cannot be frozen as in allocate)."""
+    def _job_key(self, jr: int) -> tuple:
+        """Live tier-ordered job sort key (shares move during the action,
+        so _LazyHeap re-derives this on pop).  Lexicographic order of the
+        tuple == the reference's tiered job-order comparator."""
         c = self.cyc
         m = c.m
+        parts = []
         for name in self._job_order_names:
             if name == "priority":
-                lp = m.j_prio[l]
-                rp = m.j_prio[r]
-                if lp != rp:
-                    return lp > rp
+                parts.append(-int(m.j_prio[jr]))
             elif name == "gang":
-                lr = c.j_ready_base[l] >= m.j_minav[l]
-                rr = c.j_ready_base[r] >= m.j_minav[r]
-                if lr != rr:
-                    return rr  # non-ready first
+                # Non-ready jobs order first.
+                parts.append(
+                    1 if c.j_ready_base[jr] >= m.j_minav[jr] else 0
+                )
             elif name == "drf":
-                ls = self._drf_share(l)
-                rs = self._drf_share(r)
-                if ls != rs:
-                    return ls < rs
-        if m.j_create[l] != m.j_create[r]:
-            return m.j_create[l] < m.j_create[r]
-        return m.j_uid[l] < m.j_uid[r]
+                parts.append(self._drf_share(jr))
+        parts.append(m.j_create[jr])
+        parts.append(m.j_uid[jr])
+        return tuple(parts)
 
     def _drf_share(self, jr: int) -> float:
         cache = self._share_cache
@@ -353,12 +401,16 @@ class FastEvictor:
         if hit is not None and hit[0] == self.st.j_version[jr]:
             return hit[1]
         c = self.cyc
-        total = c.total_res
+        totals = self._total_list
+        if totals is None:
+            totals = self._total_list = [float(t) for t in c.total_res]
         alloc = c.j_alloc_res[jr]
-        with np.errstate(divide="ignore", invalid="ignore"):
-            ratio = np.where(total > 0, alloc / np.where(total > 0, total, 1),
-                             np.where(alloc > 0, 1.0, 0.0))
-        out = float(ratio.max()) if len(ratio) else 0.0
+        out = 0.0
+        for k, t in enumerate(totals):
+            a = float(alloc[k])
+            v = a / t if t > 0.0 else (1.0 if a > 0.0 else 0.0)
+            if v > out:
+                out = v
         cache[jr] = (self.st.j_version[jr], out)
         return out
 
@@ -382,22 +434,20 @@ class FastEvictor:
         self._qshare_cache[qi] = (self.st.version, s)
         return s
 
-    def _queue_order_less(self, l: str, r: str) -> bool:
+    def _queue_key(self, qname: str) -> tuple:
+        """Live queue sort key (see _job_key)."""
         c = self.cyc
-        has_prop = c._has("proportion") and any(
-            opt.name == "proportion"
-            for opt in c._tier_opts("enabled_queue_order")
-        )
+        has_prop = self._qorder_has_prop
+        if has_prop is None:
+            has_prop = self._qorder_has_prop = c._has("proportion") and any(
+                opt.name == "proportion"
+                for opt in c._tier_opts("enabled_queue_order")
+            )
+        q = c.store.queues[qname]
         if has_prop:
-            ls = self._queue_share(c.queue_index.get(l, -1))
-            rs = self._queue_share(c.queue_index.get(r, -1))
-            if ls != rs:
-                return ls < rs
-        lq = c.store.queues[l]
-        rq = c.store.queues[r]
-        if lq.queue.creation_timestamp != rq.queue.creation_timestamp:
-            return lq.queue.creation_timestamp < rq.queue.creation_timestamp
-        return lq.uid < rq.uid
+            return (self._queue_share(c.queue_index.get(qname, -1)),
+                    q.queue.creation_timestamp, q.uid)
+        return (q.queue.creation_timestamp, q.uid)
 
     def _task_rows_sorted(self, jr: int) -> List[int]:
         """Pending task rows of a job, task-ordered (from the grouped
@@ -430,7 +480,12 @@ class FastEvictor:
         if static is None:
             static = self._static_mask(feat)
             self._profile_static[pidr] = static
-        ok = static & ((c.n_maxtasks <= 0) | (c.n_ntasks < c.n_maxtasks))
+        slots = self._slots_cache
+        if slots is None or slots[0] != self.st.version:
+            slots = (self.st.version,
+                     (c.n_maxtasks <= 0) | (c.n_ntasks < c.n_maxtasks))
+            self._slots_cache = slots
+        ok = static & slots[1]
         # Host ports.
         if feat.ports:
             myports = set(feat.ports)
@@ -684,18 +739,38 @@ class FastEvictor:
 
     # ----------------------------------------------- evictable prefilter
 
-    def _le_rows(self, l: np.ndarray, r: np.ndarray) -> np.ndarray:
-        """Row-wise epsilon Resource.less_equal: l [R] vs r [N, R].
+    def _le_rows(self, l: np.ndarray, a: np.ndarray,
+                 b: Optional[np.ndarray] = None) -> np.ndarray:
+        """Row-wise epsilon Resource.less_equal: l [R] vs a(+b) [N, R].
 
         (l < r) | (|l - r| < eps) is equivalent to r > l - eps, and
         scalar slots with l <= eps pass unconditionally, so only the
-        remaining columns need the comparison."""
+        remaining columns need the comparison.  The per-column loop
+        (R is 2-4) avoids materializing any [N, R] temporary — this
+        runs once per preemptor task over 10k+ nodes."""
         c = self.cyc
-        cols = ~(c.scalar_slot & (l <= c.eps))
-        if not cols.any():
-            return np.ones(r.shape[0], bool)
+        cols = np.flatnonzero(~(c.scalar_slot & (l <= c.eps)))
+        out = np.ones(a.shape[0], bool)
         thresh = l - c.eps
-        return (r[:, cols] > thresh[cols]).all(axis=1)
+        for k in cols:
+            col = a[:, k] if b is None else a[:, k] + b[:, k]
+            out &= col > thresh[k]
+        return out
+
+    def _vjob_group(self, jr: int) -> np.ndarray:
+        """Indices into the victim base vectors for one job (grouped once;
+        a per-job O(#victims) mask scan repeated for thousands of jobs in
+        preempt phase 2 dominated the action otherwise)."""
+        groups = getattr(self, "_vjob_groups", None)
+        if groups is None:
+            st = self.st
+            groups = self._vjob_groups = {}
+            order = np.argsort(st.v_job, kind="stable")
+            uniq, starts = np.unique(st.v_job[order], return_index=True)
+            bounds = list(starts) + [len(order)]
+            for i, j in enumerate(uniq):
+                groups[int(j)] = order[bounds[i]:bounds[i + 1]]
+        return groups.get(jr, np.empty(0, np.int64))
 
     def _evictable_for(self, key: tuple) -> np.ndarray:
         arr = self._evictable.get(key)
@@ -704,24 +779,37 @@ class FastEvictor:
         c = self.cyc
         m = c.m
         st = self.st
-        mask = (m.p_status[:c.Pn][st.v_rows] == ST_RUNNING) & (st.v_job >= 0)
         kind = key[0]
-        if kind == "pq":
-            qi = c.queue_index.get(key[1], -1)
-            mask &= st.v_qi == qi
-        elif kind == "job":
-            mask &= st.v_job == key[1]
-        elif kind == "rq":
-            qi = c.queue_index.get(key[1], -1)
-            reclaimable = np.zeros(c.Qn + 1, bool)
-            for name, i in c.queue_index.items():
-                q = c.store.queues.get(name)
-                reclaimable[i] = bool(q is not None and q.reclaimable())
-            mask &= (st.v_qi != qi) & (st.v_qi >= 0) \
-                & reclaimable[np.maximum(st.v_qi, 0)]
-        arr = np.zeros((c.Nn, c.R), F)
-        sel = np.flatnonzero(mask)
-        if len(sel):
+        if kind == "job":
+            sel = self._vjob_group(int(key[1]))
+            if len(sel):
+                sel = sel[m.p_status[:c.Pn][st.v_rows[sel]] == ST_RUNNING]
+        else:
+            mask = (m.p_status[:c.Pn][st.v_rows] == ST_RUNNING) \
+                & (st.v_job >= 0)
+            if kind == "pq":
+                qi = c.queue_index.get(key[1], -1)
+                mask &= st.v_qi == qi
+            elif kind == "rq":
+                qi = c.queue_index.get(key[1], -1)
+                reclaimable = np.zeros(c.Qn + 1, bool)
+                for name, i in c.queue_index.items():
+                    q = c.store.queues.get(name)
+                    reclaimable[i] = bool(q is not None and q.reclaimable())
+                mask &= (st.v_qi != qi) & (st.v_qi >= 0) \
+                    & reclaimable[np.maximum(st.v_qi, 0)]
+            sel = np.flatnonzero(mask)
+        if not len(sel):
+            # Copy-on-write zero: thousands of "job" keys (one per
+            # under-request job in preempt phase 2) have no Running
+            # victims at all; share one read-only zero array for them.
+            arr = self._zero_nr
+            if arr is None:
+                arr = np.zeros((c.Nn, c.R), F)
+                arr.flags.writeable = False
+                self._zero_nr = arr
+        else:
+            arr = np.zeros((c.Nn, c.R), F)
             np.add.at(arr, st.v_node[sel], st.v_req[sel])
         self._evictable[key] = arr
         if kind == "rq":
@@ -746,18 +834,22 @@ class FastEvictor:
         req = self.st.req[row]
         ev = self._evictable
         jq = m.j_queue[jr]
-        arr = ev.get(("pq", jq))
-        if arr is not None:
-            arr[n] += sign * req
-        arr = ev.get(("job", jr))
-        if arr is not None:
-            arr[n] += sign * req
+        sreq = sign * req
+        for key in (("pq", jq), ("job", jr)):
+            arr = ev.get(key)
+            if arr is not None:
+                if arr is self._zero_nr:  # copy-on-write
+                    arr = ev[key] = np.zeros((c.Nn, c.R), F)
+                arr[n] += sreq
         if self._rq_keys:
             vq = c.store.queues.get(jq)
             if vq is not None and vq.reclaimable():
                 for key in self._rq_keys:
                     if key[1] != jq:
-                        ev[key][n] += sign * req
+                        arr = ev[key]
+                        if arr is self._zero_nr:
+                            arr = ev[key] = np.zeros((c.Nn, c.R), F)
+                        arr[n] += sreq
 
     # -------------------------------------------------------------- victims
 
@@ -883,8 +975,7 @@ class FastEvictor:
         # its in-scope victims' resources must cover the preemptor —
         # otherwise the exact walk below cannot succeed there.
         ev = self._evictable_for(evict_key)
-        fi = c.n_idle + c.n_releasing - st.n_pipelined
-        feasible = feasible & self._le_rows(init_req, fi + ev)
+        feasible = feasible & self._le_rows(init_req, st.fi, ev)
         rows_f = np.flatnonzero(feasible & c.n_alive)
         if not len(rows_f):
             return False
@@ -923,7 +1014,7 @@ class FastEvictor:
         c = self.cyc
         m = c.m
         st = self.st
-        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptors_map: Dict[str, _LazyHeap] = {}
         tasks_map: Dict[int, List[int]] = {}
         under_request: List[int] = []
         queue_seq: List[str] = []
@@ -936,7 +1027,7 @@ class FastEvictor:
             pending = self._task_rows_sorted(jr)
             if pending and not self.job_pipelined(jr):
                 preemptors_map.setdefault(
-                    qname, PriorityQueue(self._job_order_less)
+                    qname, _LazyHeap(self._job_key)
                 ).push(jr)
                 under_request.append(jr)
                 tasks_map[jr] = pending
@@ -1055,9 +1146,9 @@ class FastEvictor:
         st = self.st
         from .fastpath import _vec_le
 
-        queues_pq = PriorityQueue(self._queue_order_less)
+        queues_pq = _LazyHeap(self._queue_key)
         seen_q = set()
-        jobs_map: Dict[str, PriorityQueue] = {}
+        jobs_map: Dict[str, _LazyHeap] = {}
         tasks_map: Dict[int, List[int]] = {}
         for jr in self._schedulable_jobs():
             qname = m.j_queue[jr]
@@ -1067,7 +1158,7 @@ class FastEvictor:
             pending = self._task_rows_sorted(jr)
             if pending:
                 jobs_map.setdefault(
-                    qname, PriorityQueue(self._job_order_less)
+                    qname, _LazyHeap(self._job_key)
                 ).push(jr)
                 tasks_map[jr] = pending
 
