@@ -1192,7 +1192,11 @@ class FastCycle:
 
         term_local = np.full(len(m.terms), -1, np.int64)
         term_local[active] = np.arange(E)
-        Ep = _pow2(E, 1)
+        # 25% headroom before the pow2 round-up: raw term counts cluster
+        # near round numbers, and a population straddling a power of two
+        # would otherwise alternate buckets cycle-to-cycle — each flip is
+        # a multi-second XLA recompile of the wave solver.
+        Ep = _pow2(E + max(E // 4, 8), 1)
 
         # ---- sparse membership hash + per-term local membership ---------
         rng = np.random.RandomState(0x7A5E)
@@ -1421,7 +1425,7 @@ class FastCycle:
         self.j_ready_base = (
             self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_empty_pending
         )
-        er, si, v = m.c_req.gather(rows)
+        # (er, si, v) reused from the divergence guard's gather above.
         np.add.at(self.j_alloc_res, (jr[er], si), v)
         np.add.at(self.j_pending_res, (jr[er], si), -v)
         # Queue allocation (overuse gating in later rounds).

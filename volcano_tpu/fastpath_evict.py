@@ -356,12 +356,28 @@ class FastEvictor:
 
     def resync(self) -> None:
         """Re-derive caches of FastCycle state that an allocate/backfill
-        action may have mutated since the last evict action (fi snapshots
-        n_idle; the slot mask snapshots n_ntasks)."""
+        action may have mutated since the last evict action: fi snapshots
+        n_idle, the slot mask snapshots n_ntasks, the share memos key off
+        versions allocate never bumps, and node_rows misses pods the
+        allocate action bound."""
         st = self.st
         c = self.cyc
+        m = c.m
         st.fi = c.n_idle + c.n_releasing - st.n_pipelined
         self._slots_cache = None
+        self._share_cache.clear()
+        self._qshare_cache.clear()
+        self._reclaim_poss_cache = None
+        # Rebuild the per-node resident lists (allocate binds appear as
+        # new residents; the host-port predicate walks these).  Session
+        # pipelines re-append in pipelined order, as pipeline() did.
+        st.node_rows = [[] for _ in range(c.Nn)]
+        node = m.p_node[:c.Pn]
+        for r in np.flatnonzero(c.resident):
+            st.node_rows[node[r]].append(int(r))
+        for r in st.pipelined_rows:
+            if st.pipe_node[r] >= 0:
+                st.node_rows[st.pipe_node[r]].append(int(r))
 
     def job_pipelined(self, jr: int) -> bool:
         """Gang JobPipelined veto (gang.go: waiting + ready >= min)."""

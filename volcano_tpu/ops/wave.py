@@ -280,6 +280,14 @@ def _solve_wave(
             # the affinity machinery scalable to 50k x 500k (SURVEY.md
             # section 7 hard parts).
             wterms = wave_terms[w]  # [EW], padded with the dummy row
+            # Waves whose window is entirely dummy padding neither consult
+            # nor change any term count (matched tasks put their terms in
+            # the window too); the per-attempt [N, EW] gather and the
+            # [UM, EW] x [EW, N] violation/score matmuls are lax.cond-
+            # skipped for them — with sparse affinity, most waves.
+            # E here includes the appended dummy row, whose index (the
+            # wave_terms pad value) is E - 1.
+            wave_live = jnp.any(wterms != E - 1)
             tk_w = aff.term_key[wterms]
             node_dom_t = jnp.take(aff.node_dom, tk_w, axis=1)  # [N, EW]
             term_arange = jnp.arange(EW)
@@ -348,19 +356,33 @@ def _solve_wave(
                 p_feasible &= ~p_has_ports[:, None] | (port_clash == 0)
             cval = None
             if has_aff:
-                cnt = cw_a + cw_p
-                cval = cnt[term_arange[None, :], jnp.maximum(node_dom_t, 0)]
-                cval = jnp.where(node_dom_t >= 0, cval, 0)  # [N, EW]
-                total = jnp.sum(cnt, axis=-1)  # [EW]
-                # Required affinity: every required term needs a resident
-                # match in the node's domain (or the self-match rule).
-                selfok = (total == 0)[None, :] & p_t_matches  # [UM, E]
-                need = (p_t_req_aff & ~selfok).astype(f32)
-                aff_viol = jnp.matmul(need, (cval == 0).astype(f32).T)
-                anti_viol = jnp.matmul(
-                    p_t_req_anti.astype(f32), (cval > 0).astype(f32).T
+                def _aff_parts(cnt):
+                    cv = cnt[
+                        term_arange[None, :], jnp.maximum(node_dom_t, 0)
+                    ]
+                    cv = jnp.where(node_dom_t >= 0, cv, 0)  # [N, EW]
+                    total = jnp.sum(cnt, axis=-1)  # [EW]
+                    # Required affinity: every required term needs a
+                    # resident match in the node's domain (or the
+                    # self-match rule).
+                    selfok = (total == 0)[None, :] & p_t_matches  # [UM, E]
+                    need = (p_t_req_aff & ~selfok).astype(f32)
+                    aff_viol = jnp.matmul(need, (cv == 0).astype(f32).T)
+                    anti_viol = jnp.matmul(
+                        p_t_req_anti.astype(f32), (cv > 0).astype(f32).T
+                    )
+                    return cv, (aff_viol == 0) & (anti_viol == 0)
+
+                def _aff_skip(cnt):
+                    return (
+                        jnp.zeros((N, EW), cnt.dtype),
+                        jnp.ones((UM, N), bool),
+                    )
+
+                cval, aff_ok = jax.lax.cond(
+                    wave_live, _aff_parts, _aff_skip, cw_a + cw_p
                 )
-                p_feasible &= (aff_viol == 0) & (anti_viol == 0)
+                p_feasible &= aff_ok
             return p_feasible, future_idle, walk_idle, cval
 
         def rank_nodes(s: GState, p_feasible, cval):
@@ -376,8 +398,11 @@ def _solve_wave(
             )
             p_score = p_score + p_static_score
             if has_aff:
-                p_score = p_score + jnp.matmul(
-                    p_t_soft, cval.T.astype(f32)
+                p_score = p_score + jax.lax.cond(
+                    wave_live,
+                    lambda cv: jnp.matmul(p_t_soft, cv.T.astype(f32)),
+                    lambda cv: jnp.zeros((UM, N), f32),
+                    cval,
                 )
             p_score = jnp.where(p_feasible, p_score, NEG)
             # top_k is the partial sort: ties prefer lower node index,
@@ -474,6 +499,12 @@ def _solve_wave(
                 # feasibility, so same-domain soft interactions place in
                 # one pass with attempt-start scores.
                 p_involved = p_t_req_aff | p_t_req_anti
+                # Per-task activity masks for the sub-round lax.cond
+                # gates: the [EW*D] scatter-min / count scatters only
+                # matter while a candidate carries required terms (filter)
+                # or an accepted task matches any windowed term (counts).
+                involved_any_t = jnp.any(p_involved[pid_l], axis=1)  # [W]
+                matches_any_t = jnp.any(t_matches_w, axis=1)  # [W]
 
             # ---- sub-rounds: rejected tasks re-walk against live capacity
             # within the attempt, reusing this attempt's feasibility and
@@ -630,71 +661,86 @@ def _solve_wave(
                     port_live = jnp.any(ports_w & used_bits_c, axis=1)
                     clean &= ~port_conf & ~port_live
                 if has_aff:
-                    # Live per-task recheck against the sub-round count
-                    # window: a sibling placed in an earlier sub-round
-                    # already satisfies (or violates) required terms here,
-                    # so involved tasks resolve within the attempt instead
-                    # of one per attempt.
-                    dw = node_dom_t[choice]  # [W, EW]
-                    cnt_live = cw_a_ + cw_p_  # [EW, D]
-                    total_live = jnp.sum(cnt_live, axis=-1)  # [EW]
-                    cval_t = cnt_live[
-                        term_arange[None, :], jnp.maximum(dw, 0)
-                    ]
-                    cval_t = jnp.where(dw >= 0, cval_t, 0)  # [W, EW]
-                    req_aff_t = p_t_req_aff[pid_l]  # [W, EW]
-                    selfok_t = (total_live == 0)[None, :] & t_matches_w
-                    aff_ok = ~jnp.any(
-                        req_aff_t & ~selfok_t & (cval_t == 0), axis=1
+                    # Live per-task recheck + pair-conflict filter, both
+                    # lax.cond-skipped for waves with no real terms (the
+                    # scatter-min runs over EW*D keys — millions of
+                    # entries at hyperscale).
+                    def _aff_filter(op):
+                        clean_in, cwa, cwp = op
+                        # A sibling placed in an earlier sub-round already
+                        # satisfies (or violates) required terms here, so
+                        # involved tasks resolve within the attempt
+                        # instead of one per attempt.
+                        dw = node_dom_t[choice]  # [W, EW]
+                        cnt_live = cwa + cwp  # [EW, D]
+                        total_live = jnp.sum(cnt_live, axis=-1)  # [EW]
+                        cval_t = cnt_live[
+                            term_arange[None, :], jnp.maximum(dw, 0)
+                        ]
+                        cval_t = jnp.where(dw >= 0, cval_t, 0)  # [W, EW]
+                        req_aff_t = p_t_req_aff[pid_l]  # [W, EW]
+                        selfok_t = (total_live == 0)[None, :] & t_matches_w
+                        aff_ok = ~jnp.any(
+                            req_aff_t & ~selfok_t & (cval_t == 0), axis=1
+                        )
+                        anti_ok = ~jnp.any(
+                            p_t_req_anti[pid_l] & (cval_t > 0), axis=1
+                        )
+                        out = clean_in & aff_ok & anti_ok
+                        # Same-domain interaction with earlier tasks of
+                        # THIS sub-round stays conservative (their count
+                        # updates are not applied yet).  A task relying on
+                        # the self-match rule additionally conflicts with
+                        # ANY earlier giver of the term, whatever its
+                        # domain — otherwise two siblings could each claim
+                        # "first" and split the gang across domains (the
+                        # sequential path serializes them).
+                        involved = p_involved[pid_l] & (dw >= 0)  # [W, EW]
+                        gives = t_matches_w & (dw >= 0)
+                        uses_selfok = (
+                            req_aff_t & selfok_t & (cval_t == 0)
+                        )  # [W, EW]
+                        # Pair conflicts via scatter-min over (term,
+                        # domain) keys instead of an O(W^2 * EW) pair
+                        # tensor: task i conflicts iff some earlier live
+                        # giver shares one of i's involved (term, domain)
+                        # keys — i.e. the minimum giver index of the key
+                        # is < i.  Self-match users conflict with ANY
+                        # earlier giver of the term (any domain), via a
+                        # per-term scatter-min.
+                        jidx = jnp.arange(W, dtype=jnp.int32)
+                        gmask = gives & live[:, None]  # [W, EW]
+                        keyv = (
+                            term_arange[None, :] * D + jnp.maximum(dw, 0)
+                        )
+                        scratch = EW * D
+                        keys_g = jnp.where(gmask, keyv, scratch)
+                        gm = (
+                            jnp.full((EW * D + 1,), W, jnp.int32)
+                            .at[keys_g.reshape(-1)]
+                            .min(jnp.broadcast_to(
+                                jidx[:, None], (W, EW)
+                            ).reshape(-1))
+                        )
+                        conflict_dom = jnp.any(
+                            involved & (gm[keyv] < jidx[:, None]), axis=1
+                        )
+                        # Per-term giver minimum: every gives entry has a
+                        # domain, so the min over domains of gm is exactly
+                        # the per-term scatter-min — no second scatter
+                        # needed.
+                        gt = gm[:EW * D].reshape(EW, D).min(axis=1)
+                        conflict_self = jnp.any(
+                            uses_selfok
+                            & (gt[None, :] < jidx[:, None]), axis=1
+                        )
+                        return out & ~(conflict_dom | conflict_self)
+
+                    clean = jax.lax.cond(
+                        wave_live & jnp.any(cand_s & involved_any_t),
+                        _aff_filter, lambda op: op[0],
+                        (clean, cw_a_, cw_p_),
                     )
-                    anti_ok = ~jnp.any(
-                        p_t_req_anti[pid_l] & (cval_t > 0), axis=1
-                    )
-                    clean &= aff_ok & anti_ok
-                    # Same-domain interaction with earlier tasks of THIS
-                    # sub-round stays conservative (their count updates
-                    # are not applied yet).  A task relying on the
-                    # self-match rule additionally conflicts with ANY
-                    # earlier giver of the term, whatever its domain —
-                    # otherwise two siblings could each claim "first" and
-                    # split the gang across domains (the sequential path
-                    # serializes them).
-                    involved = p_involved[pid_l] & (dw >= 0)  # [W, EW]
-                    gives = t_matches_w & (dw >= 0)
-                    uses_selfok = (
-                        req_aff_t & selfok_t & (cval_t == 0)
-                    )  # [W, EW]
-                    # Pair conflicts via scatter-min over (term, domain)
-                    # keys instead of an O(W^2 * EW) pair tensor: task i
-                    # conflicts iff some earlier live giver shares one of
-                    # i's involved (term, domain) keys — i.e. the minimum
-                    # giver index of the key is < i.  Self-match users
-                    # conflict with ANY earlier giver of the term (any
-                    # domain), via a per-term scatter-min.
-                    jidx = jnp.arange(W, dtype=jnp.int32)
-                    gmask = gives & live[:, None]  # [W, EW]
-                    keyv = term_arange[None, :] * D + jnp.maximum(dw, 0)
-                    scratch = EW * D
-                    keys_g = jnp.where(gmask, keyv, scratch)
-                    gm = (
-                        jnp.full((EW * D + 1,), W, jnp.int32)
-                        .at[keys_g.reshape(-1)]
-                        .min(jnp.broadcast_to(
-                            jidx[:, None], (W, EW)
-                        ).reshape(-1))
-                    )
-                    conflict_dom = jnp.any(
-                        involved & (gm[keyv] < jidx[:, None]), axis=1
-                    )
-                    # Per-term giver minimum: every gives entry has a
-                    # domain, so the min over domains of gm is exactly the
-                    # per-term scatter-min — no second scatter needed.
-                    gt = gm[:EW * D].reshape(EW, D).min(axis=1)
-                    conflict_self = jnp.any(
-                        uses_selfok
-                        & (gt[None, :] < jidx[:, None]), axis=1
-                    )
-                    clean &= ~(conflict_dom | conflict_self)
 
                 acc_alloc = clean & fits_idle
                 if has_future:
@@ -740,24 +786,39 @@ def _solve_wave(
                     # Window-local count update: the wave only touches its
                     # own term rows, so updates stay on the [EW, D] window
                     # carried through the loops; the global state is
-                    # written back once per wave.
-                    flat_dom = term_arange[None, :] * D + jnp.maximum(dw, 0)
-                    inc_base = t_matches_w & (dw >= 0)
-
-                    def cnt_apply(cw, acc):
-                        return (
-                            cw.reshape(-1)
-                            .at[flat_dom.reshape(-1)]
-                            .add(
-                                (inc_base & acc[:, None])
-                                .astype(jnp.int32).reshape(-1)
-                            )
-                            .reshape(EW, D)
+                    # written back once per wave.  lax.cond-skipped for
+                    # waves with no real terms (nothing to count).
+                    def _cnt_update(op):
+                        cwa, cwp = op
+                        dw = node_dom_t[choice]  # [W, EW]
+                        flat_dom = (
+                            term_arange[None, :] * D + jnp.maximum(dw, 0)
                         )
+                        inc_base = t_matches_w & (dw >= 0)
 
-                    cw_a_ = cnt_apply(cw_a_, acc_alloc)
-                    if has_future:
-                        cw_p_ = cnt_apply(cw_p_, acc_pipe)
+                        def cnt_apply(cw, acc):
+                            return (
+                                cw.reshape(-1)
+                                .at[flat_dom.reshape(-1)]
+                                .add(
+                                    (inc_base & acc[:, None])
+                                    .astype(jnp.int32).reshape(-1)
+                                )
+                                .reshape(EW, D)
+                            )
+
+                        cwa = cnt_apply(cwa, acc_alloc)
+                        if has_future:
+                            cwp = cnt_apply(cwp, acc_pipe)
+                        return cwa, cwp
+
+                    cw_a_, cw_p_ = jax.lax.cond(
+                        wave_live & jnp.any(
+                            (acc_alloc | acc_pipe) & matches_any_t
+                        ),
+                        _cnt_update, lambda op: op,
+                        (cw_a_, cw_p_),
+                    )
 
                 alloc_l_ = alloc_l_ + jnp.round(
                     jnp.matmul(
@@ -1009,6 +1070,32 @@ def _profiles_from_pid(tasks: SolveTasks, aff: AffinityArgs,
     return profiles, pid
 
 
+def _pad_profiles_rows(profiles: SolveProfiles) -> SolveProfiles:
+    """Pad the profile table's row axis to a power of two (min 64) with
+    inert zero rows.  The row count is data-dependent (distinct task
+    profiles this cycle); unpadded it changes shape almost every cycle
+    and forces an XLA recompile of the wave solver — ~7s per new shape,
+    dwarfing the solve itself.  Padded rows are never referenced: pid and
+    wave_prof only index real rows."""
+    U = int(_np(profiles.req).shape[0])
+    # Same 25% headroom as Ep/EW: a profile count hovering at a power of
+    # two must not flip buckets cycle-to-cycle.
+    target = U + max(U // 4, 8)
+    UB = 64
+    while UB < target:
+        UB *= 2
+    pad = UB - U
+    if pad == 0:
+        return profiles
+    def z(a):
+        a = _np(a)
+        return np.concatenate(
+            [a, np.zeros((pad, *a.shape[1:]), a.dtype)]
+        )
+
+    return SolveProfiles(*[z(a) for a in profiles])
+
+
 def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
                   pid: np.ndarray, wave_prof: np.ndarray, n_waves: int):
     """Per-wave lists of the affinity terms the wave's profiles reference.
@@ -1055,8 +1142,12 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
         terms = np.flatnonzero(iom[pids].any(axis=0))
         term_lists.append(terms)
         ew = max(ew, len(terms))
-    EW = 1
-    while EW < ew:
+    # 25% headroom before the pow2 round-up (min 16): per-wave term
+    # counts near a power of two would otherwise flip the EW bucket
+    # between cycles, recompiling the solver (see fastpath Ep).
+    EW = 16
+    target = ew + max(ew // 4, 4)
+    while EW < target:
         EW *= 2
     wave_terms = np.full((n_waves, EW), E, np.int32)  # pad = dummy row
     for w, terms in enumerate(term_lists):
@@ -1186,6 +1277,7 @@ def solve_wave(
         profiles, pid = _profiles_from_pid(tasks, aff, pid)
     else:
         profiles, pid = _profile_tasks(tasks, aff)
+    profiles = _pad_profiles_rows(profiles)
     wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
     features = (
         bool(_np(profiles.ports).any()),

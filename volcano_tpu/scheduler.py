@@ -29,6 +29,36 @@ from .metrics import metrics
 
 log = logging.getLogger(__name__)
 
+_compile_cache_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    """Persist XLA executables across processes (wave-solver compiles run
+    multiple seconds; a restarted scheduler would otherwise pay them
+    again).  Opt out with VOLCANO_TPU_COMPILE_CACHE=0 or point the cache
+    elsewhere with VOLCANO_TPU_COMPILE_CACHE=<dir>."""
+    global _compile_cache_enabled
+    if _compile_cache_enabled:
+        return
+    _compile_cache_enabled = True
+    import os
+
+    loc = os.environ.get("VOLCANO_TPU_COMPILE_CACHE", "")
+    if loc == "0":
+        return
+    if not loc:
+        loc = os.path.join(
+            os.path.expanduser("~"), ".cache", "volcano_tpu_xla"
+        )
+    try:
+        import jax
+
+        os.makedirs(loc, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", loc)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as err:  # pragma: no cover - cache is best-effort
+        log.warning("compilation cache unavailable: %s", err)
+
 
 class Scheduler:
     def __init__(
@@ -87,6 +117,7 @@ class Scheduler:
         ]
         with metrics.e2e_timer():
             if self._fastpath_enabled():
+                enable_compilation_cache()
                 from .fastpath import run_cycle_fast
 
                 try:
