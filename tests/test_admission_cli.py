@@ -238,3 +238,76 @@ class TestServiceAndCli:
 
 
 import urllib.error  # noqa: E402
+
+
+class TestClientLib:
+    """volcano_tpu.client: the thin client lib + in-memory fake
+    (SURVEY.md 2.3, pkg/client analog)."""
+
+    @pytest.fixture
+    def service(self):
+        from volcano_tpu.service import Service
+
+        svc = Service(simulate=True, schedule_period=0.05,
+                      controller_period=0.05)
+        port = svc.start(http_port=0)
+        yield svc, f"http://127.0.0.1:{port}"
+        svc.stop()
+
+    def test_client_against_live_service(self, service):
+        import time
+
+        from volcano_tpu.client import ApiError, Client
+
+        svc, server = service
+        c = Client(server)
+        assert c.healthz()
+        c.add_node("cn-0", {"cpu": "8", "memory": "16Gi", "pods": 64},
+                   topology={"volcano-tpu/slice": "s0"})
+        c.create_queue("cq", weight=3)
+        assert any(q["name"] == "cq" and q["weight"] == 3
+                   for q in c.queues())
+        c.create_job({"name": "cjob", "minAvailable": 2, "queue": "cq",
+                      "tasks": [{"name": "w", "replicas": 2,
+                                 "containers": [{"cpu": "1",
+                                                 "memory": "1Gi"}]}]})
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if c.get_job("cjob")["status"]["phase"] == "Running":
+                break
+            time.sleep(0.1)
+        assert c.get_job("cjob")["status"]["phase"] == "Running"
+        assert any(j["name"] == "cjob" for j in c.jobs("default"))
+        c.suspend_job("cjob")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if c.get_job("cjob")["status"]["phase"] == "Aborted":
+                break
+            time.sleep(0.1)
+        assert c.get_job("cjob")["status"]["phase"] == "Aborted"
+        c.delete_job("cjob")
+        with pytest.raises(ApiError) as err:
+            c.get_job("cjob")
+        assert err.value.status == 404
+        assert "volcano" in c.metrics_text()
+
+    def test_fake_client_mirrors_client_surface(self):
+        from volcano_tpu.client import ApiError, Client, FakeClient
+
+        fc = FakeClient()
+        # Same public surface as the HTTP client.
+        public = {n for n in dir(Client) if not n.startswith("_")}
+        assert public <= {n for n in dir(FakeClient)
+                          if not n.startswith("_")}
+        fc.add_node("n0", {"cpu": "4", "memory": "8Gi"})
+        fc.create_queue("fq", weight=2)
+        out = fc.create_job({
+            "name": "fj", "minAvailable": 1, "queue": "fq",
+            "tasks": [{"name": "w", "replicas": 1,
+                       "containers": [{"cpu": "1", "memory": "1Gi"}]}],
+        })
+        assert out["name"] == "fj"
+        assert fc.get_job("fj")["queue"] == "fq"
+        fc.delete_job("fj")
+        with pytest.raises(ApiError):
+            fc.get_job("fj")

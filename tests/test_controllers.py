@@ -382,3 +382,54 @@ def test_min_resources_include_scalars():
     cm.process()
     pg = store.pod_groups["default/tj"]
     assert "tpu.dev/chips" in pg.min_resources
+
+
+def test_tpuslice_plugin_packs_gang_into_one_slice():
+    """SURVEY.md 2.4 item 4: TPU slice topology is a first-class node
+    attribute used by placement scoring.  Four 1-cpu tasks fit 2-per-node;
+    with the tpuslice job plugin they must co-locate on the two nodes of a
+    single slice rather than spreading across slices."""
+    from volcano_tpu.api import Node
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.sim import ClusterSimulator
+
+    store = ClusterStore()
+    for i in range(4):
+        store.add_node(Node(
+            name=f"tpu-{i}",
+            allocatable={"cpu": "2", "memory": "8Gi", "pods": 16},
+            topology={"volcano-tpu/slice": f"slice-{i // 2}"},
+        ))
+    cm = ControllerManager(store)
+    sched = Scheduler(store)
+    sim = ClusterSimulator(store)
+    job = simple_job(name="train", replicas=4, min_available=4,
+                     plugins={"tpuslice": []})
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+
+    pods = [p for p in store.pods.values()
+            if p.owner_job == "default/train"]
+    assert len(pods) == 4
+    slices = set()
+    for p in pods:
+        assert p.node_name, f"pod {p.name} unbound"
+        idx = int(p.node_name.split("-")[1])
+        slices.add(idx // 2)
+    assert len(slices) == 1, f"gang split across slices: {slices}"
+    # The injected term is visible on the pod spec.
+    term, weight = pods[0].preferred_affinity[0]
+    assert term.topology_key == "volcano-tpu/slice"
+    assert weight == 10
+
+
+def test_node_topology_folds_into_labels():
+    from volcano_tpu.api import Node
+
+    n = Node(name="n", allocatable={"cpu": "1"},
+             labels={"zone": "z1"},
+             topology={"volcano-tpu/slice": "s0", "zone": "explicit-wins"})
+    assert n.labels["volcano-tpu/slice"] == "s0"
+    assert n.labels["zone"] == "z1"  # explicit label wins collision

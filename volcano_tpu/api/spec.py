@@ -189,6 +189,11 @@ class Node:
     def __post_init__(self):
         if not self.capacity:
             self.capacity = dict(self.allocatable)
+        if self.topology:
+            # Topology coordinates are labels (as on Kubernetes nodes), so
+            # selectors, (anti)affinity, and spread resolve them through
+            # the same machinery; explicit labels win on key collision.
+            self.labels = {**self.topology, **self.labels}
 
     def allocatable_resource(self) -> Resource:
         return Resource.from_resource_list(self.allocatable)

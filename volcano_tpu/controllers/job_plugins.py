@@ -152,10 +152,58 @@ class SshPlugin:
         pod.annotations["volcano-tpu/ssh-secret"] = job.name + SSH_SECRET_SUFFIX
 
 
+TPU_SLICE_KEY = "volcano-tpu/slice"
+
+
+class TpuSlicePlugin:
+    """TPU-native job plugin (SURVEY.md section 2.4 item 4): pack a job's
+    tasks onto nodes of the same TPU slice so the gang's collectives ride
+    ICI instead of DCN.
+
+    Nodes advertise slice membership via ``Node.topology["volcano-tpu/
+    slice"]`` (topology coordinates fold into node labels); every pod of
+    the job gets a soft self-affinity term over that key, so the wave
+    solver's (term, domain) count tensors pull siblings toward the slice
+    an earlier sibling picked — the TPU analog of the reference's wiring
+    of workload placement hints through pod templates.
+
+    Argument: ``--weight=<int>`` (default 10, the score weight of the
+    injected term)."""
+
+    name = "tpuslice"
+
+    def __init__(self, arguments: List[str]):
+        self.weight = 10
+        for arg in arguments:
+            if arg.startswith("--weight="):
+                try:
+                    self.weight = max(int(arg.split("=", 1)[1]), 1)
+                except ValueError:
+                    pass
+
+    def on_job_add(self, job, store) -> None:
+        pass
+
+    def on_job_delete(self, job, store) -> None:
+        pass
+
+    def on_pod_create(self, pod: Pod, job) -> None:
+        from ..api.spec import AffinityTerm
+
+        pod.preferred_affinity.append((
+            AffinityTerm(
+                match_labels={"volcano-tpu/job-name": job.name},
+                topology_key=TPU_SLICE_KEY,
+            ),
+            self.weight,
+        ))
+
+
 PLUGIN_BUILDERS: Dict[str, Callable] = {
     "env": EnvPlugin,
     "svc": SvcPlugin,
     "ssh": SshPlugin,
+    "tpuslice": TpuSlicePlugin,
 }
 
 

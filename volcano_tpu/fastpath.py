@@ -736,6 +736,9 @@ class FastCycle:
                     arr.copy_to_host_async()
                 except AttributeError:
                     pass
+            # Commit prep that doesn't need the assignments overlaps the
+            # device solve + transfer wait.
+            req_gather = self.m.c_req.gather(task_rows)
             assigned, never_ready, fit_failed = jax.device_get(
                 (result.assigned, result.never_ready, result.fit_failed)
             )
@@ -744,7 +747,8 @@ class FastCycle:
                 (time.perf_counter() - t0) * 1e3
             )
             progress = self._commit(
-                solve_jobs, task_rows, assigned, never_ready, fit_failed
+                solve_jobs, task_rows, assigned, never_ready, fit_failed,
+                req_gather,
             )
             retry = bool(never_ready.any()) and progress
             if not progress:
@@ -798,39 +802,30 @@ class FastCycle:
 
         namespaces = sorted(by_ns.keys(), key=_cmp_key(ns_order))
         qinfo = self.store.queues
+        # Keys are static while ordering runs, so the reference's
+        # "best queue per pop" merge reduces to: per namespace, drain
+        # queues in sorted order (ties keep first-appearance order, as
+        # the linear best_q scan did); one job per namespace per round.
+        per_ns: List[List[int]] = []
+        for ns in namespaces:
+            qnames = [q for q in by_ns[ns] if not overused(qinfo[q])]
+            qnames.sort(
+                key=_cmp_key(lambda a, b: queue_order(qinfo[a], qinfo[b]))
+            )
+            per_ns.append(
+                [row for q in qnames for row in by_ns[ns][q]]
+            )
         ordered: List[_JobProxy] = []
-        ptr: Dict[Tuple[str, str], int] = {}
-        active = {ns: dict(by_ns[ns]) for ns in namespaces}
-        while active:
-            progressed = False
-            for ns in list(namespaces):
-                queues = active.get(ns)
-                if not queues:
-                    active.pop(ns, None)
-                    continue
-                best_q = None
-                for qid in list(queues.keys()):
-                    if ptr.get((ns, qid), 0) >= len(queues[qid]):
-                        del queues[qid]
-                        continue
-                    q = qinfo[qid]
-                    if overused(q):
-                        del queues[qid]
-                        continue
-                    if best_q is None or queue_order(q, qinfo[best_q]):
-                        best_q = qid
-                if best_q is None:
-                    active.pop(ns, None)
-                    continue
-                i = ptr.get((ns, best_q), 0)
-                row = by_ns[ns][best_q][i]
-                ptr[(ns, best_q)] = i + 1
-                ordered.append(_JobProxy(
-                    row, m.j_uid[row], ns, best_q, jkeys[row]
-                ))
-                progressed = True
-            if not progressed and not any(active.values()):
-                break
+        j_uid = m.j_uid
+        j_queue = m.j_queue
+        for i in range(max((len(s) for s in per_ns), default=0)):
+            for ns_i, seq in enumerate(per_ns):
+                if i < len(seq):
+                    row = seq[i]
+                    ordered.append(_JobProxy(
+                        row, j_uid[row], namespaces[ns_i], j_queue[row],
+                        jkeys[row]
+                    ))
         return ordered
 
     def _pending_rows(self, ordered: List[_JobProxy]):
@@ -1192,11 +1187,9 @@ class FastCycle:
 
         term_local = np.full(len(m.terms), -1, np.int64)
         term_local[active] = np.arange(E)
-        # 25% headroom before the pow2 round-up: raw term counts cluster
-        # near round numbers, and a population straddling a power of two
-        # would otherwise alternate buckets cycle-to-cycle — each flip is
-        # a multi-second XLA recompile of the wave solver.
-        Ep = _pow2(E + max(E // 4, 8), 1)
+        from .ops.wave import bucket_pow2
+
+        Ep = bucket_pow2(E, floor=1)
 
         # ---- sparse membership hash + per-term local membership ---------
         rng = np.random.RandomState(0x7A5E)
@@ -1382,7 +1375,7 @@ class FastCycle:
 
     def _commit(self, solve_jobs: List[int], task_rows: np.ndarray,
                 assigned: np.ndarray, never_ready: np.ndarray,
-                fit_failed: np.ndarray) -> bool:
+                fit_failed: np.ndarray, req_gather=None) -> bool:
         """Apply the assignment matrix in bulk (the vectorized _replay)."""
         m = self.m
         store = self.store
@@ -1398,7 +1391,17 @@ class FastCycle:
         # Divergence guard (vectorized analog of the replay's re-check):
         # charged capacity must not exceed allocatable.
         add = np.zeros((self.Nn, self.R), F)
-        er, si, v = m.c_req.gather(rows)
+        if req_gather is not None:
+            # Subset the caller's full-task gather (prepared while the
+            # device solve ran) down to the committed rows.
+            er_all, si_all, v_all = req_gather
+            em = committed[er_all]
+            new_idx = np.cumsum(committed) - 1
+            er = new_idx[er_all[em]]
+            si = si_all[em]
+            v = v_all[em]
+        else:
+            er, si, v = m.c_req.gather(rows)
         np.add.at(add, (nodes_c[er], si), v)
         new_used = self.n_used + add
         over = new_used > self.n_alloc + self.eps[None, :]
@@ -1444,14 +1447,15 @@ class FastCycle:
         n_name = m.n_name
         p_uid = m.p_uid
         p_key = m.p_key
+        rows_l = rows.tolist()
+        pod_l = [pods.get(p_uid[r]) for r in rows_l]
+        host_l = [n_name[n] for n in nodes_c.tolist()]
         keys = []
         hosts = []
         bound_pods = []
-        for row, nrow in zip(rows.tolist(), nodes_c.tolist()):
-            pod = pods.get(p_uid[row])
+        for row, pod, hostname in zip(rows_l, pod_l, host_l):
             if pod is None:
                 continue
-            hostname = n_name[nrow]
             pod.node_name = hostname
             keys.append(p_key[row])
             hosts.append(hostname)

@@ -835,9 +835,8 @@ def _solve_wave(
                     giver_rel = jnp.any(
                         t_matches_w & term_required[None, :], axis=1
                     )
-                    involved_any = jnp.any(p_involved[pid_l], axis=1)
                     dirty_next = jnp.any(
-                        resolved & (involved_any | giver_rel)
+                        resolved & (involved_any_t | giver_rel)
                     )
                 else:
                     dirty_next = jnp.bool_(False)
@@ -1070,6 +1069,18 @@ def _profiles_from_pid(tasks: SolveTasks, aff: AffinityArgs,
     return profiles, pid
 
 
+def bucket_pow2(n: int, floor: int, min_pad: int = 8) -> int:
+    """Anti-recompile shape bucket: next power of two >= n plus 25%
+    headroom (raw counts clustering at a power of two must not flip
+    buckets cycle-to-cycle — each flip is a multi-second XLA recompile).
+    ``floor`` bounds the smallest bucket per axis."""
+    target = n + max(n // 4, min_pad)
+    b = max(floor, 1)
+    while b < target:
+        b *= 2
+    return b
+
+
 def _pad_profiles_rows(profiles: SolveProfiles) -> SolveProfiles:
     """Pad the profile table's row axis to a power of two (min 64) with
     inert zero rows.  The row count is data-dependent (distinct task
@@ -1078,13 +1089,7 @@ def _pad_profiles_rows(profiles: SolveProfiles) -> SolveProfiles:
     dwarfing the solve itself.  Padded rows are never referenced: pid and
     wave_prof only index real rows."""
     U = int(_np(profiles.req).shape[0])
-    # Same 25% headroom as Ep/EW: a profile count hovering at a power of
-    # two must not flip buckets cycle-to-cycle.
-    target = U + max(U // 4, 8)
-    UB = 64
-    while UB < target:
-        UB *= 2
-    pad = UB - U
+    pad = bucket_pow2(U, floor=64) - U
     if pad == 0:
         return profiles
     def z(a):
@@ -1142,13 +1147,7 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
         terms = np.flatnonzero(iom[pids].any(axis=0))
         term_lists.append(terms)
         ew = max(ew, len(terms))
-    # 25% headroom before the pow2 round-up (min 16): per-wave term
-    # counts near a power of two would otherwise flip the EW bucket
-    # between cycles, recompiling the solver (see fastpath Ep).
-    EW = 16
-    target = ew + max(ew // 4, 4)
-    while EW < target:
-        EW *= 2
+    EW = bucket_pow2(ew, floor=16, min_pad=4)
     wave_terms = np.full((n_waves, EW), E, np.int32)  # pad = dummy row
     for w, terms in enumerate(term_lists):
         wave_terms[w, :len(terms)] = terms

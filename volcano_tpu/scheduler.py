@@ -60,6 +60,26 @@ def enable_compilation_cache() -> None:
         log.warning("compilation cache unavailable: %s", err)
 
 
+def _device_trace():
+    """JAX profiler hook (SURVEY.md 5.1: histograms + device trace for
+    kernel/transfer time).  Set VOLCANO_TPU_TRACE_DIR=<dir> to capture a
+    per-cycle device trace viewable in TensorBoard/Perfetto; unset, this
+    is a no-op context."""
+    import contextlib
+    import os
+
+    trace_dir = os.environ.get("VOLCANO_TPU_TRACE_DIR")
+    if not trace_dir:
+        return contextlib.nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.trace(trace_dir)
+    except Exception as err:  # pragma: no cover - profiler is best-effort
+        log.warning("device trace unavailable: %s", err)
+        return contextlib.nullcontext()
+
+
 class Scheduler:
     def __init__(
         self,
@@ -115,7 +135,7 @@ class Scheduler:
         action_names = [
             a.strip() for a in conf.actions.split(",") if a.strip()
         ]
-        with metrics.e2e_timer():
+        with metrics.e2e_timer(), _device_trace():
             if self._fastpath_enabled():
                 enable_compilation_cache()
                 from .fastpath import run_cycle_fast

@@ -313,6 +313,7 @@ class Service:
                                 name=body["name"],
                                 allocatable=body.get("allocatable", {}),
                                 labels=body.get("labels", {}),
+                                topology=body.get("topology", {}),
                             )
                         )
                         self._json(201, {"ok": True})
