@@ -950,7 +950,10 @@ def _solve_wave(
 
 
 def _np(a):
-    return np.asarray(a)
+    # ascontiguousarray: no-op for the usual numpy inputs; jax arrays
+    # fetched from a sharded placement can materialize non-contiguous,
+    # which breaks the profile-hash .view(uint8) reinterpret.
+    return np.ascontiguousarray(a)
 
 
 _HASH_SEED = np.random.RandomState(0x5EED)

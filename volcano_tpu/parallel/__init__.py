@@ -1,5 +1,7 @@
 """Multi-chip scaling: device mesh + sharded solve."""
 
-from .mesh import make_mesh, shard_solve_args, sharded_solve
+from .mesh import (make_mesh, shard_solve_args, sharded_solve,
+                   sharded_solve_wave)
 
-__all__ = ["make_mesh", "shard_solve_args", "sharded_solve"]
+__all__ = ["make_mesh", "shard_solve_args", "sharded_solve",
+           "sharded_solve_wave"]

@@ -74,10 +74,25 @@ def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
 
 
 def sharded_solve(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
-    """Run the allocate solver with node state sharded over the mesh."""
+    """Run the sequential allocate solver with node state sharded over
+    the mesh."""
     from ..ops.allocate import solve
 
     # Input shardings drive GSPMD partitioning; no explicit mesh context is
     # needed for jit with device_put-committed arguments.
     args = shard_solve_args(mesh, solve_args, axis)
     return solve(*args)
+
+
+def sharded_solve_wave(mesh: Mesh, solve_args: Sequence,
+                       axis: str = NODES_AXIS, wave: Optional[int] = None):
+    """Run the production wave solver with node state sharded over the
+    mesh: the per-attempt [UM, N] feasibility/score tensors partition on
+    N, the top-k ranking becomes a cross-chip top-k over ICI, and the
+    [W, W] prefix-acceptance matmuls stay replicated (W is mesh-size
+    independent)."""
+    from ..ops.wave import solve_wave
+
+    args = shard_solve_args(mesh, solve_args, axis)
+    kw = {} if wave is None else {"wave": wave}
+    return solve_wave(*args, **kw)

@@ -60,24 +60,40 @@ def enable_compilation_cache() -> None:
         log.warning("compilation cache unavailable: %s", err)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
 def _device_trace():
     """JAX profiler hook (SURVEY.md 5.1: histograms + device trace for
     kernel/transfer time).  Set VOLCANO_TPU_TRACE_DIR=<dir> to capture a
     per-cycle device trace viewable in TensorBoard/Perfetto; unset, this
-    is a no-op context."""
-    import contextlib
+    is a no-op context.  Best-effort: profiler failures (unwritable dir,
+    trace already active) must not abort the scheduling cycle, so entry
+    and exit errors are swallowed here — jax.profiler.trace raises at
+    __enter__, which a plain try around its construction cannot catch."""
     import os
 
     trace_dir = os.environ.get("VOLCANO_TPU_TRACE_DIR")
     if not trace_dir:
-        return contextlib.nullcontext()
+        yield
+        return
+    started = False
     try:
         import jax
 
-        return jax.profiler.trace(trace_dir)
+        jax.profiler.start_trace(trace_dir)
+        started = True
     except Exception as err:  # pragma: no cover - profiler is best-effort
         log.warning("device trace unavailable: %s", err)
-        return contextlib.nullcontext()
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as err:  # pragma: no cover
+                log.warning("device trace stop failed: %s", err)
 
 
 class Scheduler:
