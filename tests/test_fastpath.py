@@ -92,3 +92,23 @@ def test_object_model_rebuild_after_fast_cycle():
     # Node accounting balances.
     for node in store.nodes.values():
         assert node.idle.milli_cpu >= -1e-6
+
+
+def test_chunked_solve_matches_unchunked(monkeypatch):
+    """Forcing a tiny affinity budget splits the solve into job-aligned
+    chunks with commits in between; the set of binds must match the
+    single-call solve (later chunks seeing earlier placements is the
+    sequential reference's own semantics)."""
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    kw = dict(n_nodes=16, n_pods=96, gang_size=4, zones=4,
+              affinity_fraction=0.2, anti_affinity_fraction=0.1,
+              spread_fraction=0.2, seed=3)
+    a = synthetic_cluster(**kw)
+    Scheduler(a).run_once()
+    monkeypatch.setenv("VOLCANO_TPU_AFF_BUDGET_MB", "0.0001")
+    b = synthetic_cluster(**kw)
+    Scheduler(b).run_once()
+    assert len(b.binder.binds) == len(a.binder.binds)
+    assert set(b.binder.binds) == set(a.binder.binds)

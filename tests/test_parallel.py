@@ -44,13 +44,18 @@ def test_sharded_wave_solve_places_full_count():
     from volcano_tpu.ops.wave import solve_wave
     from volcano_tpu.parallel import make_mesh, sharded_solve_wave
 
+    from test_wave import _check_invariants
+
     args = _args()
     mesh = make_mesh(8)
-    sharded = np.asarray(sharded_solve_wave(mesh, args).assigned)
+    res = sharded_solve_wave(mesh, args)
+    sharded = np.asarray(res.assigned)
     single = np.asarray(solve_wave(*args).assigned)
     # Cross-shard reduction order may flip score near-ties; the placement
-    # COUNT and capacity-validity must hold.
+    # COUNT, oversubscription, and gang invariants must hold.
+    assert (sharded >= 0).any()
     assert int((sharded >= 0).sum()) == int((single >= 0).sum())
+    _check_invariants(args, res)
 
 
 @needs_8

@@ -1,0 +1,88 @@
+"""Randomized invariant sweep (SURVEY.md 4.3: property tests — gang
+atomicity, no oversubscription) over seeded synthetic clusters.
+
+Each seed draws a different cluster shape/gang mix; invariants are checked
+from the store after a full cycle, independent of the solver's internals.
+"""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _shape(seed):
+    rng = np.random.RandomState(seed)
+    return dict(
+        n_nodes=int(rng.randint(4, 24)),
+        n_pods=int(rng.randint(8, 120)),
+        gang_size=int(rng.choice([1, 2, 3, 5, 8])),
+        n_queues=int(rng.choice([1, 2, 3])),
+        zones=int(rng.choice([0, 2, 4])),
+        affinity_fraction=float(rng.choice([0.0, 0.2])),
+        anti_affinity_fraction=float(rng.choice([0.0, 0.2])),
+        spread_fraction=float(rng.choice([0.0, 0.3])),
+        seed=seed,
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_cycle_invariants(seed):
+    kw = _shape(seed)
+    store = synthetic_cluster(**kw)
+    Scheduler(store, conf_str=CONF).run_once()
+
+    # --- no oversubscription: per-node bound requests fit allocatable ---
+    node_alloc = {}
+    node_used = {}
+    for name, ninfo in store.nodes.items():
+        node_alloc[name] = ninfo.node.allocatable_resource()
+        node_used[name] = Resource()
+    per_job_bound = {}
+    for pod in store.pods.values():
+        if pod.node_name:
+            req = Resource()
+            for c in pod.containers:
+                req.add(Resource.from_resource_list(c))
+            node_used[pod.node_name].add(req)
+        gid = pod.job_id()
+        if gid:
+            per_job_bound.setdefault(gid, [0, 0])
+            per_job_bound[gid][1] += 1
+            if pod.node_name:
+                per_job_bound[gid][0] += 1
+    for name, used in node_used.items():
+        assert used.less_equal(node_alloc[name]), (
+            f"node {name} oversubscribed: {used} > {node_alloc[name]}"
+        )
+
+    # --- gang atomicity: a gang binds fully-to-min or not at all -------
+    for group, (bound, total) in per_job_bound.items():
+        pg = store.pod_groups.get(group)
+        if pg is None:
+            continue
+        assert bound == 0 or bound >= pg.min_member, (
+            f"gang {group} partially bound: {bound}/{total} "
+            f"(min {pg.min_member})"
+        )
+
+    # --- binds only on known nodes -------------------------------------
+    for pod in store.pods.values():
+        if pod.node_name:
+            assert pod.node_name in node_alloc

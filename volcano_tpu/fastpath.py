@@ -31,6 +31,7 @@ falls back to the object path, which remains the semantic reference.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -719,40 +720,126 @@ class FastCycle:
             if prep is None:
                 return
             solve_jobs, task_rows = prep
-            inputs, pid, profiles = self._solve_inputs(solve_jobs, task_rows)
-            t0 = time.perf_counter()
-            if solver == "wave":
-                result = solve_fn(*inputs, pid=pid, profiles=profiles)
-            else:
-                result = solve_fn(*inputs)
-            # One batched device->host fetch: through a remote-TPU tunnel
-            # each fetch RPC carries ~100 ms fixed latency, so three
-            # sequential np.asarray() calls triple the cycle's floor.
-            import jax
+            progress_any = False
+            never_any = False
+            for cjobs, crows in self._solve_chunks(solve_jobs, task_rows):
+                inputs, pid, profiles = self._solve_inputs(cjobs, crows)
+                t0 = time.perf_counter()
+                if solver == "wave":
+                    result = solve_fn(*inputs, pid=pid, profiles=profiles)
+                else:
+                    result = solve_fn(*inputs)
+                # One batched device->host fetch: through a remote-TPU
+                # tunnel each fetch RPC carries ~100 ms fixed latency, so
+                # three sequential np.asarray() calls triple the cycle's
+                # floor.
+                import jax
 
-            for arr in (result.assigned, result.never_ready,
-                        result.fit_failed):
-                try:
-                    arr.copy_to_host_async()
-                except AttributeError:
-                    pass
-            # Commit prep that doesn't need the assignments overlaps the
-            # device solve + transfer wait.
-            req_gather = self.m.c_req.gather(task_rows)
-            assigned, never_ready, fit_failed = jax.device_get(
-                (result.assigned, result.never_ready, result.fit_failed)
-            )
-            assigned = assigned[:len(task_rows)]
-            metrics.device_solve_latency.observe(
-                (time.perf_counter() - t0) * 1e3
-            )
-            progress = self._commit(
-                solve_jobs, task_rows, assigned, never_ready, fit_failed,
-                req_gather,
-            )
-            retry = bool(never_ready.any()) and progress
-            if not progress:
+                for arr in (result.assigned, result.never_ready,
+                            result.fit_failed):
+                    try:
+                        arr.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                # Commit prep that doesn't need the assignments overlaps
+                # the device solve + transfer wait.
+                req_gather = self.m.c_req.gather(crows)
+                assigned, never_ready, fit_failed = jax.device_get(
+                    (result.assigned, result.never_ready,
+                     result.fit_failed)
+                )
+                assigned = assigned[:len(crows)]
+                metrics.device_solve_latency.observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
+                progress = self._commit(
+                    cjobs, crows, assigned, never_ready, fit_failed,
+                    req_gather,
+                )
+                progress_any |= progress
+                never_any |= bool(never_ready.any())
+            retry = never_any and progress_any
+            if not progress_any:
                 return
+
+    def _solve_chunks(self, solve_jobs: List[int], task_rows: np.ndarray):
+        """Split one solve call at job boundaries when the affinity count
+        tensors would blow the device-memory budget.
+
+        The solver carries two dense [E, D] int32 count tensors; at
+        hyperscale with hostname-domain terms (50k nodes, 12k+ terms)
+        that is tens of GB.  Terms active per chunk shrink with the
+        chunk's job population, so solving in job-aligned chunks with a
+        host commit in between bounds the footprint — and later chunks
+        legitimately see earlier chunks' placements (the same state the
+        reference's sequential walk would show them)."""
+        m = self.m
+        D = max(1, len(m.domains))
+        raw = os.environ.get("VOLCANO_TPU_AFF_BUDGET_MB", "1024")
+        try:
+            budget = float(raw) * 1e6
+        except ValueError:
+            log.warning(
+                "VOLCANO_TPU_AFF_BUDGET_MB=%r is not a number; "
+                "using 1024", raw,
+            )
+            budget = 1024e6
+        # Footprint scales with the terms the PENDING rows actually touch
+        # (the solver compacts [E, D] to active terms), not the mirror's
+        # full interned term table.
+        er_a, ei_a = m.c_ip_aff.gather(task_rows)
+        er_n, ei_n = m.c_ip_anti.gather(task_rows)
+        er_s, ei_s, _ = m.c_ip_soft.gather(task_rows)
+        refs_row = np.concatenate([er_a, er_n, er_s])
+        refs_term = np.concatenate([ei_a, ei_n, ei_s])
+        E = len(np.unique(refs_term)) if len(refs_term) else 0
+        cost = float(E) * D * 8.0  # two int32 [E, D] tensors
+        if cost <= budget or len(solve_jobs) <= 1:
+            if cost > budget:
+                log.warning(
+                    "affinity count tensors ~%.0f MB exceed the %.0f MB "
+                    "budget but a single job cannot be split",
+                    cost / 1e6, budget / 1e6,
+                )
+            yield solve_jobs, task_rows
+            return
+        order = np.argsort(refs_row, kind="stable")
+        refs_row = refs_row[order]
+        refs_term = refs_term[order]
+        n_chunks = min(int(np.ceil(cost / budget)), len(solve_jobs))
+        target = max(1, int(np.ceil(len(task_rows) / n_chunks)))
+        jr = self.jobr[task_rows]
+        # Job segment boundaries in the job-contiguous task_rows.
+        seg_starts = np.flatnonzero(
+            np.concatenate(([True], jr[1:] != jr[:-1]))
+        )
+        seg_ends = np.concatenate((seg_starts[1:], [len(task_rows)]))
+
+        def emit(cjobs, lo, hi):
+            i0, i1 = np.searchsorted(refs_row, [lo, hi])
+            e_chunk = len(np.unique(refs_term[i0:i1]))
+            if e_chunk * D * 8.0 > budget:
+                log.warning(
+                    "solve chunk of %d jobs still carries ~%.0f MB of "
+                    "affinity count tensors (budget %.0f MB)",
+                    len(cjobs), e_chunk * D * 8.0 / 1e6, budget / 1e6,
+                )
+            return cjobs, task_rows[lo:hi]
+
+        chunk_jobs: List[int] = []
+        lo = 0
+        hi = 0
+        ji = 0
+        for s, e in zip(seg_starts, seg_ends):
+            hi = int(e)
+            chunk_jobs.append(solve_jobs[ji])
+            ji += 1
+            if hi - lo >= target and ji < len(solve_jobs):
+                yield emit(chunk_jobs, lo, hi)
+                chunk_jobs = []
+                lo = hi
+        if hi > lo or chunk_jobs:
+            yield emit(chunk_jobs, lo, hi)
 
     def _schedulable_rows(self) -> List[int]:
         m = self.m
