@@ -40,6 +40,50 @@ def test_dist_job_parses():
     assert "svc" in job.plugins
 
 
+def test_dist_job_runs_to_completion():
+    """The PS/worker example runs end-to-end: rendezvous env injected,
+    TaskCompleted on the workers completes the job (its task-level
+    policy), as the reference's distributed-MNIST e2e does
+    (test/e2e/tensorflow.go:30)."""
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.sim import ClusterSimulator
+    from volcano_tpu.api import Node
+    from volcano_tpu.cache import ClusterStore
+
+    data = yaml.safe_load((EXAMPLES / "tensorflow-dist.yaml").read_text())
+    job = job_from_dict(data)
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "4", "memory": "8Gi",
+                                         "pods": 16}))
+    cm = ControllerManager(store)
+    sched = Scheduler(store)
+    sim = ClusterSimulator(store)
+    store.add_batch_job(job)
+    for _ in range(4):
+        cm.process()
+        sched.run_once()
+        sim.step()
+        cm.process()
+    pods = [p for p in store.pods.values()
+            if p.owner_job == "default/dist-mnist"]
+    assert len(pods) == 3
+    worker = next(p for p in pods if p.task_name == "worker")
+    assert worker.env["WORKER_NUM"] == "2"
+    assert "PS_HOSTS" in worker.env
+    assert "VC_PROCESS_ID" in worker.env
+    # Workers complete -> TaskCompleted task policy -> CompleteJob.
+    for _ in range(6):
+        cm.process()
+        sched.run_once()
+        sim.step(complete=lambda p: 0 if p.task_name == "worker"
+                 else None)
+        cm.process()
+    assert store.batch_jobs["default/dist-mnist"].status.state.phase == \
+        "Completed"
+
+
 def test_scheduler_confs_parse():
     for name in ("scheduler-conf.yaml", "preempt-conf.yaml"):
         conf = parse_scheduler_conf((EXAMPLES / name).read_text())

@@ -112,3 +112,37 @@ def test_chunked_solve_matches_unchunked(monkeypatch):
     Scheduler(b).run_once()
     assert len(b.binder.binds) == len(a.binder.binds)
     assert set(b.binder.binds) == set(a.binder.binds)
+
+
+def test_bind_failure_resyncs_tasks_to_pending():
+    """A binder reporting partial failure (BindFailure) reverts exactly
+    the failed tasks to Pending — the errTasks resync semantics
+    (cache.go:627-649) — and the next cycle retries them."""
+    from volcano_tpu.cache.interface import BindFailure
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    store = synthetic_cluster(n_nodes=8, n_pods=24, gang_size=1)
+    orig_bind_keys = store.binder.bind_keys
+    state = {"fail_once": True}
+
+    def flaky_bind_keys(keys, hosts):
+        if state["fail_once"]:
+            state["fail_once"] = False
+            ok = [(k, h) for k, h in zip(keys, hosts)][: len(keys) // 2]
+            orig_bind_keys([k for k, _ in ok], [h for _, h in ok])
+            raise BindFailure([k for k in keys[len(keys) // 2:]])
+        orig_bind_keys(keys, hosts)
+
+    store.binder.bind_keys = flaky_bind_keys
+    sched = Scheduler(store)
+    sched.run_once()
+    bound_1 = len(store.binder.binds)
+    assert bound_1 == 12
+    # Failed tasks are Pending again, not phantom-bound.
+    pending = [p for p in store.pods.values() if p.node_name is None]
+    assert len(pending) == 12
+    # Next cycle rebinds them.
+    sched.run_once()
+    assert len(store.binder.binds) == 24
+    assert all(p.node_name for p in store.pods.values())

@@ -35,6 +35,20 @@ class VolumeBinder(Protocol):
     def bind_volumes(self, task: TaskInfo) -> None: ...
 
 
+class BindFailure(Exception):
+    """Raised by a binder when some binds could not be dispatched.
+
+    ``failed`` holds the "ns/name" keys that did NOT bind.  The fast
+    path reverts exactly those tasks to Pending so the next cycle
+    retries them — the errTasks resync semantics of cache.go:627-649
+    (there: failed bind RPCs push the task onto a rate-limited queue
+    that re-syncs it from the API server)."""
+
+    def __init__(self, failed):
+        super().__init__(f"{len(failed)} binds failed")
+        self.failed = list(failed)
+
+
 class FakeBinder:
     """Records binds into a map + ordered channel (test_utils.go:94-117)."""
 

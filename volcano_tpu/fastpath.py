@@ -779,10 +779,13 @@ class FastCycle:
         try:
             budget = float(raw) * 1e6
         except ValueError:
-            log.warning(
-                "VOLCANO_TPU_AFF_BUDGET_MB=%r is not a number; "
-                "using 1024", raw,
-            )
+            budget = float("nan")
+        if not (0 < budget < float("inf")):  # catches NaN, 0, negatives
+            if raw != "1024":
+                log.warning(
+                    "VOLCANO_TPU_AFF_BUDGET_MB=%r is not a positive "
+                    "number; using 1024", raw,
+                )
             budget = 1024e6
         # Footprint scales with the terms the PENDING rows actually touch
         # (the solver compacts [E, D] to active terms), not the mirror's
@@ -1540,6 +1543,7 @@ class FastCycle:
         keys = []
         hosts = []
         bound_pods = []
+        bound_rows = []
         for row, pod, hostname in zip(rows_l, pod_l, host_l):
             if pod is None:
                 continue
@@ -1547,11 +1551,29 @@ class FastCycle:
             keys.append(p_key[row])
             hosts.append(hostname)
             bound_pods.append(pod)
-        if bind_keys is not None:
-            bind_keys(keys, hosts)
-        else:
-            for pod, hostname in zip(bound_pods, hosts):
-                binder.bind(pod, hostname)
+            bound_rows.append(row)
+        from .cache.interface import BindFailure
+
+        try:
+            if bind_keys is not None:
+                bind_keys(keys, hosts)
+            else:
+                failed = []
+                for pod, hostname, key in zip(bound_pods, hosts, keys):
+                    try:
+                        binder.bind(pod, hostname)
+                    except BindFailure:
+                        failed.append(key)
+                if failed:
+                    raise BindFailure(failed)
+        except BindFailure as bf:
+            self._revert_failed_binds(bf.failed, keys, bound_rows,
+                                      bound_pods)
+            failed = set(bf.failed)
+            bound_pods = [
+                pod for pod, key in zip(bound_pods, keys)
+                if key not in failed
+            ]
         if notify:
             for pod in bound_pods:
                 store._notify("Pod", "bind", pod)
@@ -1559,6 +1581,54 @@ class FastCycle:
         store.mark_objects_stale()
         self._record_fit_failures(solve_jobs, fit_failed)
         return True
+
+    def _revert_failed_binds(self, failed_keys, keys: List[str],
+                             bound_rows: List[int],
+                             bound_pods: List[object]) -> None:
+        """Undo the commit bookkeeping for binds the binder reports
+        failed (cache.go errTasks resync): the tasks return to Pending
+        and the next cycle retries them.
+
+        The revert is per-task, as in the reference: a gang whose member
+        bind fails stays partially bound below min_available until the
+        retry succeeds — the reference likewise leaves the other members
+        bound while errTasks resyncs the failed one, with the gang
+        plugin's session-close conditions and the job's lifecycle
+        policies handling a persistently failing member."""
+        m = self.m
+        failed = set(failed_keys)
+        idx = [i for i, k in enumerate(keys) if k in failed]
+        if not idx:
+            return
+        log.warning("%d binds failed; tasks resync to Pending", len(idx))
+        rows_f = np.array([bound_rows[i] for i in idx], np.int64)
+        nodes_f = m.p_node[rows_f].astype(np.int64)
+        sub = np.zeros((self.Nn, self.R), F)
+        er, si, v = m.c_req.gather(rows_f)
+        np.add.at(sub, (nodes_f[er], si), v)
+        self.n_used = self.n_used - sub
+        self.n_idle = self.n_idle + sub
+        np.add.at(self.n_ntasks, nodes_f, -1)
+        m.p_status[rows_f] = ST_PENDING
+        m.p_node[rows_f] = -1
+        self.resident[rows_f] = False
+        jr = self.jobr[rows_f]
+        np.add.at(self.j_cnt_alloc, jr, -1)
+        np.add.at(self.j_cnt_pending, jr, 1)
+        self.j_ready_base = (
+            self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_empty_pending
+        )
+        np.add.at(self.j_alloc_res, (jr[er], si), -v)
+        np.add.at(self.j_pending_res, (jr[er], si), v)
+        q_of = self.q_of_job[jr]
+        qmask = q_of >= 0
+        if qmask.any():
+            er_q = qmask[er]
+            np.add.at(
+                self.q_alloc, (q_of[er][er_q], si[er_q]), -v[er_q]
+            )
+        for i in idx:
+            bound_pods[i].node_name = None
 
     def _record_fit_failures(self, solve_jobs: List[int],
                              fit_failed: np.ndarray) -> None:
@@ -1615,6 +1685,7 @@ class FastCycle:
             binder = store.binder
             bind_batch = getattr(binder, "bind_batch", None)
             pairs = []
+            pair_rows = []
             for row in bound_rows:
                 pod = store.pods.get(m.p_uid[row])
                 if pod is None:
@@ -1622,11 +1693,46 @@ class FastCycle:
                 hostname = m.n_name[m.p_node[row]]
                 pod.node_name = hostname
                 pairs.append((pod, hostname))
-            if bind_batch is not None:
-                bind_batch(pairs)
-            else:
-                for pod, hostname in pairs:
-                    binder.bind(pod, hostname)
+                pair_rows.append(row)
+            from .cache.interface import BindFailure
+
+            failed_keys = set()
+            try:
+                if bind_batch is not None:
+                    bind_batch(pairs)
+                else:
+                    for pod, hostname in pairs:
+                        binder.bind(pod, hostname)
+            except BindFailure as bf:
+                failed_keys = set(bf.failed)
+            if failed_keys:
+                # BestEffort revert: no resource accounting to undo, only
+                # status/placement/counters (errTasks resync semantics).
+                log.warning(
+                    "%d backfill binds failed; tasks resync to Pending",
+                    len(failed_keys),
+                )
+                kept = []
+                for row, (pod, hostname) in zip(pair_rows, pairs):
+                    key = f"{pod.namespace}/{pod.name}"
+                    if key not in failed_keys:
+                        kept.append((pod, hostname))
+                        continue
+                    jrow = self.jobr[row]
+                    m.p_status[row] = ST_PENDING
+                    self.n_ntasks[m.p_node[row]] -= 1
+                    m.p_node[row] = -1
+                    self.resident[row] = False
+                    pod.node_name = None
+                    if jrow >= 0:
+                        self.j_cnt_alloc[jrow] -= 1
+                        self.j_cnt_pending[jrow] += 1
+                        self.j_cnt_empty_pending[jrow] += 1
+                pairs = kept
+                self.j_ready_base = (
+                    self.j_cnt_alloc + self.j_cnt_succ
+                    + self.j_cnt_empty_pending
+                )
             for pod, _ in pairs:
                 if store._watchers:
                     store._notify("Pod", "bind", pod)
