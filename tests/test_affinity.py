@@ -294,3 +294,36 @@ def test_oracle_parity_with_affinity(seed):
     np.testing.assert_array_equal(np.asarray(got.pipelined), want.pipelined)
     np.testing.assert_array_equal(np.asarray(got.never_ready), want.never_ready)
     np.testing.assert_array_equal(np.asarray(got.fit_failed), want.fit_failed)
+
+
+def test_same_domain_affinity_siblings_place_in_few_subrounds():
+    """Required-affinity siblings landing in the earlier sibling's domain
+    are mutually consistent and must place together, not one per
+    sub-round: a 12-task self-affinity gang on a 2-zone cluster should
+    resolve in a handful of solver iterations, not O(gang size)."""
+    store = _store_with_zones(n_per_zone=4, cpu="16")
+    term = AffinityTerm(match_labels={"app": "big"}, topology_key="zone")
+    pods = [
+        Pod(name=f"big-{k}", labels={"app": "big"},
+            containers=[{"cpu": "1", "memory": "1Gi"}],
+            affinity=[term])
+        for k in range(12)
+    ]
+    _gang(store, "big", pods)
+    from volcano_tpu.ops.wave import solve_wave
+    from volcano_tpu.synth import solve_args_from_store
+
+    args, maps = solve_args_from_store(store)
+    res = solve_wave(*args)
+    names = {}
+    for i, ti in enumerate(maps.task_infos):
+        n = int(np.asarray(res.assigned)[i])
+        names[ti.name] = maps.node_names[n] if n >= 0 else None
+    assert None not in names.values()
+    zones = {n.rsplit("-n", 1)[0] for n in names.values()}
+    assert len(zones) == 1, f"gang split across zones: {names}"
+    iters = int(np.asarray(res.iters))
+    assert iters <= 8, (
+        f"same-domain affinity siblings serialized: {iters} iterations "
+        f"for a 12-task gang"
+    )

@@ -795,8 +795,12 @@ class FastCycle:
         er_s, ei_s, _ = m.c_ip_soft.gather(task_rows)
         refs_row = np.concatenate([er_a, er_n, er_s])
         refs_term = np.concatenate([ei_a, ei_n, ei_s])
+        from .ops.wave import bucket_pow2
+
         E = len(np.unique(refs_term)) if len(refs_term) else 0
-        cost = float(E) * D * 8.0  # two int32 [E, D] tensors
+        # Two int32 [Ep, D] tensors; budget against the solver's actual
+        # padded bucket (headroom + pow2 round-up reaches 2.5x raw).
+        cost = float(bucket_pow2(E, floor=1)) * D * 8.0 if E else 0.0
         if cost <= budget or len(solve_jobs) <= 1:
             if cost > budget:
                 log.warning(
@@ -821,11 +825,14 @@ class FastCycle:
         def emit(cjobs, lo, hi):
             i0, i1 = np.searchsorted(refs_row, [lo, hi])
             e_chunk = len(np.unique(refs_term[i0:i1]))
-            if e_chunk * D * 8.0 > budget:
+            padded = (
+                bucket_pow2(e_chunk, floor=1) * D * 8.0 if e_chunk else 0.0
+            )
+            if padded > budget:
                 log.warning(
                     "solve chunk of %d jobs still carries ~%.0f MB of "
                     "affinity count tensors (budget %.0f MB)",
-                    len(cjobs), e_chunk * D * 8.0 / 1e6, budget / 1e6,
+                    len(cjobs), padded / 1e6, budget / 1e6,
                 )
             return cjobs, task_rows[lo:hi]
 

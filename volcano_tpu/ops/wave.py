@@ -688,26 +688,31 @@ def _solve_wave(
                         )
                         out = clean_in & aff_ok & anti_ok
                         # Same-domain interaction with earlier tasks of
-                        # THIS sub-round stays conservative (their count
-                        # updates are not applied yet).  A task relying on
-                        # the self-match rule additionally conflicts with
-                        # ANY earlier giver of the term, whatever its
-                        # domain — otherwise two siblings could each claim
-                        # "first" and split the gang across domains (the
-                        # sequential path serializes them).
-                        involved = p_involved[pid_l] & (dw >= 0)  # [W, EW]
+                        # THIS sub-round: only ANTI terms serialize (an
+                        # earlier giver in my domain would violate my
+                        # anti constraint once committed).  Required
+                        # AFFINITY siblings landing in the same domain
+                        # are mutually consistent — the earlier giver
+                        # satisfies the later one, exactly what the
+                        # sequential walk would produce — so they place
+                        # in one pass.  A task relying on the self-match
+                        # rule conflicts only with an earlier giver in a
+                        # DIFFERENT domain (two "firsts" splitting the
+                        # gang); an earlier same-domain giver makes its
+                        # placement consistent.
+                        anti_inv = (
+                            p_t_req_anti[pid_l] & (dw >= 0)
+                        )  # [W, EW]
                         gives = t_matches_w & (dw >= 0)
                         uses_selfok = (
                             req_aff_t & selfok_t & (cval_t == 0)
                         )  # [W, EW]
                         # Pair conflicts via scatter-min over (term,
                         # domain) keys instead of an O(W^2 * EW) pair
-                        # tensor: task i conflicts iff some earlier live
-                        # giver shares one of i's involved (term, domain)
-                        # keys — i.e. the minimum giver index of the key
-                        # is < i.  Self-match users conflict with ANY
-                        # earlier giver of the term (any domain), via a
-                        # per-term scatter-min.
+                        # tensor: the minimum live-giver index per key
+                        # identifies the earliest giver in each domain;
+                        # its per-term min (gt) the earliest giver in any
+                        # domain.
                         jidx = jnp.arange(W, dtype=jnp.int32)
                         gmask = gives & live[:, None]  # [W, EW]
                         keyv = (
@@ -722,19 +727,22 @@ def _solve_wave(
                                 jidx[:, None], (W, EW)
                             ).reshape(-1))
                         )
-                        conflict_dom = jnp.any(
-                            involved & (gm[keyv] < jidx[:, None]), axis=1
+                        gm_my = gm[keyv]  # [W, EW] earliest in my domain
+                        conflict_anti = jnp.any(
+                            anti_inv & (gm_my < jidx[:, None]), axis=1
                         )
-                        # Per-term giver minimum: every gives entry has a
-                        # domain, so the min over domains of gm is exactly
-                        # the per-term scatter-min — no second scatter
-                        # needed.
                         gt = gm[:EW * D].reshape(EW, D).min(axis=1)
+                        # Domain-less nodes (dw < 0) have no "my domain":
+                        # a selfok user there conflicts with ANY earlier
+                        # giver (the committed count kills its selfok on
+                        # the next attempt, as the sequential walk would).
+                        gm_my_self = jnp.where(dw >= 0, gm_my, W)
                         conflict_self = jnp.any(
                             uses_selfok
-                            & (gt[None, :] < jidx[:, None]), axis=1
+                            & (gt[None, :] < jidx[:, None])
+                            & (gm_my_self > gt[None, :]), axis=1
                         )
-                        return out & ~(conflict_dom | conflict_self)
+                        return out & ~(conflict_anti | conflict_self)
 
                     clean = jax.lax.cond(
                         wave_live & jnp.any(cand_s & involved_any_t),
