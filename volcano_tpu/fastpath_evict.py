@@ -986,13 +986,17 @@ class FastEvictor:
         from .fastpath import _vec_le
 
         init_req = st.init_req[prow]
-        feasible = self.feasible_mask(prow)
-        # Necessary-condition prefilter: the node's future idle plus ALL
-        # its in-scope victims' resources must cover the preemptor —
-        # otherwise the exact walk below cannot succeed there.
+        # Necessary-condition prefilter first (cheaper than the full
+        # predicate mask): the node's future idle plus ALL its in-scope
+        # victims' resources must cover the preemptor — otherwise the
+        # exact walk below cannot succeed there.  As victims deplete this
+        # empties and skips the predicate mask wholesale.
         ev = self._evictable_for(evict_key)
-        feasible = feasible & self._le_rows(init_req, st.fi, ev)
-        rows_f = np.flatnonzero(feasible & c.n_alive)
+        feasible = self._le_rows(init_req, st.fi, ev) & c.n_alive
+        if not feasible.any():
+            return False
+        feasible &= self.feasible_mask(prow)
+        rows_f = np.flatnonzero(feasible)
         if not len(rows_f):
             return False
         sc = self.scores(prow)[rows_f]
@@ -1201,14 +1205,17 @@ class FastEvictor:
                 # node walk wholesale.
                 queues_pq.push(qname)
                 continue
-            feasible = self.feasible_mask(prow)
             init_req = st.init_req[prow]
             # Reclaim requires the NEWLY reclaimed resources alone to
             # cover the task (reclaim.go:166-168: `resreq.less_equal(
             # reclaimed)`), so the prefilter is on evictable capacity
             # only — exhausted nodes drop out as their victims go.
+            # Checked before the predicate mask: as victims deplete this
+            # empties and skips the mask wholesale.
             ev = self._evictable_for(("rq", qname))
-            feasible = feasible & self._le_rows(init_req, ev)
+            feasible = self._le_rows(init_req, ev)
+            if feasible.any():
+                feasible = feasible & self.feasible_mask(prow)
             for n in np.flatnonzero(feasible & c.n_alive):
                 n = int(n)
                 cand = []
