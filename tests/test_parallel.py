@@ -68,3 +68,17 @@ def test_mesh_sizes():
         assert mesh.devices.size == n
         out = np.asarray(sharded_solve(mesh, args).assigned)
         assert (out >= 0).any()
+
+
+@needs_8
+def test_sharded_wave_solve_with_sparse_cnt0(monkeypatch):
+    """The on-device sparse cnt0 rebuild must respect the mesh caller's
+    replicated sharding (committed-device compatibility)."""
+    import volcano_tpu.ops.wave as wave
+    from volcano_tpu.parallel import make_mesh, sharded_solve_wave
+
+    monkeypatch.setattr(wave, "CNT0_SPARSE_MIN", 0)
+    args = _args()
+    mesh = make_mesh(8)
+    res = sharded_solve_wave(mesh, args)
+    assert (np.asarray(res.assigned) >= 0).any()

@@ -210,3 +210,51 @@ configurations:
     assert prio.enabled_job_order is False
     assert prio.enabled_task_order is True  # defaulted
     assert conf.configurations[0].arguments["overcommit-factor"] == "1.5"
+
+
+def test_fastpath_failure_fallback_guard(monkeypatch):
+    """Small clusters fall back to the object session when the fast path
+    fails; VOLCANO_TPU_FALLBACK=never (or a hyperscale mirror) re-raises
+    instead of stalling in an O(tasks x nodes) Python walk."""
+    import volcano_tpu.fastpath as fp
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    def boom(store, conf):
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(fp, "run_cycle_fast", boom)
+
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+    Scheduler(store).run_once()  # falls back, still binds
+    assert len(store.binder.binds) == 8
+
+    monkeypatch.setenv("VOLCANO_TPU_FALLBACK", "never")
+    store2 = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="device exploded"):
+        Scheduler(store2).run_once()
+
+
+def test_fastpath_failure_no_fallback_at_hyperscale(monkeypatch):
+    """auto mode refuses the object-session fallback when tasks x nodes
+    exceeds FALLBACK_MAX_WORK (the hours-long Python walk)."""
+    import volcano_tpu.fastpath as fp
+    from volcano_tpu.cache.mirror import StoreMirror
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    def boom(store, conf):
+        raise RuntimeError("device exploded")
+
+    monkeypatch.setattr(fp, "run_cycle_fast", boom)
+    monkeypatch.setattr(StoreMirror, "n_pods",
+                        property(lambda self: 500_000))
+    monkeypatch.setattr(StoreMirror, "n_nodes",
+                        property(lambda self: 50_000))
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="device exploded"):
+        Scheduler(store).run_once()

@@ -166,3 +166,50 @@ def test_wave_matches_sequential_on_heterogeneous_mix():
     wav = solve_wave(*args, wave=96)
     _check_invariants(args, wav)
     assert _placed(wav) == _placed(seq)
+
+
+def test_sparse_cnt0_path_matches_dense(monkeypatch):
+    """Forcing the sparse on-device cnt0 scatter (the hyperscale upload
+    avoidance) must produce the same schedule as the dense upload,
+    including resident counts and task-axis padding truncation."""
+    import volcano_tpu.ops.wave as wave
+    from volcano_tpu.api import Node, Pod, PodGroup, GROUP_NAME_ANNOTATION
+    from volcano_tpu.api.spec import AffinityTerm
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.synth import solve_args_from_store
+
+    def build():
+        store = ClusterStore()
+        for z in range(2):
+            for i in range(3):
+                store.add_node(Node(
+                    name=f"z{z}-n{i}",
+                    allocatable={"cpu": "8", "memory": "16Gi", "pods": 32},
+                    labels={"zone": f"z{z}"},
+                ))
+        # Resident pod matching the term -> nonzero cnt0 entry.
+        store.add_pod_group(PodGroup(name="res", min_member=1))
+        res = Pod(name="res-0", labels={"app": "db"},
+                  containers=[{"cpu": "1", "memory": "1Gi"}],
+                  annotations={GROUP_NAME_ANNOTATION: "res"},
+                  node_name="z1-n0", phase="Running")
+        store.add_pod(res)
+        term = AffinityTerm(match_labels={"app": "db"},
+                            topology_key="zone")
+        store.add_pod_group(PodGroup(name="g", min_member=3))
+        for k in range(3):
+            store.add_pod(Pod(
+                name=f"g-{k}", labels={"app": "db"},
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+                annotations={GROUP_NAME_ANNOTATION: "g"},
+                affinity=[term],
+            ))
+        return store
+
+    args, _ = solve_args_from_store(build())
+    dense = np.asarray(wave.solve_wave(*args).assigned)
+    monkeypatch.setattr(wave, "CNT0_SPARSE_MIN", 0)
+    args2, _ = solve_args_from_store(build())
+    sparse = np.asarray(wave.solve_wave(*args2).assigned)
+    assert np.array_equal(dense, sparse)
+    assert (sparse >= 0).sum() == 3

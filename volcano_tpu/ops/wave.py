@@ -85,6 +85,9 @@ from .resreq import less_equal
 from .scoring import ScoreWeights, node_score
 
 DEFAULT_WAVE = 1024
+# cnt0 tables above this element count ship as sparse entries and are
+# scattered on device (tests lower it to force the sparse path).
+CNT0_SPARSE_MIN = 4_000_000
 TOPK = 256  # diversification breadth: k-th contender takes its k-th best node
 SUBROUNDS = 16  # in-attempt re-walk rounds for conflict losers
 
@@ -957,6 +960,11 @@ def _solve_wave(
     )
 
 
+@partial(jax.jit, static_argnames=("e", "d"))
+def _scatter_cnt0(rows, cols, vals, e, d):
+    return jnp.zeros((e, d), jnp.int32).at[rows, cols].add(vals)
+
+
 def _np(a):
     # ascontiguousarray: no-op for the usual numpy inputs; jax arrays
     # fetched from a sharded placement can materialize non-contiguous,
@@ -1113,7 +1121,8 @@ def _pad_profiles_rows(profiles: SolveProfiles) -> SolveProfiles:
 
 
 def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
-                  pid: np.ndarray, wave_prof: np.ndarray, n_waves: int):
+                  pid: np.ndarray, wave_prof: np.ndarray, n_waves: int,
+                  skip_cnt0: bool = False):
     """Per-wave lists of the affinity terms the wave's profiles reference.
 
     Every [*, E] tensor in the kernel is gathered down to the wave's term
@@ -1142,13 +1151,19 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
         t_matches=zc(profiles.t_matches),
         t_soft=zc(profiles.t_soft),
     )
-    aff = aff._replace(
-        term_key=np.concatenate([_np(aff.term_key), np.zeros(1, np.int32)]),
-        cnt0=np.concatenate(
+    repl = {
+        "term_key": np.concatenate(
+            [_np(aff.term_key), np.zeros(1, np.int32)]
+        ),
+    }
+    if not skip_cnt0:
+        # skip_cnt0: the caller rebuilds cnt0 on device with the dummy
+        # row included — skip the dense [Ep, D] host copy here.
+        repl["cnt0"] = np.concatenate(
             [_np(aff.cnt0),
              np.zeros((1, _np(aff.cnt0).shape[1]), _np(aff.cnt0).dtype)]
-        ),
-    )
+        )
+    aff = aff._replace(**repl)
     wp = _np(wave_prof)
     U = iom.shape[0]
     term_lists = []
@@ -1289,21 +1304,55 @@ def solve_wave(
         profiles, pid = _profile_tasks(tasks, aff)
     profiles = _pad_profiles_rows(profiles)
     wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
+    cnt0_in = aff.cnt0
+    cnt0_host = _np(cnt0_in)
+    cnt0_sparse = cnt0_host.size > CNT0_SPARSE_MIN
+    if cnt0_sparse:
+        # One scan serves both the feature bit and the sparse extraction
+        # (cnt0 is the largest host array on this path).
+        rows_nz, cols_nz = np.nonzero(cnt0_host)
+        cnt0_any = bool(len(rows_nz))
+    else:
+        cnt0_any = bool(cnt0_host.any())
     features = (
         bool(_np(profiles.ports).any()),
         bool(
             _np(profiles.t_req_aff).any()
             or _np(profiles.t_req_anti).any()
             or _np(profiles.t_soft).any()
-            or _np(aff.cnt0).any()
+            or cnt0_any
         ),
         bool(_np(nodes.taint_bits).any()),
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),
     )
     profiles, aff, wave_terms, ew = _term_windows(
-        profiles, aff, pid, wave_prof, n_waves
+        profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse
     )
+    if cnt0_sparse:
+        # Hyperscale [Ep, D] count tables reach hundreds of MB; ship the
+        # sparse resident entries (typically none on a fresh cycle) and
+        # scatter them on device — into the dummy-row-extended shape —
+        # instead of uploading (and host-copying) the dense zeros.
+        vals_nz = cnt0_host[rows_nz, cols_nz].astype(np.int32)
+        k = bucket_pow2(len(rows_nz), floor=16)
+        cpad = k - len(rows_nz)
+        if cpad:
+            # Padded entries add 0 to cell (0, 0): a no-op.
+            rows_nz = np.concatenate([rows_nz, np.zeros(cpad, np.int64)])
+            cols_nz = np.concatenate([cols_nz, np.zeros(cpad, np.int64)])
+            vals_nz = np.concatenate([vals_nz, np.zeros(cpad, np.int32)])
+        cnt0_dev = _scatter_cnt0(
+            rows_nz.astype(np.int32), cols_nz.astype(np.int32), vals_nz,
+            cnt0_host.shape[0] + 1, cnt0_host.shape[1],
+        )
+        in_sharding = getattr(cnt0_in, "sharding", None)
+        if in_sharding is not None and not isinstance(cnt0_in, np.ndarray):
+            # Mesh callers pass cnt0 replicated over their devices; the
+            # rebuilt table must match, or the jit below sees committed
+            # arrays on incompatible device sets.
+            cnt0_dev = jax.device_put(cnt0_dev, in_sharding)
+        aff = aff._replace(cnt0=cnt0_dev)
     # Exact f32 matmuls are load-bearing: the one-hot matmuls carry node
     # indices, resource sums, and 0/1 predicate counts that are compared
     # with == / <=; the TPU default (bf16 MXU passes) rounds node ids above

@@ -160,6 +160,16 @@ class Scheduler:
                     if run_cycle_fast(self.store, conf):
                         return
                 except Exception:
+                    if not self._fallback_sensible():
+                        # At hyperscale the object session takes hours
+                        # per cycle; silently "falling back" would stall
+                        # scheduling while masking the device failure.
+                        log.exception(
+                            "Fast path failed and the cluster is too "
+                            "large for the object-session fallback "
+                            "(override with VOLCANO_TPU_FALLBACK=always)"
+                        )
+                        raise
                     log.exception(
                         "Fast path failed; falling back to object session"
                     )
@@ -180,6 +190,22 @@ class Scheduler:
         import os
 
         return os.environ.get("VOLCANO_TPU_FASTPATH", "1") != "0"
+
+    # Above this tasks x nodes product the object-session fallback is
+    # slower than retrying the fast path next period (the object walk is
+    # O(tasks x nodes) Python).
+    FALLBACK_MAX_WORK = 50_000_000
+
+    def _fallback_sensible(self) -> bool:
+        import os
+
+        mode = os.environ.get("VOLCANO_TPU_FALLBACK", "auto")
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        m = self.store.mirror
+        return (m.n_pods * max(m.n_nodes, 1)) <= self.FALLBACK_MAX_WORK
 
     # ----------------------------------------------------------------- loop
 
