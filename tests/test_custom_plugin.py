@@ -1,0 +1,146 @@
+"""Custom (out-of-tree) plugin through the public registry: the
+RegisterPluginBuilder extension point (framework/plugins.go analog).
+
+A configuration naming a non-built-in plugin is ineligible for the fast
+path, so the cycle runs on the object-session path with the custom
+callbacks dispatched through the tiered session machinery.
+"""
+
+import numpy as np
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.framework import register_plugin_builder
+from volcano_tpu.scheduler import Scheduler
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: pinned-nodes
+- plugins:
+  - name: binpack
+"""
+
+
+class PinnedNodesPlugin:
+    """Only nodes whose name appears in the plugin argument pass the
+    predicate — a minimal custom policy."""
+
+    def __init__(self, arguments):
+        allow = ""
+        for arg in arguments or []:
+            if str(arg).startswith("--allow="):
+                allow = str(arg).split("=", 1)[1]
+        self.allowed = set(a for a in allow.split(",") if a)
+        self.opened = False
+
+    @property
+    def name(self):
+        return "pinned-nodes"
+
+    def on_session_open(self, ssn):
+        self.opened = True
+
+        def predicate(task, node):
+            if node.name not in self.allowed:
+                raise RuntimeError(f"node {node.name} not pinned")
+
+        ssn.add_predicate_fn(self.name, predicate)
+
+    def on_session_close(self, ssn):
+        pass
+
+
+def test_custom_plugin_via_registry():
+    instances = []
+
+    def builder(arguments):
+        p = PinnedNodesPlugin(["--allow=n1"])
+        instances.append(p)
+        return p
+
+    register_plugin_builder("pinned-nodes", builder)
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "8", "memory": "16Gi"}))
+    store.add_pod_group(PodGroup(name="g", min_member=2))
+    for k in range(2):
+        store.add_pod(Pod(name=f"p-{k}",
+                          containers=[{"cpu": "1", "memory": "1Gi"}],
+                          annotations={GROUP_NAME_ANNOTATION: "g"}))
+    Scheduler(store, conf_str=CONF).run_once()
+    assert instances and instances[0].opened
+    assert len(store.binder.binds) == 2
+    assert set(store.binder.binds.values()) == {"n1"}, (
+        f"custom predicate ignored: {store.binder.binds}"
+    )
+
+
+class DeviceMaskPlugin:
+    """TPU-native custom plugin: contributes a [P, N] mask factory
+    (ssn.add_device_mask_fn) instead of a per-pair host callback."""
+
+    def __init__(self, allowed):
+        self.allowed = allowed
+
+    @property
+    def name(self):
+        return "device-mask"
+
+    def on_session_open(self, ssn):
+        def mask(cluster, pending, node_names):
+            m = np.zeros((len(pending), len(node_names)), bool)
+            for j, nm in enumerate(node_names):
+                if nm in self.allowed:
+                    m[:, j] = True
+            return m
+
+        ssn.add_device_mask_fn(self.name, mask)
+
+    def on_session_close(self, ssn):
+        pass
+
+
+CONF_MASK = CONF.replace("pinned-nodes", "device-mask")
+
+
+def test_device_mask_fn_via_registry():
+    register_plugin_builder("device-mask",
+                            lambda args: DeviceMaskPlugin({"n2"}))
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "8", "memory": "16Gi"}))
+    store.add_pod_group(PodGroup(name="g", min_member=2))
+    for k in range(2):
+        store.add_pod(Pod(name=f"p-{k}",
+                          containers=[{"cpu": "1", "memory": "1Gi"}],
+                          annotations={GROUP_NAME_ANNOTATION: "g"}))
+    Scheduler(store, conf_str=CONF_MASK).run_once()
+    assert len(store.binder.binds) == 2
+    assert set(store.binder.binds.values()) == {"n2"}
+
+
+def test_custom_plugin_with_sequential_solver():
+    register_plugin_builder("pinned-nodes",
+                            lambda args: PinnedNodesPlugin(["--allow=n1"]))
+    conf = CONF + """configurations:
+- name: allocate
+  arguments:
+    solver: seq
+"""
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "8", "memory": "16Gi"}))
+    store.add_pod_group(PodGroup(name="g", min_member=2))
+    for k in range(2):
+        store.add_pod(Pod(name=f"p-{k}",
+                          containers=[{"cpu": "1", "memory": "1Gi"}],
+                          annotations={GROUP_NAME_ANNOTATION: "g"}))
+    Scheduler(store, conf_str=conf).run_once()
+    assert len(store.binder.binds) == 2
+    assert set(store.binder.binds.values()) == {"n1"}

@@ -249,10 +249,10 @@ def test_fastpath_failure_no_fallback_at_hyperscale(monkeypatch):
         raise RuntimeError("device exploded")
 
     monkeypatch.setattr(fp, "run_cycle_fast", boom)
-    monkeypatch.setattr(StoreMirror, "n_pods",
-                        property(lambda self: 500_000))
+    # 8 real pending tasks x a faked 10M-node cluster exceeds the
+    # pending x nodes work bound.
     monkeypatch.setattr(StoreMirror, "n_nodes",
-                        property(lambda self: 50_000))
+                        property(lambda self: 10_000_000))
     store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
     import pytest
 

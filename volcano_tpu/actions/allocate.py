@@ -25,7 +25,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..api import FitErrors, JobInfo, PodGroupPhase, Resource, TaskInfo, TaskStatus
+from ..api import (FitError, FitErrors, JobInfo, PodGroupPhase,
+                   Resource, TaskInfo, TaskStatus)
 from ..arrays import ResourceSlots, encode_affinity, encode_cluster
 from ..framework.arguments import get_action_args
 from ..metrics import metrics
@@ -197,11 +198,22 @@ class AllocateAction:
             s_nodes, s_tasks, s_jobs, s_queues = solve_inputs(
                 arrays, deserved, q_alloc0
             )
+            extra_ok = self._custom_mask(ssn, cluster, pending, maps)
+            if extra_ok is not None:
+                # Align to the encoder's padded task/node axes (padded
+                # tasks are inert; padded nodes are not-ready): all-ones.
+                pp = arrays.tasks.req.shape[0]
+                nn = arrays.nodes.idle.shape[0]
+                full = np.ones((pp, nn), bool)
+                full[:extra_ok.shape[0], :extra_ok.shape[1]] = extra_ok
+                extra_ok = full
+
             t0 = time.perf_counter()
             solve_fn = solve_wave if solver == "wave" else solve
             result = solve_fn(
                 s_nodes, s_tasks, s_jobs, s_queues,
                 weights, arrays.eps, arrays.scalar_slot, aff,
+                extra_ok=extra_ok,
             )
             assigned = np.asarray(result.assigned)
             pipelined = np.asarray(result.pipelined)
@@ -226,6 +238,61 @@ class AllocateAction:
             retry_discards = bool(never_ready.any()) and made_progress
             if not made_progress:
                 return
+
+    # Built-in predicate plugins whose checks are already encoded as
+    # device masks; anything else registering a predicate is an
+    # out-of-tree plugin evaluated host-side into the extra mask.
+    BUILTIN_PREDICATE_PLUGINS = frozenset({"predicates"})
+
+    def _custom_mask(self, ssn, cluster, pending, maps):
+        """[P, N] verdicts from custom-plugin predicate callbacks and
+        device-mask factories (ssn.add_predicate_fn from out-of-tree
+        plugins + ssn.add_device_mask_fn).  None when only built-ins are
+        registered — the overwhelmingly common case, which costs nothing.
+        The host-predicate sweep is O(P x N) Python, the price the
+        reference pays for EVERY predicate (scheduler_helper.go:65)."""
+        custom = [
+            (opt.name, ssn.predicate_fns[opt.name])
+            for _, opt in ssn._tier_plugins("enabled_predicate")
+            if opt.name in ssn.predicate_fns
+            and opt.name not in self.BUILTIN_PREDICATE_PLUGINS
+        ]
+        mask_fns = [
+            (nm, fn) for nm, fn in ssn.device_mask_fns.items()
+            if nm not in self.BUILTIN_PREDICATE_PLUGINS
+        ]
+        if not custom and not mask_fns:
+            return None
+        n_nodes = len(maps.node_names)
+        extra = np.ones((len(pending), n_nodes), bool)
+        node_infos = [cluster.nodes[nm] for nm in maps.node_names]
+        for _name, fn in custom:
+            unexpected_logged = False
+            for i, task in enumerate(pending):
+                row = extra[i]
+                for j, node in enumerate(node_infos):
+                    if not row[j]:
+                        continue
+                    try:
+                        fn(task, node)
+                    except FitError:
+                        row[j] = False
+                    except Exception as err:
+                        # A buggy plugin (wrong signature, attribute
+                        # errors) would otherwise silently veto every
+                        # node; surface the first instance.
+                        if not unexpected_logged:
+                            unexpected_logged = True
+                            log.warning(
+                                "custom predicate plugin %s raised %r "
+                                "(treated as infeasible)", _name, err,
+                            )
+                        row[j] = False
+        for _name, fn in mask_fns:
+            contributed = fn(cluster, pending, maps.node_names)
+            if contributed is not None:
+                extra &= np.asarray(contributed, bool)
+        return extra
 
     # --------------------------------------------------------------- replay
 

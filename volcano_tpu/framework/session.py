@@ -160,7 +160,11 @@ class Session:
         self.score_weight_fns[name] = fn
 
     def add_device_mask_fn(self, name, fn):
-        """Contribute an extra [P,N] predicate mask factory (TPU-native)."""
+        """Contribute an extra [P, N] predicate mask factory (TPU-native
+        custom-plugin extension; cheaper than per-(task, node) host
+        callbacks).  Contract: ``fn(cluster, pending_tasks, node_names)
+        -> [len(pending), len(node_names)] bool or None``; the allocate
+        action ANDs the result into the solver's feasibility."""
         self.device_mask_fns[name] = fn
 
     # ------------------------------------------------------ tier iteration

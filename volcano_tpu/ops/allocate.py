@@ -201,6 +201,7 @@ def solve(
     eps,  # [R]
     scalar_slot,  # [R]
     aff: AffinityArgs,  # inter-pod affinity/spread count block
+    extra_ok=None,  # optional [P, N] bool: custom-plugin predicate verdicts
 ) -> AllocResult:
     P, _ = tasks.req.shape
     J = jobs.min_available.shape[0]
@@ -344,6 +345,10 @@ def solve(
         anti_ok = jnp.all(~req_n[None, :] | (cval == 0), axis=-1)
 
         feasible = ok & fit_future & pods_ok & ports_ok & aff_ok & anti_ok
+        if extra_ok is not None:
+            # Custom-plugin predicate verdicts (session add_predicate_fn /
+            # add_device_mask_fn contributions from out-of-tree plugins).
+            feasible &= extra_ok[tt]
         any_feasible = jnp.any(feasible)
 
         score = node_score(tasks.req[tt], nodes.allocatable, idle, weights)

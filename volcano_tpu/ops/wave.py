@@ -162,6 +162,7 @@ def _solve_wave(
     scalar_slot,
     aff: AffinityArgs,
     prof: SolveProfiles,
+    extra_prof: jnp.ndarray,  # [U, N] bool custom verdicts ([1,1] if unused)
     pid: jnp.ndarray,  # [P] int32 global profile id per task
     wave_prof: jnp.ndarray,  # [NW, U_MAX] int32 profile ids present per wave
     pid_local: jnp.ndarray,  # [P] int32 index into the wave's profile list
@@ -169,13 +170,14 @@ def _solve_wave(
     wave: int,
     n_waves: int,
     ew: int,
-    features: tuple = (True, True, True, True, True),
+    features: tuple = (True, True, True, True, True, False),
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
     # anywhere, no affinity terms, no taints, no releasing capacity =>
     # no pipelining, no finite queue deserved => no overuse gating).
-    has_ports, has_aff, has_taints, has_future, has_overuse = features
+    (has_ports, has_aff, has_taints, has_future, has_overuse,
+     has_extra) = features
 
     P, R = tasks.req.shape
     N = nodes.idle.shape[0]
@@ -306,6 +308,10 @@ def _solve_wave(
         p_ok = node_ready[None, :] & _subset_mm(
             _unpack_bits(prof.sel_bits[pids]), label_missing_f
         )
+        if has_extra:
+            # Custom-plugin verdicts, per profile (tasks sharing a
+            # profile share a mask row by construction).
+            p_ok &= extra_prof[pids]
         aff_bits_p = _unpack_bits(prof.aff_bits[pids])  # [UM, A, B]
         term_ok = _subset_mm(
             aff_bits_p.reshape(UM * A, -1), label_missing_f
@@ -975,7 +981,7 @@ def _np(a):
 _HASH_SEED = np.random.RandomState(0x5EED)
 
 
-def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs):
+def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs, extra_ok=None):
     """Group tasks into distinct profiles (host, numpy).
 
     Returns (profiles, pid[P]) where profiles hold one row per distinct
@@ -1003,6 +1009,10 @@ def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs):
         _np(aff.t_matches).reshape(P, -1).view(np.uint8).reshape(P, -1),
         _np(aff.t_soft).reshape(P, -1).view(np.uint8).reshape(P, -1),
     ]
+    if extra_ok is not None:
+        # Custom per-task node masks split profiles: tasks of one profile
+        # must share a mask row (the kernel applies it per profile).
+        cols.append(np.packbits(_np(extra_ok), axis=1))
     raw = np.concatenate(cols, axis=1)  # [P, C] uint8
     # Three independent linear hashes with small coefficients: every dot
     # product stays below 2^33, so the float64 BLAS matmul is exact and two
@@ -1052,7 +1062,8 @@ def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs):
         t_matches=_np(aff.t_matches)[u],
         t_soft=_np(aff.t_soft)[u],
     )
-    return profiles, pid
+    extra_prof = _np(extra_ok)[u] if extra_ok is not None else None
+    return profiles, pid, extra_prof
 
 
 def _renumber_pid(pid: np.ndarray):
@@ -1260,6 +1271,7 @@ def solve_wave(
     wave: int = DEFAULT_WAVE,
     pid=None,
     profiles: SolveProfiles = None,
+    extra_ok=None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -1271,14 +1283,29 @@ def solve_wave(
     ``profiles`` also given (rows aligned to the pid numbering, which must
     be by first occurrence), nothing per-task is recomputed here and
     ``aff``'s task-level fields may be dummies.
+
+    ``extra_ok`` (optional [P, N] bool) carries custom-plugin predicate
+    verdicts (session add_predicate_fn / add_device_mask_fn); it folds
+    into the profile grouping so tasks sharing a profile share a mask
+    row, and is only supported when profiles are computed in-call
+    (custom plugins make a configuration fast-path-ineligible).
     """
     P = int(_np(tasks.req).shape[0])
+    if extra_ok is not None and (pid is not None or profiles is not None):
+        raise ValueError(
+            "extra_ok requires in-call profile computation"
+        )
     wave = int(min(wave, max(1, P)))
     pad = (-P) % wave
     if pad:
         tasks = _pad_tasks(tasks, pad)
         if profiles is None:
             aff = _pad_aff(aff, pad)
+        if extra_ok is not None:
+            extra_ok = np.concatenate([
+                _np(extra_ok),
+                np.ones((pad, _np(extra_ok).shape[1]), bool),
+            ])
     n_waves = (P + pad) // wave
     if profiles is not None and pid is not None:
         pid = np.asarray(pid, np.int64)
@@ -1301,8 +1328,17 @@ def solve_wave(
             pid = np.concatenate([pid, np.full(pad, fresh, np.int64)])
         profiles, pid = _profiles_from_pid(tasks, aff, pid)
     else:
-        profiles, pid = _profile_tasks(tasks, aff)
+        profiles, pid, extra_prof = _profile_tasks(tasks, aff, extra_ok)
+    u_before = int(_np(profiles.req).shape[0])
     profiles = _pad_profiles_rows(profiles)
+    if extra_ok is not None:
+        u_pad = int(_np(profiles.req).shape[0]) - u_before
+        if u_pad:
+            extra_prof = np.concatenate([
+                extra_prof, np.ones((u_pad, extra_prof.shape[1]), bool),
+            ])
+    else:
+        extra_prof = np.ones((1, 1), bool)
     wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
     cnt0_in = aff.cnt0
     cnt0_host = _np(cnt0_in)
@@ -1325,6 +1361,7 @@ def solve_wave(
         bool(_np(nodes.taint_bits).any()),
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),
+        extra_ok is not None,
     )
     profiles, aff, wave_terms, ew = _term_windows(
         profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse
@@ -1361,7 +1398,7 @@ def solve_wave(
     with jax.default_matmul_precision("float32"):
         res = _solve_wave(
             nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
-            profiles, pid, wave_prof, pid_local, wave_terms,
+            profiles, extra_prof, pid, wave_prof, pid_local, wave_terms,
             wave=wave, n_waves=n_waves, ew=ew, features=features,
         )
     if pad:

@@ -159,8 +159,9 @@ class PredicatesPlugin:
 
         # Device contribution: the allocate action builds the [P,N] static
         # mask (ops.predicates.static_predicate_mask) when this plugin is
-        # enabled; pod-(anti)affinity terms get host-evaluated columns.
-        ssn.add_device_mask_fn(self.name, lambda arrays, maps: None)
+        # enabled — encoded directly from the snapshot arrays, so no
+        # device-mask factory is registered here (that registry carries
+        # OUT-OF-TREE mask contributions, session.add_device_mask_fn).
 
     def on_session_close(self, ssn) -> None:
         pass

@@ -199,13 +199,23 @@ class Scheduler:
     def _fallback_sensible(self) -> bool:
         import os
 
+        import numpy as np
+
+        from .api import TaskStatus
+
         mode = os.environ.get("VOLCANO_TPU_FALLBACK", "auto")
         if mode == "always":
             return True
         if mode == "never":
             return False
         m = self.store.mirror
-        return (m.n_pods * max(m.n_nodes, 1)) <= self.FALLBACK_MAX_WORK
+        # The object walk is O(pending tasks x nodes): a mostly-scheduled
+        # large cluster with a handful of pending pods falls back fine.
+        pending = int(np.count_nonzero(
+            (m.p_status[:m.n_pods] == int(TaskStatus.Pending))
+            & m.p_alive[:m.n_pods]
+        ))
+        return (pending * max(m.n_nodes, 1)) <= self.FALLBACK_MAX_WORK
 
     # ----------------------------------------------------------------- loop
 
