@@ -144,3 +144,44 @@ def test_custom_plugin_with_sequential_solver():
     Scheduler(store, conf_str=conf).run_once()
     assert len(store.binder.binds) == 2
     assert set(store.binder.binds.values()) == {"n1"}
+
+
+class SteerScorePlugin:
+    """Custom scorer: strongly prefers one node via add_node_order_fn."""
+
+    def __init__(self, target, weight=1000.0):
+        self.target = target
+        self.weight = weight
+
+    @property
+    def name(self):
+        return "steer-score"
+
+    def on_session_open(self, ssn):
+        def score(task, node):
+            return self.weight if node.name == self.target else 0.0
+
+        ssn.add_node_order_fn(self.name, score)
+
+    def on_session_close(self, ssn):
+        pass
+
+
+def test_custom_node_order_fn_steers_placement():
+    register_plugin_builder("steer-score",
+                            lambda args: SteerScorePlugin("n2"))
+    conf = CONF.replace("pinned-nodes", "steer-score")
+    store = ClusterStore()
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "8", "memory": "16Gi"}))
+    store.add_pod_group(PodGroup(name="g", min_member=2))
+    for k in range(2):
+        store.add_pod(Pod(name=f"p-{k}",
+                          containers=[{"cpu": "1", "memory": "1Gi"}],
+                          annotations={GROUP_NAME_ANNOTATION: "g"}))
+    Scheduler(store, conf_str=conf).run_once()
+    assert len(store.binder.binds) == 2
+    assert set(store.binder.binds.values()) == {"n2"}, (
+        f"custom scorer ignored: {store.binder.binds}"
+    )

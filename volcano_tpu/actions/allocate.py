@@ -198,22 +198,28 @@ class AllocateAction:
             s_nodes, s_tasks, s_jobs, s_queues = solve_inputs(
                 arrays, deserved, q_alloc0
             )
+            pp = arrays.tasks.req.shape[0]
+            nn = arrays.nodes.idle.shape[0]
             extra_ok = self._custom_mask(ssn, cluster, pending, maps)
             if extra_ok is not None:
                 # Align to the encoder's padded task/node axes (padded
                 # tasks are inert; padded nodes are not-ready): all-ones.
-                pp = arrays.tasks.req.shape[0]
-                nn = arrays.nodes.idle.shape[0]
                 full = np.ones((pp, nn), bool)
                 full[:extra_ok.shape[0], :extra_ok.shape[1]] = extra_ok
                 extra_ok = full
+            extra_score = self._custom_score(ssn, cluster, pending, maps)
+            if extra_score is not None:
+                fulls = np.zeros((pp, nn), np.float32)
+                fulls[:extra_score.shape[0], :extra_score.shape[1]] = \
+                    extra_score
+                extra_score = fulls
 
             t0 = time.perf_counter()
             solve_fn = solve_wave if solver == "wave" else solve
             result = solve_fn(
                 s_nodes, s_tasks, s_jobs, s_queues,
                 weights, arrays.eps, arrays.scalar_slot, aff,
-                extra_ok=extra_ok,
+                extra_ok=extra_ok, extra_score=extra_score,
             )
             assigned = np.asarray(result.assigned)
             pipelined = np.asarray(result.pipelined)
@@ -293,6 +299,64 @@ class AllocateAction:
             if contributed is not None:
                 extra &= np.asarray(contributed, bool)
         return extra
+
+    # Built-in scorers already encoded as device score weights.
+    BUILTIN_SCORE_PLUGINS = frozenset({"binpack", "nodeorder"})
+
+    def _custom_score(self, ssn, cluster, pending, maps):
+        """[P, N] additive scores from custom-plugin node-order callbacks
+        (ssn.add_node_order_fn / add_batch_node_order_fn from out-of-tree
+        plugins).  None when only built-ins are registered."""
+        custom_map = [
+            (opt.name, ssn.node_order_fns[opt.name])
+            for _, opt in ssn._tier_plugins("enabled_node_order")
+            if opt.name in ssn.node_order_fns
+            and opt.name not in self.BUILTIN_SCORE_PLUGINS
+        ]
+        custom_batch = [
+            (opt.name, ssn.batch_node_order_fns[opt.name])
+            for _, opt in ssn._tier_plugins("enabled_node_order")
+            if opt.name in ssn.batch_node_order_fns
+            and opt.name not in self.BUILTIN_SCORE_PLUGINS
+        ]
+        if not custom_map and not custom_batch:
+            return None
+        n_nodes = len(maps.node_names)
+        extra = np.zeros((len(pending), n_nodes), np.float32)
+        node_infos = [cluster.nodes[nm] for nm in maps.node_names]
+        col = {nm: j for j, nm in enumerate(maps.node_names)}
+        for _name, fn in custom_map:
+            logged = False
+            for i, task in enumerate(pending):
+                for j, node in enumerate(node_infos):
+                    try:
+                        extra[i, j] += float(fn(task, node))
+                    except Exception as err:
+                        if not logged:
+                            logged = True
+                            log.warning(
+                                "custom node-order plugin %s raised %r",
+                                _name, err,
+                            )
+        for _name, fn in custom_batch:
+            logged = False
+            for i, task in enumerate(pending):
+                try:
+                    for nm, sc in (fn(task, node_infos) or {}).items():
+                        j = col.get(nm)
+                        if j is not None:
+                            extra[i, j] += float(sc)
+                except Exception as err:
+                    if not logged:
+                        logged = True
+                        log.warning(
+                            "custom batch node-order plugin %s raised %r",
+                            _name, err,
+                        )
+        # Defend the solver against buggy plugins: NaN poisons argmax
+        # ordering and magnitudes near the infeasibility sentinel
+        # (-3e38) break the progress guarantee.
+        return np.clip(np.nan_to_num(extra, nan=0.0), -1e18, 1e18)
 
     # --------------------------------------------------------------- replay
 

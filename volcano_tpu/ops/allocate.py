@@ -202,6 +202,7 @@ def solve(
     scalar_slot,  # [R]
     aff: AffinityArgs,  # inter-pod affinity/spread count block
     extra_ok=None,  # optional [P, N] bool: custom-plugin predicate verdicts
+    extra_score=None,  # optional [P, N] f32: custom-plugin node scores
 ) -> AllocResult:
     P, _ = tasks.req.shape
     J = jobs.min_available.shape[0]
@@ -352,6 +353,8 @@ def solve(
         any_feasible = jnp.any(feasible)
 
         score = node_score(tasks.req[tt], nodes.allocatable, idle, weights)
+        if extra_score is not None:
+            score = score + extra_score[tt]
         # Preferred node affinity (CalculateNodeAffinityPriority): term
         # scores are pre-normalized to *10 at encode; the weight knob is
         # applied here so config controls it.

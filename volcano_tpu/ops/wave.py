@@ -163,6 +163,7 @@ def _solve_wave(
     aff: AffinityArgs,
     prof: SolveProfiles,
     extra_prof: jnp.ndarray,  # [U, N] bool custom verdicts ([1,1] if unused)
+    score_prof: jnp.ndarray,  # [U, N] f32 custom scores ([1,1] if unused)
     pid: jnp.ndarray,  # [P] int32 global profile id per task
     wave_prof: jnp.ndarray,  # [NW, U_MAX] int32 profile ids present per wave
     pid_local: jnp.ndarray,  # [P] int32 index into the wave's profile list
@@ -170,14 +171,14 @@ def _solve_wave(
     wave: int,
     n_waves: int,
     ew: int,
-    features: tuple = (True, True, True, True, True, False),
+    features: tuple = (True, True, True, True, True, False, False),
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
     # anywhere, no affinity terms, no taints, no releasing capacity =>
     # no pipelining, no finite queue deserved => no overuse gating).
     (has_ports, has_aff, has_taints, has_future, has_overuse,
-     has_extra) = features
+     has_extra, has_extra_score) = features
 
     P, R = tasks.req.shape
     N = nodes.idle.shape[0]
@@ -337,6 +338,10 @@ def _solve_wave(
         p_static_score = weights.node_affinity_weight * jnp.sum(
             pref_match * prof.pref_w[pids][:, :, None], axis=1
         )  # [UM, N]
+        if has_extra_score:
+            # Attempt-invariant: hoisted out of the attempt loop (XLA
+            # does not hoist out of while_loops).
+            p_static_score = p_static_score + score_prof[pids]
 
         def live_parts(s: GState, cw_a, cw_p):
             """Per-attempt dynamic feasibility [UM, N] (+ cval for aff)."""
@@ -981,7 +986,8 @@ def _np(a):
 _HASH_SEED = np.random.RandomState(0x5EED)
 
 
-def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs, extra_ok=None):
+def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs, extra_ok=None,
+                   extra_score=None):
     """Group tasks into distinct profiles (host, numpy).
 
     Returns (profiles, pid[P]) where profiles hold one row per distinct
@@ -1013,6 +1019,11 @@ def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs, extra_ok=None):
         # Custom per-task node masks split profiles: tasks of one profile
         # must share a mask row (the kernel applies it per profile).
         cols.append(np.packbits(_np(extra_ok), axis=1))
+    if extra_score is not None:
+        cols.append(
+            _np(extra_score).astype(np.float32)
+            .reshape(P, -1).view(np.uint8).reshape(P, -1)
+        )
     raw = np.concatenate(cols, axis=1)  # [P, C] uint8
     # Three independent linear hashes with small coefficients: every dot
     # product stays below 2^33, so the float64 BLAS matmul is exact and two
@@ -1063,7 +1074,11 @@ def _profile_tasks(tasks: SolveTasks, aff: AffinityArgs, extra_ok=None):
         t_soft=_np(aff.t_soft)[u],
     )
     extra_prof = _np(extra_ok)[u] if extra_ok is not None else None
-    return profiles, pid, extra_prof
+    score_prof = (
+        _np(extra_score).astype(np.float32)[u]
+        if extra_score is not None else None
+    )
+    return profiles, pid, extra_prof, score_prof
 
 
 def _renumber_pid(pid: np.ndarray):
@@ -1272,6 +1287,7 @@ def solve_wave(
     pid=None,
     profiles: SolveProfiles = None,
     extra_ok=None,
+    extra_score=None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -1291,9 +1307,10 @@ def solve_wave(
     (custom plugins make a configuration fast-path-ineligible).
     """
     P = int(_np(tasks.req).shape[0])
-    if extra_ok is not None and (pid is not None or profiles is not None):
+    if (extra_ok is not None or extra_score is not None) and (
+            pid is not None or profiles is not None):
         raise ValueError(
-            "extra_ok requires in-call profile computation"
+            "extra_ok/extra_score require in-call profile computation"
         )
     wave = int(min(wave, max(1, P)))
     pad = (-P) % wave
@@ -1305,6 +1322,11 @@ def solve_wave(
             extra_ok = np.concatenate([
                 _np(extra_ok),
                 np.ones((pad, _np(extra_ok).shape[1]), bool),
+            ])
+        if extra_score is not None:
+            extra_score = np.concatenate([
+                _np(extra_score).astype(np.float32),
+                np.zeros((pad, _np(extra_score).shape[1]), np.float32),
             ])
     n_waves = (P + pad) // wave
     if profiles is not None and pid is not None:
@@ -1328,17 +1350,27 @@ def solve_wave(
             pid = np.concatenate([pid, np.full(pad, fresh, np.int64)])
         profiles, pid = _profiles_from_pid(tasks, aff, pid)
     else:
-        profiles, pid, extra_prof = _profile_tasks(tasks, aff, extra_ok)
+        profiles, pid, extra_prof, score_prof = _profile_tasks(
+            tasks, aff, extra_ok, extra_score
+        )
     u_before = int(_np(profiles.req).shape[0])
     profiles = _pad_profiles_rows(profiles)
+    u_pad = int(_np(profiles.req).shape[0]) - u_before
     if extra_ok is not None:
-        u_pad = int(_np(profiles.req).shape[0]) - u_before
         if u_pad:
             extra_prof = np.concatenate([
                 extra_prof, np.ones((u_pad, extra_prof.shape[1]), bool),
             ])
     else:
         extra_prof = np.ones((1, 1), bool)
+    if extra_score is not None:
+        if u_pad:
+            score_prof = np.concatenate([
+                score_prof,
+                np.zeros((u_pad, score_prof.shape[1]), np.float32),
+            ])
+    else:
+        score_prof = np.zeros((1, 1), np.float32)
     wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
     cnt0_in = aff.cnt0
     cnt0_host = _np(cnt0_in)
@@ -1362,6 +1394,7 @@ def solve_wave(
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),
         extra_ok is not None,
+        extra_score is not None,
     )
     profiles, aff, wave_terms, ew = _term_windows(
         profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse
@@ -1398,7 +1431,8 @@ def solve_wave(
     with jax.default_matmul_precision("float32"):
         res = _solve_wave(
             nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
-            profiles, extra_prof, pid, wave_prof, pid_local, wave_terms,
+            profiles, extra_prof, score_prof, pid, wave_prof, pid_local,
+            wave_terms,
             wave=wave, n_waves=n_waves, ew=ew, features=features,
         )
     if pad:
