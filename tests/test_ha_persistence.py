@@ -99,3 +99,40 @@ def test_leader_election_failover(tmp_path):
     assert "b-lead" in events
     b.stop()
     tb.join(timeout=2)
+
+
+def test_healthz_unhealthy_after_repeated_cycle_failures(monkeypatch):
+    """Repeated scheduling-cycle failures (a crashed device runtime is
+    unrecoverable in-process) flip /healthz to 503 so a supervisor or the
+    HA standby takes over (SURVEY.md 5.3)."""
+    import urllib.request
+
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.service import Service
+
+    svc = Service(simulate=True, schedule_period=0.02,
+                  controller_period=0.05)
+    monkeypatch.setattr(
+        Scheduler, "run_once",
+        lambda self: (_ for _ in ()).throw(RuntimeError("device gone")),
+    )
+    port = svc.start(http_port=0)
+    try:
+        import time
+
+        deadline = time.time() + 10
+        status = 200
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as resp:
+                    status = resp.status
+            except urllib.error.HTTPError as err:
+                status = err.code
+            if status == 503:
+                break
+            time.sleep(0.05)
+        assert status == 503
+    finally:
+        svc.stop()

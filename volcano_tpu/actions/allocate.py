@@ -300,24 +300,25 @@ class AllocateAction:
                 extra &= np.asarray(contributed, bool)
         return extra
 
-    # Built-in scorers already encoded as device score weights.
-    BUILTIN_SCORE_PLUGINS = frozenset({"binpack", "nodeorder"})
-
     def _custom_score(self, ssn, cluster, pending, maps):
         """[P, N] additive scores from custom-plugin node-order callbacks
         (ssn.add_node_order_fn / add_batch_node_order_fn from out-of-tree
-        plugins).  None when only built-ins are registered."""
+        plugins).  None when only built-ins are registered.  A plugin
+        that registered add_score_weight_fn already scores through the
+        device ScoreWeights — excluding on that signal (rather than a
+        hardcoded name list) avoids double-counting and covers custom
+        plugins that choose the weights route."""
         custom_map = [
             (opt.name, ssn.node_order_fns[opt.name])
             for _, opt in ssn._tier_plugins("enabled_node_order")
             if opt.name in ssn.node_order_fns
-            and opt.name not in self.BUILTIN_SCORE_PLUGINS
+            and opt.name not in ssn.score_weight_fns
         ]
         custom_batch = [
             (opt.name, ssn.batch_node_order_fns[opt.name])
             for _, opt in ssn._tier_plugins("enabled_node_order")
             if opt.name in ssn.batch_node_order_fns
-            and opt.name not in self.BUILTIN_SCORE_PLUGINS
+            and opt.name not in ssn.score_weight_fns
         ]
         if not custom_map and not custom_batch:
             return None

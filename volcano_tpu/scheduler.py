@@ -115,6 +115,7 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_conf = None
+        self._consecutive_failures = 0
 
     # --------------------------------------------------------------- config
 
@@ -224,14 +225,31 @@ class Scheduler:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    # Consecutive failed cycles before healthy() reports False (a crashed
+    # TPU runtime is unrecoverable in-process; the health signal lets a
+    # supervisor or the HA standby take over — SURVEY.md 5.3).
+    UNHEALTHY_AFTER = 3
+
+    def healthy(self) -> bool:
+        return self._consecutive_failures < self.UNHEALTHY_AFTER
+
     def _loop(self):
         while not self._stop.is_set():
             t0 = time.time()
             try:
                 if self.gate is None or self.gate():
                     self.run_once()
+                    self._consecutive_failures = 0
+                else:
+                    # A standby runs no cycles; stale leader-era failures
+                    # must not keep its health check red.
+                    self._consecutive_failures = 0
             except Exception:
-                log.exception("Scheduling cycle failed")
+                self._consecutive_failures += 1
+                log.exception(
+                    "Scheduling cycle failed (%d consecutive)",
+                    self._consecutive_failures,
+                )
             elapsed = time.time() - t0
             self._stop.wait(max(self.schedule_period - elapsed, 0.0))
 

@@ -241,7 +241,16 @@ class Service:
                 parts = [p for p in url.path.split("/") if p]
                 try:
                     if url.path == "/healthz":
-                        self._send(200, "ok", "text/plain")
+                        sched = getattr(service, "scheduler", None)
+                        if sched is not None and not sched.healthy():
+                            # Repeated cycle failures (e.g. a crashed TPU
+                            # runtime, unrecoverable in-process): report
+                            # unhealthy so the supervisor/HA standby
+                            # takes over.
+                            self._send(503, "unhealthy: scheduler cycles "
+                                       "failing", "text/plain")
+                        else:
+                            self._send(200, "ok", "text/plain")
                     elif url.path == "/metrics":
                         self._send(200, metrics.expose_text(), "text/plain")
                     elif parts[:2] == ["apis", "jobs"] and len(parts) == 2:
