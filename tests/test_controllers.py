@@ -433,3 +433,46 @@ def test_node_topology_folds_into_labels():
              topology={"volcano-tpu/slice": "s0", "zone": "explicit-wins"})
     assert n.labels["volcano-tpu/slice"] == "s0"
     assert n.labels["zone"] == "z1"  # explicit label wins collision
+
+
+def test_queue_close_open_lifecycle_via_commands():
+    """CloseQueue/OpenQueue commands drive the queue state machine
+    (queue_controller.go:268-330): a closed queue rejects new jobs at
+    admission while running jobs continue; reopening admits again."""
+    import pytest
+
+    from volcano_tpu.api import Queue
+    from volcano_tpu.controllers import Command
+    from volcano_tpu.webhooks.admission import AdmissionError
+
+    store, cm, sched, sim = make_env()
+    store.add_queue(Queue(name="batch", weight=2))
+    from volcano_tpu.webhooks.admission import AdmittedStore
+
+    admitted = AdmittedStore(store)
+    job1 = simple_job(name="j1", replicas=1, min_available=1)
+    job1.queue = "batch"
+    admitted.add_batch_job(job1)
+    converge(cm, sched, sim)
+    assert store.batch_jobs["default/j1"].status.state.phase == "Running"
+
+    store.add_command(Command(action="CloseQueue", target_kind="Queue",
+                              target_name="batch"))
+    cm.process()
+    # j1's PodGroup still exists, so the queue drains through Closing
+    # (queue_controller.go: Closed only when no PodGroups remain).
+    assert store.raw_queues["batch"].state == "Closing"
+    job2 = simple_job(name="j2", replicas=1, min_available=1)
+    job2.queue = "batch"
+    with pytest.raises(AdmissionError):
+        admitted.add_batch_job(job2)
+    # Running job unaffected.
+    assert store.batch_jobs["default/j1"].status.state.phase == "Running"
+
+    store.add_command(Command(action="OpenQueue", target_kind="Queue",
+                              target_name="batch"))
+    cm.process()
+    assert store.raw_queues["batch"].state == "Open"
+    admitted.add_batch_job(job2)
+    converge(cm, sched, sim)
+    assert store.batch_jobs["default/j2"].status.state.phase == "Running"

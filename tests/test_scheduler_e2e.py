@@ -258,3 +258,72 @@ def test_fastpath_failure_no_fallback_at_hyperscale(monkeypatch):
 
     with pytest.raises(RuntimeError, match="device exploded"):
         Scheduler(store).run_once()
+
+
+def test_conf_hot_reload_between_cycles(tmp_path):
+    """The YAML config is re-read every cycle (scheduler.go:77,89-106):
+    enabling the preempt action in the file takes effect on the next
+    run_once without restarting the scheduler."""
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import preempt_cluster
+
+    base = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text(base)
+    store = preempt_cluster(n_nodes=6, n_pending=12, seed=2)
+    sched = Scheduler(store, conf_path=str(conf))
+    sched.run_once()
+    assert len(store.evictor.evicts) == 0  # no preempt action yet
+    conf.write_text(base.replace(
+        '"enqueue, allocate, backfill"',
+        '"enqueue, allocate, preempt, reclaim, backfill"',
+    ))
+    sched.run_once()
+    assert len(store.evictor.evicts) > 0  # hot-reloaded action ran
+
+
+def test_conf_parse_failure_keeps_last_good(tmp_path):
+    """A broken config edit keeps the last GOOD config (scheduler.go
+    keeps scheduling on parse failure) — distinguishable from the
+    built-in default because the good config enables preempt, which the
+    default does not."""
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import preempt_cluster
+
+    conf = tmp_path / "scheduler.conf"
+    conf.write_text("""
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+""")
+    store = preempt_cluster(n_nodes=6, n_pending=12, seed=4)
+    sched = Scheduler(store, conf_path=str(conf))
+    sched.run_once()
+    evicted_first = len(store.evictor.evicts)
+    assert evicted_first > 0
+    conf.write_text("actions: [unclosed")
+    store2 = preempt_cluster(n_nodes=6, n_pending=12, seed=4)
+    sched.store = store2
+    sched.run_once()  # parse fails -> last good config (with preempt)
+    assert len(store2.evictor.evicts) == evicted_first
