@@ -146,3 +146,25 @@ def test_bind_failure_resyncs_tasks_to_pending():
     sched.run_once()
     assert len(store.binder.binds) == 24
     assert all(p.node_name for p in store.pods.values())
+
+
+def test_enqueue_phase_transition_persisted_despite_writeback_skip():
+    """The close write-back skips unchanged PodGroups, but enqueue's
+    in-place Pending -> Inqueue mutation must still persist + notify
+    (the status updater is the API-server boundary)."""
+    from volcano_tpu.api import Node, PodGroup
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    store.add_node(Node(name="n0", allocatable={"cpu": "4",
+                                                "memory": "8Gi"}))
+    store.add_pod_group(PodGroup(name="g", min_member=1,
+                                 min_resources={"cpu": "1"}))
+    phases = []
+    orig = store.status_updater.update_pod_group
+    store.status_updater.update_pod_group = (
+        lambda pg: (phases.append(pg.status.phase), orig(pg))[1]
+    )
+    Scheduler(store).run_once()
+    assert "Inqueue" in phases, f"Inqueue not persisted: {phases}"

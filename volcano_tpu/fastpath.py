@@ -522,6 +522,10 @@ class FastCycle:
     # ------------------------------------------------------------- actions
 
     def run(self) -> None:
+        # PodGroups whose phase was mutated in place mid-cycle (enqueue's
+        # Pending -> Inqueue gate): the close write-back must not skip
+        # them as "unchanged".
+        self._phase_dirty = set()
         self.derive()
         self._proportion()
         self.new_conditions: Dict[int, PodGroupCondition] = {}
@@ -664,6 +668,10 @@ class FastCycle:
                         inqueue = True
                 if inqueue:
                     pg.status.phase = PodGroupPhase.Inqueue.value
+                    # The close-phase skip-check compares against this
+                    # already-mutated object; record the transition so
+                    # the write-back still persists + notifies it.
+                    self._phase_dirty.add(pg.uid)
 
     def _job_enqueueable_vec(self, qname: str, pg, min_vec: np.ndarray,
                              q_cap_vec: Dict) -> bool:
@@ -1805,6 +1813,7 @@ class FastCycle:
         fit_failed = getattr(self, "_fit_failed_rows", set())
         unschedulable_rows = set()
 
+        cond_changed_rows = set()
         if self._has("gang"):
             unschedulable_jobs = 0
             for row in self.session_jobs:
@@ -1815,18 +1824,35 @@ class FastCycle:
                 unschedulable_rows.add(row)
                 pg = store.pod_groups.get(m.j_uid[row])
                 if pg is not None:
-                    conditions = [
-                        c for c in pg.status.conditions
-                        if c.type != POD_GROUP_UNSCHEDULABLE
-                    ]
-                    conditions.append(PodGroupCondition(
-                        type=POD_GROUP_UNSCHEDULABLE,
-                        status="True",
-                        transition_id=self.uid,
-                        reason="NotEnoughResources",
-                        message=msg,
-                    ))
-                    pg.status.conditions = conditions
+                    # Condition refresh throttling (job_updater.go
+                    # isPodGroupConditionsUpdated): an existing
+                    # Unschedulable condition differing only in
+                    # transition id is "the same" — keep it instead of
+                    # rewriting every cycle for persistently
+                    # unschedulable jobs.
+                    existing = next(
+                        (c for c in pg.status.conditions
+                         if c.type == POD_GROUP_UNSCHEDULABLE), None
+                    )
+                    if (
+                        existing is None
+                        or existing.status != "True"
+                        or existing.reason != "NotEnoughResources"
+                        or existing.message != msg
+                    ):
+                        conditions = [
+                            c for c in pg.status.conditions
+                            if c.type != POD_GROUP_UNSCHEDULABLE
+                        ]
+                        conditions.append(PodGroupCondition(
+                            type=POD_GROUP_UNSCHEDULABLE,
+                            status="True",
+                            transition_id=self.uid,
+                            reason="NotEnoughResources",
+                            message=msg,
+                        ))
+                        pg.status.conditions = conditions
+                        cond_changed_rows.add(row)
                 metrics.unschedule_task_count.set(
                     int(m.j_minav[row] - self.j_ready_base[row]),
                     job_name=m.j_uid[row].split("/")[-1],
@@ -1836,7 +1862,9 @@ class FastCycle:
                 )
             metrics.unschedule_job_count.set(unschedulable_jobs)
 
-        # jobStatus write-back (framework.go _job_status).
+        # jobStatus write-back, skipping unchanged PodGroups
+        # (framework.go jobStatus + job_updater.go
+        # isPodGroupStatusUpdated: only changed statuses are written).
         for row in self.session_jobs:
             pg = store.pod_groups.get(m.j_uid[row])
             if pg is None:
@@ -1844,16 +1872,30 @@ class FastCycle:
             status = pg.status
             running = int(self.j_cnt_run[row])
             if running != 0 and row in unschedulable_rows:
-                status.phase = PodGroupPhase.Unknown.value
+                new_phase = PodGroupPhase.Unknown.value
             else:
                 allocated = int(self.j_cnt_alloc[row] + self.j_cnt_succ[row])
                 if allocated >= m.j_minav[row]:
-                    status.phase = PodGroupPhase.Running.value
+                    new_phase = PodGroupPhase.Running.value
                 elif status.phase != PodGroupPhase.Inqueue.value:
-                    status.phase = PodGroupPhase.Pending.value
+                    new_phase = PodGroupPhase.Pending.value
+                else:
+                    new_phase = status.phase
+            failed = int(self.j_cnt_fail[row])
+            succeeded = int(self.j_cnt_succ[row])
+            if (
+                row not in cond_changed_rows
+                and pg.uid not in self._phase_dirty
+                and status.phase == new_phase
+                and status.running == running
+                and status.failed == failed
+                and status.succeeded == succeeded
+            ):
+                continue
+            status.phase = new_phase
             status.running = running
-            status.failed = int(self.j_cnt_fail[row])
-            status.succeeded = int(self.j_cnt_succ[row])
+            status.failed = failed
+            status.succeeded = succeeded
             store.status_updater.update_pod_group(pg)
             if store._watchers:
                 store._notify("PodGroup", "status", pg)
