@@ -168,3 +168,46 @@ def test_enqueue_phase_transition_persisted_despite_writeback_skip():
     )
     Scheduler(store).run_once()
     assert "Inqueue" in phases, f"Inqueue not persisted: {phases}"
+
+
+def test_enqueue_transition_survives_failed_cycle(monkeypatch):
+    """A cycle that fails AFTER enqueue's in-place Inqueue mutation must
+    not strand the transition: the next successful cycle still persists
+    it (the dirty set lives on the store, cleared only after a
+    successful write-back)."""
+    import volcano_tpu.fastpath as fp
+    from volcano_tpu.api import Node, PodGroup
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    store.add_node(Node(name="n0", allocatable={"cpu": "4",
+                                                "memory": "8Gi"}))
+    store.add_pod_group(PodGroup(name="g", min_member=1,
+                                 min_resources={"cpu": "1"}))
+    phases = []
+    orig_update = store.status_updater.update_pod_group
+    store.status_updater.update_pod_group = (
+        lambda pg: (phases.append(pg.status.phase), orig_update(pg))[1]
+    )
+    orig_alloc = fp.FastCycle._allocate
+    calls = {"n": 0}
+
+    def failing_alloc(self):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("device failure after enqueue")
+        return orig_alloc(self)
+
+    monkeypatch.setattr(fp.FastCycle, "_allocate", failing_alloc)
+    sched = Scheduler(store)
+    sched.run_once()  # fast cycle fails post-enqueue; object path covers
+    phases.clear()
+    # Force the interesting path: a later FAST cycle must persist the
+    # still-pending transition even though the phase compares equal.
+    store._phase_dirty_uids.add("default/g")
+    sched.run_once()
+    assert "Inqueue" in phases or "Running" in phases, (
+        f"stranded transition never persisted: {phases}"
+    )
+    assert not store._phase_dirty_uids

@@ -524,8 +524,14 @@ class FastCycle:
     def run(self) -> None:
         # PodGroups whose phase was mutated in place mid-cycle (enqueue's
         # Pending -> Inqueue gate): the close write-back must not skip
-        # them as "unchanged".
-        self._phase_dirty = set()
+        # them as "unchanged".  Lives on the STORE and is only cleared
+        # after a successful write-back, so a cycle that fails between
+        # the mutation and close does not strand the transition
+        # unpersisted forever.
+        store = self.store
+        if not hasattr(store, "_phase_dirty_uids"):
+            store._phase_dirty_uids = set()
+        self._phase_dirty = store._phase_dirty_uids
         self.derive()
         self._proportion()
         self.new_conditions: Dict[int, PodGroupCondition] = {}
@@ -1899,6 +1905,10 @@ class FastCycle:
             store.status_updater.update_pod_group(pg)
             if store._watchers:
                 store._notify("PodGroup", "status", pg)
+        # Every pending in-place transition has now been persisted (or
+        # superseded); a failure above leaves the set intact for the
+        # next cycle.
+        self._phase_dirty.clear()
 
     def _gang_message(self, row: int, fit_failed: bool) -> str:
         """Replicates gang.go's unschedulable message via job.fit_error()."""
