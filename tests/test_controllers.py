@@ -514,14 +514,8 @@ def test_lifecycle_policy_event_action_matrix(event, action,
 
         store.evict(TaskInfo(victim), "test eviction")
         sim.step()  # eviction completes (pod deleted)
-    for _ in range(8):
-        cm.process()
-        sched.run_once()
-        sim.step()
-        cm.process()
-        phase = store.batch_jobs["default/mx"].status.state.phase
-        if phase == expected_phase:
-            break
+    converge(cm, sched, sim, cycles=8)
+    phase = store.batch_jobs["default/mx"].status.state.phase
     assert phase == expected_phase, (
         f"{event} x {action}: expected {expected_phase}, got {phase}"
     )

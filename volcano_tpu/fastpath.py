@@ -429,8 +429,17 @@ class FastCycle:
             elif opt.name == "drf":
                 plugin_cols.append(drf_share[:Jn])
         # np.lexsort: LAST key is primary -> tie-breaks first, tiers in
-        # reverse order last.
-        cols = [np.array(m.j_uid[:Jn]), m.j_create[:Jn]]
+        # reverse order last.  The uid tie-break column uses a per-cycle
+        # integer rank (a strictly monotone map of the uid strings):
+        # string lexsorts over tens of thousands of uids dominated this
+        # function, and it runs 2+ times per cycle.
+        uid_rank = getattr(self, "_uid_rank", None)
+        if uid_rank is None:
+            order0 = np.argsort(np.array(m.j_uid[:Jn]), kind="stable")
+            uid_rank = np.empty(Jn, np.int64)
+            uid_rank[order0] = np.arange(Jn)
+            self._uid_rank = uid_rank
+        cols = [uid_rank, m.j_create[:Jn]]
         cols.extend(reversed(plugin_cols))
         order = np.lexsort(tuple(cols))
         rank = np.empty(Jn, np.int64)
