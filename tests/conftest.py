@@ -2,23 +2,11 @@
 
 Multi-chip sharding is validated on a virtual CPU mesh
 (xla_force_host_platform_device_count), matching how the driver dry-runs the
-multi-chip path; real-TPU benchmarking happens in bench.py.
-
-Note: the environment's TPU plugin pins jax_platforms at interpreter startup
-(before conftest runs), so the env var alone is not enough — we override the
-live jax config after import.
+multi-chip path; real-TPU benchmarking happens in bench.py.  The override
+logic is shared with __graft_entry__.dryrun_multichip via
+volcano_tpu.virtualcpu.
 """
 
-import os
+from volcano_tpu.virtualcpu import force_virtual_cpu_platform
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_platform(8)

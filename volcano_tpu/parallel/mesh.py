@@ -27,9 +27,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NODES_AXIS = "nodes"
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = NODES_AXIS) -> Mesh:
-    devices = jax.devices()
+def make_mesh(n_devices: Optional[int] = None, axis: str = NODES_AXIS,
+              platform: Optional[str] = None) -> Mesh:
+    """Build a 1-D device mesh over the nodes axis.
+
+    ``platform`` pins the backend explicitly ("cpu", "tpu"); default is
+    jax's default backend.  Callers that need the virtual CPU mesh (the
+    multi-chip dryrun, the test suite) must force the platform first —
+    ``volcano_tpu.virtualcpu.force_virtual_cpu_platform`` — and pass
+    ``platform="cpu"``.
+    """
+    devices = jax.devices(platform) if platform is not None else jax.devices()
     if n_devices is not None:
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"mesh needs {n_devices} devices, backend has {len(devices)}"
+            )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
 
