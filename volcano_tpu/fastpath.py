@@ -23,9 +23,14 @@ incremental array mirror (``cache/mirror.py``):
   (``framework/framework.go`` jobStatus) and the gang plugin's
   OnSessionClose conditions (``gang.go:140-183``).
 
-Eligibility: actions within {enqueue, allocate, backfill} and plugins
-within the built-in set.  Anything else (preempt/reclaim, custom plugins)
-falls back to the object path, which remains the semantic reference.
+Eligibility (``eligible()``): actions within ``FAST_ACTIONS``
+({enqueue, allocate, backfill, preempt, reclaim} — preempt/reclaim
+dispatch to ``fastpath_evict``), plugins within ``FAST_PLUGINS`` (the
+eight built-ins), and the wave solver selected.  Anything else — custom
+plugins, unknown actions, solver=sequential — falls back to the object
+path, which remains the semantic reference (custom predicate /
+node-order / device-mask callbacks still reach the device solver there,
+via ``actions/allocate.py``).
 """
 
 from __future__ import annotations
