@@ -1,0 +1,113 @@
+"""Async bind dispatch + rate-limited bind-failure backoff + event trail
+(the analog of cache.go:536-552 goroutine binds and 627-649 errTasks)."""
+
+import time
+
+from volcano_tpu.cache.interface import BindFailure
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+
+def _flaky(store, fail_times):
+    """Wrap the store's binder: the first ``fail_times`` batches fail the
+    second half of their keys."""
+    orig = store.binder.bind_keys
+    state = {"left": fail_times}
+
+    def flaky(keys, hosts):
+        if state["left"] > 0:
+            state["left"] -= 1
+            half = len(keys) // 2
+            orig(list(keys[:half]), list(hosts[:half]))
+            raise BindFailure(list(keys[half:]))
+        orig(keys, hosts)
+
+    store.binder.bind_keys = flaky
+    return state
+
+
+def test_async_bind_failure_reverts_with_backoff(monkeypatch):
+    from volcano_tpu.cache import bindqueue
+
+    monkeypatch.setattr(bindqueue, "BACKOFF_BASE", 0.05)
+    store = synthetic_cluster(n_nodes=8, n_pods=24, gang_size=1)
+    store.async_bind = True
+    _flaky(store, fail_times=1)
+    sched = Scheduler(store)
+    sched.run_once()
+    assert store.flush_binds(timeout=10)
+    assert len(store.binder.binds) == 12
+
+    # Next cycle drains the failures: tasks revert to Pending, carry a
+    # backoff window, and are NOT re-solved within it.
+    sched.run_once()
+    assert store.flush_binds(timeout=10)
+    assert len(store.bind_backoff) == 12
+    assert len(store.binder.binds) == 12  # still inside backoff
+
+    # FailedScheduling events are visible on the pods.
+    failed_keys = list(store.bind_backoff)
+    evs = store.events_for(f"Pod/{failed_keys[0]}")
+    assert any(e["reason"] == "FailedScheduling" for e in evs)
+
+    # After the backoff expires the tasks re-enter and bind.
+    time.sleep(0.12)
+    sched.run_once()
+    assert store.flush_binds(timeout=10)
+    assert len(store.binder.binds) == 24
+    assert all(p.node_name for p in store.pods.values())
+    # Successful rebind clears the backoff state.
+    assert not store.bind_backoff
+
+
+def test_async_bind_success_records_scheduled_events():
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=1)
+    store.async_bind = True
+    Scheduler(store).run_once()
+    assert store.flush_binds(timeout=10)
+    pod = next(iter(store.pods.values()))
+    evs = store.events_for(f"Pod/{pod.namespace}/{pod.name}")
+    assert any(e["reason"] == "Scheduled" for e in evs)
+
+
+def test_unschedulable_gang_records_podgroup_event():
+    # A gang that cannot fit leaves an Unschedulable event on its group.
+    store = synthetic_cluster(n_nodes=1, n_pods=4, gang_size=4,
+                              pod_cpu_choices=("64",),
+                              pod_mem_choices=("256Gi",))
+    Scheduler(store).run_once()
+    pgs = [pg for pg in store.pod_groups.values()]
+    assert pgs
+    hit = False
+    for pg in pgs:
+        evs = store.events_for(f"PodGroup/{pg.namespace}/{pg.name}")
+        if any(e["reason"] == "Unschedulable" for e in evs):
+            hit = True
+    assert hit
+
+
+def test_evict_records_event():
+    from volcano_tpu.synth import preempt_cluster
+
+    store = preempt_cluster(n_nodes=4, fill_per_node=4, n_pending=8,
+                            gang_size=1)
+    conf = """
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+    Scheduler(store, conf_str=conf).run_once()
+    evicted = getattr(store.evictor, "evicts", [])
+    assert evicted
+    key = evicted[0]
+    evs = store.events_for(f"Pod/{key}")
+    assert any(e["reason"] == "Evict" for e in evs)

@@ -1,0 +1,138 @@
+"""Async bind dispatch + rate-limited bind-failure queue.
+
+The reference dispatches every bind on a goroutine and never waits for it
+in the scheduling cycle (``pkg/scheduler/cache/cache.go:536-552``); failed
+binds push the task onto a rate-limited ``errTasks`` workqueue whose
+resync re-derives the task from the API server with exponential backoff
+(``cache.go:106-107,627-649``).  This module is that machinery for the
+fast path:
+
+- ``BindDispatcher`` owns a worker thread draining batched bind requests
+  to the store's ``Binder``.  The scheduling cycle only pays the queue
+  append.
+- Failures land in a thread-safe failure list the scheduler drains at the
+  START of the next cycle (keeping every mirror mutation on the cycle
+  thread); each failure re-enters Pending with an exponential per-task
+  backoff (``not_before``) during which the solver does not re-place it —
+  the analog of the task sitting in the rate-limited errTasks queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+# Reference workqueue.DefaultItemBasedRateLimiter: 5ms base, 1000s cap.
+# Scheduling periods are ~1s, so sub-second delays are invisible; start
+# at one period instead.
+BACKOFF_BASE = 1.0
+BACKOFF_MAX = 60.0
+
+
+class BindDispatcher:
+    """Single worker thread draining batched bind requests."""
+
+    def __init__(self, binder,
+                 on_failure: Callable[[List[Tuple[str, object]]], None],
+                 on_success: Optional[Callable[[List[str], List[str]], None]] = None):
+        self._binder = binder
+        self._on_failure = on_failure
+        self._on_success = on_success
+        self._q: List[Tuple[Sequence[str], Sequence[str], Sequence[object]]] = []
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._inflight = 0
+        self._thread = threading.Thread(
+            target=self._run, name="vc-bind-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    def dispatch(self, keys: Sequence[str], hosts: Sequence[str],
+                 pods: Sequence[object]) -> None:
+        with self._cv:
+            self._q.append((keys, hosts, pods))
+            self._inflight += 1
+            self._cv.notify()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every dispatched batch has been processed."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._cv:
+            while self._inflight > 0:
+                remaining = (
+                    None if deadline is None else deadline - time.time()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+        return True
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        from .interface import BindFailure
+
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._q:
+                    return
+                keys, hosts, pods = self._q.pop(0)
+            failed: List[str] = []
+            try:
+                bind_keys = getattr(self._binder, "bind_keys", None)
+                if bind_keys is not None:
+                    try:
+                        bind_keys(list(keys), list(hosts))
+                    except BindFailure as bf:
+                        failed = list(bf.failed)
+                else:
+                    for pod, host, key in zip(pods, hosts, keys):
+                        try:
+                            self._binder.bind(pod, host)
+                        except BindFailure:
+                            failed.append(key)
+            except Exception:
+                # A binder that throws something other than BindFailure
+                # fails the whole batch; the resync path retries.
+                log.exception("bind batch failed")
+                failed = list(keys)
+            if failed:
+                try:
+                    # Hand the pod objects back with the keys so the
+                    # store's drain never re-derives key->pod over the
+                    # whole pod table.
+                    by_key = {k: p for k, p in zip(keys, pods)}
+                    self._on_failure(
+                        [(k, by_key.get(k)) for k in failed]
+                    )
+                except Exception:
+                    log.exception("bind-failure handler failed")
+            if self._on_success is not None:
+                ok_pairs = None
+                if failed:
+                    fset = set(failed)
+                    ok_pairs = (
+                        [k for k in keys if k not in fset],
+                        [h for k, h in zip(keys, hosts) if k not in fset],
+                    )
+                else:
+                    ok_pairs = (list(keys), list(hosts))
+                try:
+                    self._on_success(*ok_pairs)
+                except Exception:
+                    log.exception("bind-success handler failed")
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()

@@ -201,6 +201,11 @@ class StoreMirror:
         cap = 1024
         self.p_uid: List[Optional[str]] = []
         self.p_key: List[str] = []  # "ns/name" bind key per row
+        # Live pod record per row (kept current by upsert_pod: every
+        # store.pods[uid] = pod write is paired with an upsert).  Lets the
+        # fast path's bulk commit reach 100k pod objects by list indexing
+        # instead of 100k string-keyed dict lookups.
+        self.p_pod: List[Optional[Pod]] = []
         self.p_feat: List[Optional[_PodFeat]] = []
         self.p_row: Dict[str, int] = {}
         self.p_status = np.zeros(cap, np.int16)
@@ -211,6 +216,7 @@ class StoreMirror:
         self.p_alive = np.zeros(cap, bool)
         self.p_be = np.zeros(cap, bool)  # best-effort (empty init_req)
         self.p_has_ip = np.zeros(cap, bool)  # has inter-pod terms
+        self.p_has_tol = np.zeros(cap, bool)  # has tolerations
         self.p_prof = np.zeros(cap, I)  # task profile id (self.profiles)
         self.c_req = CSRColumn(has_val=True)
         self.c_init_req = CSRColumn(has_val=True)
@@ -257,6 +263,12 @@ class StoreMirror:
         self.j_create = np.zeros(jcap, np.float64)
         self.j_queue: List[str] = []
         self.j_ns: List[str] = []
+        # Interned namespace/queue codes (vectorized grouping in the fast
+        # path: string columns force Python loops at 10k+ jobs).
+        self.ns_names = Interner()
+        self.qnames = Interner()
+        self.j_ns_code = np.zeros(jcap, I)
+        self.j_queue_code = np.zeros(jcap, I)
         self.j_alive = np.zeros(jcap, bool)
         # Toleration specs per pod row (matched lazily per cycle, because
         # the taint dictionary may grow after the pod was added).
@@ -474,6 +486,7 @@ class StoreMirror:
                 self._orphans.setdefault(pod.node_name, []).append(pod.uid)
         row = self.p_row.get(pod.uid)
         if row is not None and self.p_uid[row] == pod.uid:
+            self.p_pod[row] = pod
             if self.p_feat[row] is feat:
                 # Same spec blob (bind/evict copy-on-write carries it over):
                 # update dynamic state only.  The job link is re-derived —
@@ -489,6 +502,7 @@ class StoreMirror:
         row = len(self.p_uid)
         self.p_uid.append(pod.uid)
         self.p_key.append(f"{pod.namespace}/{pod.name}")
+        self.p_pod.append(pod)
         self.p_feat.append(feat)
         self.p_row[pod.uid] = row
         n = row + 1
@@ -500,6 +514,7 @@ class StoreMirror:
         self.p_alive = _grow(self.p_alive, n)
         self.p_be = _grow(self.p_be, n)
         self.p_has_ip = _grow(self.p_has_ip, n)
+        self.p_has_tol = _grow(self.p_has_tol, n)
         self.p_prof = _grow(self.p_prof, n)
         self.p_aff_lo = _grow(self.p_aff_lo, n)
         self.p_aff_hi = _grow(self.p_aff_hi, n)
@@ -516,6 +531,7 @@ class StoreMirror:
         self.p_alive[row] = True
         self.p_be[row] = feat.best_effort
         self.p_has_ip[row] = feat.has_ip
+        self.p_has_tol[row] = bool(feat.tol)
         self.p_prof[row] = self.profiles.intern(feat.key)
 
         self.c_req.append(*feat.req)
@@ -562,6 +578,7 @@ class StoreMirror:
             return
         self.p_alive[row] = False
         self.p_uid[row] = None
+        self.p_pod[row] = None
         self.n_dead += 1
 
     def set_pod_state(self, uid: str, status: int, node_row: int) -> None:
@@ -693,10 +710,31 @@ class StoreMirror:
             self.j_prio = _grow(self.j_prio, n)
             self.j_create = _grow(self.j_create, n)
             self.j_alive = _grow(self.j_alive, n)
+            self.j_ns_code = _grow(self.j_ns_code, n)
+            self.j_queue_code = _grow(self.j_queue_code, n)
             self.j_queue.append("default")
             self.j_ns.append("default")
+            self.j_ns_code[row] = self.ns_names.intern("default")
+            self.j_queue_code[row] = self.qnames.intern("default")
             self.j_alive[row] = False
+            self._j_uid_rank = None
         return row
+
+    def job_uid_rank(self) -> np.ndarray:
+        """[Jn] integer rank array that is a strictly monotone map of the
+        job uid strings (the session default tie-break).  Cached until a
+        new job row appears — the string argsort over tens of thousands
+        of uids is too slow to pay per cycle."""
+        rank = self._j_uid_rank
+        Jn = len(self.j_uid)
+        if rank is None or len(rank) != Jn:
+            order = np.argsort(np.array(self.j_uid[:Jn]), kind="stable")
+            rank = np.empty(Jn, np.int64)
+            rank[order] = np.arange(Jn)
+            self._j_uid_rank = rank
+        return rank
+
+    _j_uid_rank: Optional[np.ndarray] = None
 
     def upsert_pod_group(self, pg, priority: int) -> None:
         row = self.job_row(pg.uid)
@@ -705,7 +743,29 @@ class StoreMirror:
         self.j_create[row] = pg.creation_timestamp
         self.j_queue[row] = pg.queue
         self.j_ns[row] = pg.namespace
+        self.j_ns_code[row] = self.ns_names.intern(pg.namespace)
+        self.j_queue_code[row] = self.qnames.intern(pg.queue)
         self.j_alive[row] = True
+        # Precompute the dense MinResources vector at add time (unknown
+        # scalar names are interned like pod requests are), so enqueue's
+        # budget walk never parses resource quantities in-cycle.
+        if pg.min_resources is not None:
+            try:
+                res = Resource.from_resource_list(pg.min_resources)
+                R = 2 + len(self.scalar_slots)
+                if res.scalars:
+                    for name in res.scalars:
+                        self.scalar_slots.intern(name)
+                    R = 2 + len(self.scalar_slots)
+                v = np.zeros((R,), np.float32)
+                v[0] = res.milli_cpu
+                v[1] = res.memory
+                if res.scalars:
+                    for name, quant in res.scalars.items():
+                        v[2 + self.scalar_slots.index[name]] = quant
+                pg._minres_vec = (R, v)
+            except Exception:
+                pass
 
     def remove_pod_group(self, uid: str) -> None:
         row = self.j_row.get(uid)
@@ -731,6 +791,7 @@ class StoreMirror:
                      "n_alive", "n_maxtasks", "c_n_alloc", "c_n_labels",
                      "c_n_taints", "node_objs", "domains", "j_uid", "j_row",
                      "j_minav", "j_prio", "j_create", "j_queue", "j_ns",
+                     "ns_names", "qnames", "j_ns_code", "j_queue_code",
                      "j_alive", "_pods_ref", "_orphans", "epoch"):
             setattr(fresh, attr, getattr(old, attr))
         fresh._node_dom_dirty = True
@@ -742,11 +803,12 @@ class StoreMirror:
             uid = old.p_uid[r]
             fresh.p_uid.append(uid)
             fresh.p_key.append(old.p_key[r])
+            fresh.p_pod.append(old.p_pod[r])
             fresh.p_feat.append(old.p_feat[r])
             fresh.p_row[uid] = len(fresh.p_uid) - 1
         n = len(live)
         for name in ("p_status", "p_node", "p_job", "p_prio", "p_create",
-                     "p_alive", "p_be", "p_has_ip", "p_prof"):
+                     "p_alive", "p_be", "p_has_ip", "p_has_tol", "p_prof"):
             arr = getattr(old, name)[:total][live]
             setattr(fresh, name, arr.copy())
         # CSR columns: re-append per live row (vectorized gather then bulk).

@@ -102,8 +102,160 @@ class ClusterStore:
         self.mirror = StoreMirror()
         self.mirror.attach(self.pods)
 
+        # Async bind dispatch + rate-limited bind-failure resync
+        # (cache.go:536-552 goroutine binds; 627-649 errTasks).  Sync by
+        # default so tests observe binds immediately after a cycle;
+        # production service/bench enable async.
+        self.async_bind = False
+        self._bind_dispatcher = None
+        self._bind_fail_lock = threading.Lock()
+        # [(key, pod), ...] reported by the dispatcher thread.
+        self._failed_bind_keys: List[tuple] = []
+        # "ns/name" -> (consecutive fails, retry-not-before timestamp).
+        self.bind_backoff: Dict[str, tuple] = {}
+
+        # Per-object user-visible event trail (the reference records
+        # Kubernetes Events for Evict/Scheduled/FailedScheduling/
+        # Unschedulable — cache.go:487,540,584,790).  Key: "Kind/ns/name";
+        # value: list of [reason, message, count, first_ts, last_ts],
+        # deduplicated k8s-style on (reason, message).
+        self._events: Dict[str, List[list]] = {}
+        self._events_lock = threading.Lock()
+
         # Create the default queue at startup, weight 1 (cache.go:244-254).
         self.add_queue(Queue(name=default_queue, weight=1))
+
+    # ------------------------------------------------------------- events
+
+    EVENTS_PER_OBJECT = 16
+    # Hyperscale guard: the event map sheds its oldest objects beyond this
+    # (500k-pod snapshots would otherwise pin hundreds of MB of trails).
+    MAX_EVENT_OBJECTS = 100_000
+
+    def record_event(self, key: str, reason: str, message: str) -> None:
+        """Append a user-visible event to an object's trail
+        (``key`` = "Kind/ns/name", e.g. "Pod/default/job-a-0")."""
+        import time as _time
+
+        now = _time.time()
+        with self._events_lock:
+            if (key not in self._events
+                    and len(self._events) >= self.MAX_EVENT_OBJECTS):
+                self._events.pop(next(iter(self._events)))
+            trail = self._events.setdefault(key, [])
+            for ev in trail:
+                if ev[0] == reason and ev[1] == message:
+                    ev[2] += 1
+                    ev[4] = now
+                    return
+            trail.append([reason, message, 1, now, now])
+            if len(trail) > self.EVENTS_PER_OBJECT:
+                del trail[0]
+
+    def events_for(self, key: str) -> List[dict]:
+        with self._events_lock:
+            return [
+                {"reason": r, "message": m, "count": c,
+                 "first_seen": f, "last_seen": l}
+                for r, m, c, f, l in self._events.get(key, [])
+            ]
+
+    # -------------------------------------------------- async bind machinery
+
+    def dispatch_binds(self, keys, hosts, pods) -> None:
+        """Queue a batch of binds on the background dispatcher (the
+        goroutine analog); failures surface at the next cycle's
+        ``drain_bind_failures``."""
+        if self._bind_dispatcher is None:
+            from .bindqueue import BindDispatcher
+
+            self._bind_dispatcher = BindDispatcher(
+                self.binder, self._on_bind_failures,
+                on_success=self._on_bind_success,
+            )
+        self._bind_dispatcher.dispatch(keys, hosts, pods)
+
+    def flush_binds(self, timeout: Optional[float] = None) -> bool:
+        if self._bind_dispatcher is None:
+            return True
+        return self._bind_dispatcher.flush(timeout)
+
+    def close(self) -> None:
+        """Stop background machinery (the bind dispatcher thread).  The
+        dispatcher's callbacks pin this store, so long-lived processes
+        creating many stores (benchmarks) must close them."""
+        if self._bind_dispatcher is not None:
+            self._bind_dispatcher.stop()
+            self._bind_dispatcher = None
+
+    def _on_bind_failures(self, failed_pairs) -> None:
+        """Dispatcher-thread hook: ``failed_pairs`` is [(key, pod), ...]."""
+        with self._bind_fail_lock:
+            self._failed_bind_keys.extend(failed_pairs)
+
+    def _on_bind_success(self, keys: List[str], hosts: List[str]) -> None:
+        """Dispatcher-thread hook: record Scheduled events (cache.go:540)
+        and clear any backoff the task had accumulated — all off the
+        scheduling cycle's critical path."""
+        if self.bind_backoff:
+            for key in keys:
+                self.bind_backoff.pop(key, None)
+        for key, host in zip(keys, hosts):
+            self.record_event(f"Pod/{key}", "Scheduled",
+                              f"bound to {host}")
+
+    def drain_bind_failures(self) -> int:
+        """Apply queued bind failures: the task re-enters Pending with an
+        exponential backoff window during which the solver skips it (the
+        rate-limited errTasks retry, cache.go:627-649).  Runs on the
+        scheduling-cycle thread so all mirror mutation stays there."""
+        import time as _time
+
+        from .bindqueue import BACKOFF_BASE, BACKOFF_MAX
+
+        with self._bind_fail_lock:
+            failed = self._failed_bind_keys
+            self._failed_bind_keys = []
+        if not failed:
+            return 0
+        now = _time.time()
+        n = 0
+        with self._lock:
+            for key, pod in failed:
+                # Skip stale entries: the pod may have been replaced
+                # (copy-on-write) or removed since the dispatch.
+                if (pod is None or self.pods.get(pod.uid) is not pod
+                        or pod.node_name is None):
+                    continue
+                fails, _ = self.bind_backoff.get(key, (0, 0.0))
+                fails += 1
+                delay = min(BACKOFF_BASE * (2 ** (fails - 1)), BACKOFF_MAX)
+                self.bind_backoff[key] = (fails, now + delay)
+                pod.node_name = None
+                self.mirror.set_pod_state(
+                    pod.uid, int(TaskStatus.Pending), -1
+                )
+                self.mark_objects_stale()
+                self.record_event(
+                    f"Pod/{key}", "FailedScheduling",
+                    f"bind failed; retry in {delay:.0f}s "
+                    f"(attempt {fails})",
+                )
+                # Watchers (job/podgroup controllers) must recount: the
+                # commit already notified a bind for this pod before the
+                # outcome was known.
+                self._notify("Pod", "update", pod)
+                n += 1
+        return n
+
+    def bind_retry_ok(self, key: str, now: float) -> bool:
+        """True when the task is clear of its bind-failure backoff."""
+        ent = self.bind_backoff.get(key)
+        if ent is None:
+            return True
+        if now >= ent[1]:
+            return True
+        return False
 
     # ----------------------------------------------- lazy object model
 
@@ -452,6 +604,10 @@ class ClusterStore:
             self.pods[pod.uid] = pod
             self._add_task(pod)
             self.mirror.upsert_pod(pod, self.mirror.job_row)
+            self.record_event(
+                f"Pod/{pod.namespace}/{pod.name}", "Scheduled",
+                f"bound to {hostname}",
+            )
             self._notify("Pod", "bind", pod)
 
     def evict(self, task: TaskInfo, reason: str) -> None:
@@ -468,6 +624,10 @@ class ClusterStore:
             self._add_task(pod)
             self.mirror.upsert_pod(pod, self.mirror.job_row)
             self.evictor.evict(pod)
+            self.record_event(
+                f"Pod/{pod.namespace}/{pod.name}", "Evict",
+                reason or "evicted by scheduler",
+            )
             self._notify("Pod", "evict", pod)
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:

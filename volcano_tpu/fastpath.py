@@ -240,25 +240,42 @@ class FastCycle:
             if len(rows_res) else np.zeros(Nn, I)
         )
 
-        # Per-job status counters.
+        # Per-job status counters: ONE bincount over a combined
+        # (job, status) key serves all eight counters (separate
+        # mask+bincount passes each re-walk the 100k-row pod table).
         self.Jn = Jn = len(m.j_uid)
         jr = self.jobr
         valid_j = alive & (jr >= 0)
+        vrows = np.flatnonzero(valid_j)
+        NS_ = int(status.max(initial=0)) + 1
+        by_js = np.bincount(
+            jr[vrows].astype(np.int64) * NS_ + status[vrows],
+            minlength=Jn * NS_,
+        ).reshape(Jn, NS_).astype(I)
 
-        def jcount(mask):
-            rows = np.flatnonzero(valid_j & mask)
-            return np.bincount(jr[rows], minlength=Jn).astype(I)
+        def scount(st):
+            return by_js[:, st] if st < NS_ else np.zeros(Jn, I)
 
         alloc_mask = np.isin(status, _ALLOCATED_STATUSES)
-        self.j_cnt_alloc = jcount(alloc_mask)
-        self.j_cnt_succ = jcount(status == ST_SUCCEEDED)
-        self.j_cnt_fail = jcount(status == ST_FAILED)
-        self.j_cnt_run = jcount(status == ST_RUNNING)
+        alloc_cols = [st for st in _ALLOCATED_STATUSES if st < NS_]
+        self.j_cnt_alloc = (
+            by_js[:, alloc_cols].sum(axis=1).astype(I)
+            if alloc_cols else np.zeros(Jn, I)
+        )
+        self.j_cnt_succ = scount(ST_SUCCEEDED)
+        self.j_cnt_fail = scount(ST_FAILED)
+        self.j_cnt_run = scount(ST_RUNNING)
         pending_mask = status == ST_PENDING
-        self.j_cnt_pending = jcount(pending_mask)
-        self.j_cnt_empty_pending = jcount(pending_mask & m.p_be[:Pn])
-        self.j_cnt_total = jcount(np.ones_like(status, bool))
-        self.j_cnt_releasing = jcount(status == ST_RELEASING)
+        self.j_cnt_pending = scount(ST_PENDING)
+        self.j_cnt_empty_pending = (
+            np.bincount(
+                jr[np.flatnonzero(valid_j & pending_mask & m.p_be[:Pn])],
+                minlength=Jn,
+            ).astype(I)
+            if m.p_be[:Pn].any() else np.zeros(Jn, I)
+        )
+        self.j_cnt_total = by_js.sum(axis=1).astype(I)
+        self.j_cnt_releasing = scount(ST_RELEASING)
         self.j_cnt_other = (
             self.j_cnt_total - self.j_cnt_alloc - self.j_cnt_succ
             - self.j_cnt_fail - self.j_cnt_pending - self.j_cnt_releasing
@@ -300,6 +317,23 @@ class FastCycle:
         self.session_jobs = [
             row for row in range(Jn) if m.j_alive[row]
         ]
+        # One pass over the podgroup dict serves every later consumer
+        # (_enqueue / _schedulable_rows / _close previously each paid a
+        # 12k+-element dict-lookup loop).  j_phase codes: 0 = missing,
+        # 1 = Pending, 2 = other.
+        pgs = self.store.pod_groups
+        j_pgs: List[Optional[object]] = [None] * Jn
+        j_phase = np.zeros(Jn, np.int8)
+        pending_phase = PodGroupPhase.Pending.value
+        j_uid = m.j_uid
+        for row in self.session_jobs:
+            pg = pgs.get(j_uid[row])
+            if pg is None:
+                continue
+            j_pgs[row] = pg
+            j_phase[row] = 1 if pg.status.phase == pending_phase else 2
+        self.j_pgs = j_pgs
+        self.j_phase = j_phase
 
     # ---------------------------------------------------------- resources
 
@@ -312,8 +346,28 @@ class FastCycle:
 
     # -------------------------------------------------------------- shares
 
+    def _flush_aggr(self) -> None:
+        """Apply deferred per-job/per-queue resource scatter updates.
+
+        _commit defers the j_alloc_res / j_pending_res / q_alloc scatter
+        adds (three 200k-entry np.add.at calls at north-star scale) because
+        the typical single-round cycle never reads them again; consumers
+        that can observe post-commit values flush first.  In-place add.at
+        keeps captured references (e.g. _overused_fn's alloc) coherent."""
+        pend = getattr(self, "_aggr_pending", None)
+        if not pend:
+            return
+        self._aggr_pending = []
+        for jr_er, si, v, q_er in pend:
+            np.add.at(self.j_alloc_res, (jr_er, si), v)
+            np.add.at(self.j_pending_res, (jr_er, si), -v)
+            qm = q_er >= 0
+            if qm.any():
+                np.add.at(self.q_alloc, (q_er[qm], si[qm]), v[qm])
+
     def _drf_shares(self) -> np.ndarray:
         """Per-job DRF share (drf.go:317-329), vectorized."""
+        self._flush_aggr()
         total = self.total_res
         with np.errstate(divide="ignore", invalid="ignore"):
             ratio = np.where(
@@ -327,16 +381,20 @@ class FastCycle:
         """Water-fill deserved shares (proportion.go:117-173) over the
         queues that have session jobs.  Mirrors the plugin's Resource-level
         loop exactly (queue counts are small)."""
+        self._flush_aggr()
         q_alloc = np.zeros((self.Qn, self.R), F)
         q_req = np.zeros((self.Qn, self.R), F)
         q_seen = np.zeros(self.Qn, bool)
-        for row in self.session_jobs:
-            qi = self.q_of_job[row]
-            if qi < 0:
-                continue
-            q_seen[qi] = True
-            q_alloc[qi] += self.j_alloc_res[row]
-            q_req[qi] += self.j_alloc_res[row] + self.j_pending_res[row]
+        srows = np.asarray(self.session_jobs, np.int64)
+        if len(srows):
+            qs = self.q_of_job[srows]
+            ok = qs >= 0
+            srows_q = srows[ok]
+            qs = qs[ok]
+            q_seen[qs] = True
+            np.add.at(q_alloc, qs, self.j_alloc_res[srows_q])
+            np.add.at(q_req, qs,
+                      self.j_alloc_res[srows_q] + self.j_pending_res[srows_q])
         self.q_alloc = q_alloc
         self.q_seen = q_seen
 
@@ -438,12 +496,7 @@ class FastCycle:
         # integer rank (a strictly monotone map of the uid strings):
         # string lexsorts over tens of thousands of uids dominated this
         # function, and it runs 2+ times per cycle.
-        uid_rank = getattr(self, "_uid_rank", None)
-        if uid_rank is None:
-            order0 = np.argsort(np.array(m.j_uid[:Jn]), kind="stable")
-            uid_rank = np.empty(Jn, np.int64)
-            uid_rank[order0] = np.arange(Jn)
-            self._uid_rank = uid_rank
+        uid_rank = m.job_uid_rank()
         cols = [uid_rank, m.j_create[:Jn]]
         cols.extend(reversed(plugin_cols))
         order = np.lexsort(tuple(cols))
@@ -491,6 +544,7 @@ class FastCycle:
         time, so one evaluation per queue per pass suffices)."""
         if not self._has("proportion"):
             return lambda q: False
+        self._flush_aggr()
         deserved = self.q_deserved_res
         qidx = self.queue_index
         alloc = self.q_alloc
@@ -512,6 +566,7 @@ class FastCycle:
 
     def _ns_shares(self, drf_share_unused) -> Dict[str, float]:
         """Weighted namespace DRF shares (drf.go:224-258)."""
+        self._flush_aggr()
         if not (self._has("drf") and any(
             opt.name == "drf"
             for opt in self._tier_opts("enabled_namespace_order")
@@ -574,6 +629,7 @@ class FastCycle:
         self._close()
 
     def _evict_machinery(self):
+        self._flush_aggr()
         if self._evictor is None:
             from .fastpath_evict import FastEvictor
 
@@ -628,6 +684,8 @@ class FastCycle:
         queue_seq: List[str] = []
         seen = set()
         row_pg = {}
+        j_pgs = self.j_pgs
+        j_phase = self.j_phase
         for row in self.session_jobs:
             qname = m.j_queue[row]
             if qname not in store.queues:
@@ -637,9 +695,8 @@ class FastCycle:
             if qname not in seen:
                 seen.add(qname)
                 queue_seq.append(qname)
-            pg = store.pod_groups.get(m.j_uid[row])
-            row_pg[row] = pg
-            if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
+            row_pg[row] = j_pgs[row]
+            if j_phase[row] == 1:
                 jobs_map.setdefault(qname, []).append(row)
         queue_seq.sort(key=_cmp_key(
             lambda l, r: queue_order(store.queues[l], store.queues[r])
@@ -652,6 +709,44 @@ class FastCycle:
         used_vec = (self.n_used[self.n_alive].sum(axis=0)
                     if self.Nn else np.zeros(self.R, F))
         idle = self.total_res * factor - used_vec
+
+        # Accept-all fast path: when no involved queue has a capability
+        # cap and the SUM of every pending group's MinResources fits the
+        # overcommitted idle budget, the sequential scan accepts every
+        # group (each prefix of charges leaves at least the final idle),
+        # so the per-group budget walk collapses to one vector compare.
+        if not _vec_is_empty(idle, eps):
+            capped = self._has("proportion") and any(
+                store.queues[q].queue.capability for q in jobs_map
+            )
+            if not capped:
+                vecs = []
+                all_vec = True
+                for lst in jobs_map.values():
+                    for row in lst:
+                        pg = row_pg[row]
+                        if pg.min_resources is None:
+                            continue
+                        v = self._minres_vec(pg)
+                        if v is None:
+                            all_vec = False
+                            break
+                        vecs.append(v)
+                    if not all_vec:
+                        break
+                if all_vec:
+                    total = (
+                        np.sum(np.stack(vecs), axis=0) if vecs
+                        else np.zeros(self.R, F)
+                    )
+                    if _vec_le(total, idle, eps, scalar_slot):
+                        for lst in jobs_map.values():
+                            for row in lst:
+                                pg = row_pg[row]
+                                pg.status.phase = PodGroupPhase.Inqueue.value
+                                self.j_phase[row] = 2
+                                self._phase_dirty.add(pg.uid)
+                        return
 
         q_cap_vec: Dict[str, Optional[np.ndarray]] = {}
         done = False
@@ -688,6 +783,7 @@ class FastCycle:
                         inqueue = True
                 if inqueue:
                     pg.status.phase = PodGroupPhase.Inqueue.value
+                    self.j_phase[row] = 2
                     # The close-phase skip-check compares against this
                     # already-mutated object; record the transition so
                     # the write-back still persists + notifies it.
@@ -698,6 +794,7 @@ class FastCycle:
         """proportion's JobEnqueueable veto (proportion.go:231-247)."""
         if not self._has("proportion"):
             return True
+        self._flush_aggr()
         queue = self.store.queues.get(qname)
         if queue is None or not queue.queue.capability:
             return True
@@ -713,6 +810,7 @@ class FastCycle:
     def _job_enqueueable_obj(self, qname: str, pg) -> bool:
         if not self._has("proportion"):
             return True
+        self._flush_aggr()
         queue = self.store.queues.get(qname)
         if queue is None or not queue.queue.capability:
             return True
@@ -881,28 +979,29 @@ class FastCycle:
 
     def _schedulable_rows(self) -> List[int]:
         m = self.m
-        rows = []
-        for row in self.session_jobs:
-            pg = self.store.pod_groups.get(m.j_uid[row])
-            if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
-                continue
-            # gang JobValid (gang.go:51-72): registered whenever the gang
-            # plugin is configured (JobValid has no enable flag).
-            if self._has("gang") and self.j_valid[row] < m.j_minav[row]:
-                continue
-            if m.j_queue[row] not in self.store.queues:
-                continue
-            rows.append(row)
-        return rows
+        srows = np.asarray(self.session_jobs, np.int64)
+        if not len(srows):
+            return []
+        keep = self.j_phase[srows] != 1  # Inqueue gate: skip Pending groups
+        # gang JobValid (gang.go:51-72): registered whenever the gang
+        # plugin is configured (JobValid has no enable flag).
+        if self._has("gang"):
+            keep &= self.j_valid[srows] >= m.j_minav[srows]
+        # Queue existence: q_of_job is -1 for unknown queues (derive).
+        keep &= self.q_of_job[srows] >= 0
+        return srows[keep].tolist()
 
-    def _ordered_jobs(self) -> List[_JobProxy]:
+    def _ordered_jobs(self) -> List[int]:
         """Namespace round-robin x queue order x job order, as sorted-list
-        merging (allocate.go:107-153).
+        merging (allocate.go:107-153).  Returns job rows in processing
+        order.
 
         Heap pops over total-ordered keys (the uid tie-break makes every
-        comparator total) produce exactly sorted order, so the object path's
-        PriorityQueues reduce to one lexsort per queue; the queue scan
-        keeps the object path's min-by-key-ties-by-insertion rule."""
+        comparator total) produce exactly sorted order, so the object
+        path's PriorityQueues reduce to lexsorts over interned
+        namespace/queue code columns; the final round-robin ("one job per
+        namespace per round") is a second lexsort on (position-within-
+        namespace, namespace-rank)."""
         m = self.m
         rows = self._schedulable_rows()
         if not rows:
@@ -914,46 +1013,61 @@ class FastCycle:
         queue_order = self._queue_order_fn()
         ns_order = self._namespace_order_fn(ns_share)
 
-        # Group jobs: namespace -> queue -> sorted job list (insertion order
-        # of first job appearance defines dict order, as in the object path).
-        by_ns: Dict[str, Dict[str, List[int]]] = {}
-        for row in rows:
-            by_ns.setdefault(m.j_ns[row], {}).setdefault(
-                m.j_queue[row], []
-            ).append(row)
-        for queues in by_ns.values():
-            for qname, lst in queues.items():
-                lst.sort(key=lambda r: jkeys[r])
-
-        namespaces = sorted(by_ns.keys(), key=_cmp_key(ns_order))
+        rows_arr = np.asarray(rows, np.int64)
+        nsc = m.j_ns_code[rows_arr]
+        qc = m.j_queue_code[rows_arr]
         qinfo = self.store.queues
-        # Keys are static while ordering runs, so the reference's
-        # "best queue per pop" merge reduces to: per namespace, drain
-        # queues in sorted order (ties keep first-appearance order, as
-        # the linear best_q scan did); one job per namespace per round.
-        per_ns: List[List[int]] = []
-        for ns in namespaces:
-            qnames = [q for q in by_ns[ns] if not overused(qinfo[q])]
-            qnames.sort(
-                key=_cmp_key(lambda a, b: queue_order(qinfo[a], qinfo[b]))
-            )
-            per_ns.append(
-                [row for q in qnames for row in by_ns[ns][q]]
-            )
-        ordered: List[_JobProxy] = []
-        j_uid = m.j_uid
-        j_queue = m.j_queue
-        for i in range(max((len(s) for s in per_ns), default=0)):
-            for ns_i, seq in enumerate(per_ns):
-                if i < len(seq):
-                    row = seq[i]
-                    ordered.append(_JobProxy(
-                        row, j_uid[row], namespaces[ns_i], j_queue[row],
-                        jkeys[row]
-                    ))
-        return ordered
 
-    def _pending_rows(self, ordered: List[_JobProxy]):
+        # Rank the few distinct namespaces/queues with the comparator
+        # closures (the per-JOB work stays in numpy).
+        # First-appearance order feeds the stable sorts so comparator ties
+        # (if any plugin comparator were non-total) resolve exactly as the
+        # object path's insertion-ordered scans did.
+        ns_codes, ns_first = np.unique(nsc, return_index=True)
+        ns_codes = ns_codes[np.argsort(ns_first, kind="stable")]
+        ns_names = [m.ns_names.items[c] for c in ns_codes.tolist()]
+        ns_sorted = sorted(ns_names, key=_cmp_key(ns_order))
+        ns_rank_of = {n: i for i, n in enumerate(ns_sorted)}
+        ns_rank_by_code = np.full(int(ns_codes.max()) + 1, -1, np.int64)
+        for c, n in zip(ns_codes.tolist(), ns_names):
+            ns_rank_by_code[c] = ns_rank_of[n]
+
+        q_codes, q_first = np.unique(qc, return_index=True)
+        q_codes = q_codes[np.argsort(q_first, kind="stable")]
+        q_names = [m.qnames.items[c] for c in q_codes.tolist()]
+        q_sorted = sorted(q_names,
+                          key=_cmp_key(lambda a, b: queue_order(qinfo[a],
+                                                                qinfo[b])))
+        q_rank_of = {n: i for i, n in enumerate(q_sorted)}
+        q_rank_by_code = np.full(int(q_codes.max()) + 1, -1, np.int64)
+        for c, n in zip(q_codes.tolist(), q_names):
+            # Overused queues drop out of this pass entirely
+            # (allocate.go:126-143).
+            q_rank_by_code[c] = -1 if overused(qinfo[n]) else q_rank_of[n]
+
+        ns_r = ns_rank_by_code[nsc]
+        q_r = q_rank_by_code[qc]
+        keep = q_r >= 0
+        rows_arr = rows_arr[keep]
+        if not len(rows_arr):
+            return []
+        ns_r = ns_r[keep]
+        q_r = q_r[keep]
+        # Within a namespace: queues in queue order, jobs by job key.
+        order1 = np.lexsort((jkeys[rows_arr], q_r, ns_r))
+        seq = rows_arr[order1]
+        ns_s = ns_r[order1]
+        # Position within the namespace group (groups are contiguous now).
+        starts = np.concatenate(([True], ns_s[1:] != ns_s[:-1]))
+        group_start = np.maximum.accumulate(
+            np.where(starts, np.arange(len(seq)), 0)
+        )
+        k = np.arange(len(seq)) - group_start
+        # Round-robin: k-th jobs of every namespace, namespaces in order.
+        final = np.lexsort((ns_s, k))
+        return seq[final].tolist()
+
+    def _pending_rows(self, ordered: List[int]):
         """Pending task rows in processing order (job-contiguous)."""
         m = self.m
         Pn = self.Pn
@@ -963,13 +1077,22 @@ class FastCycle:
         if not pending.any():
             return None
         rows_all = np.flatnonzero(pending)
+        if self.store.bind_backoff:
+            # Tasks inside their bind-failure backoff window sit out the
+            # cycle (the rate-limited errTasks queue, cache.go:627-649).
+            now = time.time()
+            ok = np.array([
+                self.store.bind_retry_ok(m.p_key[r], now)
+                for r in rows_all.tolist()
+            ])
+            rows_all = rows_all[ok]
+            if not len(rows_all):
+                return None
         jr = self.jobr[rows_all]
         # Rank of each job in the processing order.
         jrank = np.full(self.Jn + 1, -1, np.int64)
-        solve_jobs: List[int] = []
-        for p in ordered:
-            jrank[p.row] = len(solve_jobs)
-            solve_jobs.append(p.row)
+        solve_jobs: List[int] = list(ordered)
+        jrank[solve_jobs] = np.arange(len(solve_jobs))
         ranks = jrank[jr]
         keep = ranks >= 0
         rows_all = rows_all[keep]
@@ -985,8 +1108,28 @@ class FastCycle:
         )
         prio_key = -prio if prio_enabled else np.zeros_like(prio)
         create = m.p_create[rows_all]
-        uids = np.array([m.p_uid[r] for r in rows_all])
-        order = np.lexsort((uids, create, prio_key, ranks))
+        # Numeric lexsort first; the uid tie-break (session default) only
+        # matters within groups whose (rank, prio, create) triple repeats —
+        # creation timestamps are unique monotonic counters, so such groups
+        # are rare, and the 100k-element string-array build the full
+        # string lexsort needed is skipped entirely.
+        order = np.lexsort((create, prio_key, ranks))
+        rs, ps, cs = ranks[order], prio_key[order], create[order]
+        dup = np.flatnonzero(
+            (rs[1:] == rs[:-1]) & (ps[1:] == ps[:-1]) & (cs[1:] == cs[:-1])
+        )
+        if len(dup):
+            p_uid = m.p_uid
+            starts = np.flatnonzero(np.concatenate(
+                ([True], (rs[1:] != rs[:-1]) | (ps[1:] != ps[:-1])
+                 | (cs[1:] != cs[:-1]))
+            ))
+            ends = np.concatenate((starts[1:], [len(order)]))
+            for s, e in zip(starts.tolist(), ends.tolist()):
+                if e - s > 1:
+                    order[s:e] = sorted(
+                        order[s:e], key=lambda i: p_uid[rows_all[i]]
+                    )
         task_rows = rows_all[order]
         # Keep only jobs that actually have pending tasks, preserving order.
         present = np.unique(self.jobr[task_rows])
@@ -1059,7 +1202,12 @@ class FastCycle:
         nt = len(taints)
         er: List[int] = []
         ti: List[int] = []
-        for local, r in enumerate(rows):
+        # Tolerations are rare; the p_has_tol column turns the 100k-row
+        # feature walk into a scan over just the tolerating rows.
+        if not m.p_has_tol[rows].any():
+            return np.array(er, np.int64), np.array(ti, np.int64)
+        for local in np.flatnonzero(m.p_has_tol[rows]).tolist():
+            r = rows[local]
             feat = m.p_feat[r]
             if feat is None or not feat.tol:
                 continue
@@ -1087,6 +1235,7 @@ class FastCycle:
         return np.array(er, np.int64), np.array(ti, np.int64)
 
     def _solve_inputs(self, solve_jobs: List[int], task_rows: np.ndarray):
+        self._flush_aggr()
         m = self.m
         P = len(task_rows)
         # Task axis stays exact: solve_wave pads to wave multiples (the
@@ -1515,7 +1664,6 @@ class FastCycle:
 
         # Divergence guard (vectorized analog of the replay's re-check):
         # charged capacity must not exceed allocatable.
-        add = np.zeros((self.Nn, self.R), F)
         if req_gather is not None:
             # Subset the caller's full-task gather (prepared while the
             # device solve ran) down to the committed rows.
@@ -1527,7 +1675,12 @@ class FastCycle:
             v = v_all[em]
         else:
             er, si, v = m.c_req.gather(rows)
-        np.add.at(add, (nodes_c[er], si), v)
+        # bincount over flattened (node, slot) indices is several times
+        # faster than np.add.at for 200k+ scatter entries.
+        add = np.bincount(
+            nodes_c[er].astype(np.int64) * self.R + si,
+            weights=v, minlength=self.Nn * self.R,
+        ).reshape(self.Nn, self.R).astype(F)
         new_used = self.n_used + add
         over = new_used > self.n_alloc + self.eps[None, :]
         if over.any() and bool((add[over.any(axis=1)] > 0).any()):
@@ -1543,72 +1696,92 @@ class FastCycle:
         m.p_node[rows] = nodes_c
         self.n_used = new_used
         self.n_idle = self.n_idle - add
-        np.add.at(self.n_ntasks, nodes_c, 1)
+        self.n_ntasks += np.bincount(
+            nodes_c, minlength=self.Nn
+        ).astype(I)
         self.resident[rows] = True
 
         # Job counters (affects readiness for later rounds + close).
         jr = self.jobr[rows]
-        np.add.at(self.j_cnt_alloc, jr, 1)
-        np.add.at(self.j_cnt_pending, jr, -1)
+        bc = np.bincount(jr, minlength=self.Jn).astype(I)
+        self.j_cnt_alloc += bc
+        self.j_cnt_pending -= bc
         self.j_ready_base = (
             self.j_cnt_alloc + self.j_cnt_succ + self.j_cnt_empty_pending
         )
         # (er, si, v) reused from the divergence guard's gather above.
-        np.add.at(self.j_alloc_res, (jr[er], si), v)
-        np.add.at(self.j_pending_res, (jr[er], si), -v)
-        # Queue allocation (overuse gating in later rounds).
-        q_of = self.q_of_job[jr]
-        qmask = q_of >= 0
-        if qmask.any():
-            er_q = qmask[er]
-            np.add.at(self.q_alloc, (q_of[er][er_q], si[er_q]), v[er_q])
+        # The j_alloc_res/j_pending_res/q_alloc scatter updates are
+        # deferred (see _flush_aggr): later rounds and the evict
+        # machinery flush before reading.
+        if not hasattr(self, "_aggr_pending"):
+            self._aggr_pending = []
+        self._aggr_pending.append((jr[er], si, v, self.q_of_job[jr][er]))
 
         # Pod records + bind dispatch (async in the reference,
         # cache.go:536-552; here one batched dispatch).
         binder = store.binder
         bind_keys = getattr(binder, "bind_keys", None)
-        pods = store.pods
         notify = store._watchers
         n_name = m.n_name
-        p_uid = m.p_uid
+        p_pod = m.p_pod
         p_key = m.p_key
         rows_l = rows.tolist()
-        pod_l = [pods.get(p_uid[r]) for r in rows_l]
+        pod_l = [p_pod[r] for r in rows_l]
         host_l = [n_name[n] for n in nodes_c.tolist()]
-        keys = []
-        hosts = []
-        bound_pods = []
-        bound_rows = []
-        for row, pod, hostname in zip(rows_l, pod_l, host_l):
-            if pod is None:
-                continue
-            pod.node_name = hostname
-            keys.append(p_key[row])
-            hosts.append(hostname)
-            bound_pods.append(pod)
-            bound_rows.append(row)
+        # identity scan, NOT `None in pod_l`: `in` calls the dataclass
+        # __eq__ field-by-field on every element.
+        if not any(p is None for p in pod_l):
+            # Common case: every committed row has a live pod record.
+            # List comprehensions + one zip setattr walk instead of four
+            # per-pod appends (this loop runs 100k times at north-star
+            # scale).
+            for pod, hostname in zip(pod_l, host_l):
+                pod.node_name = hostname
+            keys = [p_key[r] for r in rows_l]
+            hosts = host_l
+            bound_pods = pod_l
+            bound_rows = rows_l
+        else:
+            keys = []
+            hosts = []
+            bound_pods = []
+            bound_rows = []
+            for row, pod, hostname in zip(rows_l, pod_l, host_l):
+                if pod is None:
+                    continue
+                pod.node_name = hostname
+                keys.append(p_key[row])
+                hosts.append(hostname)
+                bound_pods.append(pod)
+                bound_rows.append(row)
         from .cache.interface import BindFailure
 
-        try:
-            if bind_keys is not None:
-                bind_keys(keys, hosts)
-            else:
-                failed = []
-                for pod, hostname, key in zip(bound_pods, hosts, keys):
-                    try:
-                        binder.bind(pod, hostname)
-                    except BindFailure:
-                        failed.append(key)
-                if failed:
-                    raise BindFailure(failed)
-        except BindFailure as bf:
-            self._revert_failed_binds(bf.failed, keys, bound_rows,
-                                      bound_pods)
-            failed = set(bf.failed)
-            bound_pods = [
-                pod for pod, key in zip(bound_pods, keys)
-                if key not in failed
-            ]
+        if getattr(store, "async_bind", False):
+            # Async dispatch (cache.go:536-552): the cycle only pays the
+            # queue append; failures surface via drain_bind_failures at
+            # the next cycle's start and re-enter Pending with backoff.
+            store.dispatch_binds(keys, hosts, bound_pods)
+        else:
+            try:
+                if bind_keys is not None:
+                    bind_keys(keys, hosts)
+                else:
+                    failed = []
+                    for pod, hostname, key in zip(bound_pods, hosts, keys):
+                        try:
+                            binder.bind(pod, hostname)
+                        except BindFailure:
+                            failed.append(key)
+                    if failed:
+                        raise BindFailure(failed)
+            except BindFailure as bf:
+                self._revert_failed_binds(bf.failed, keys, bound_rows,
+                                          bound_pods)
+                failed = set(bf.failed)
+                bound_pods = [
+                    pod for pod, key in zip(bound_pods, keys)
+                    if key not in failed
+                ]
         if notify:
             for pod in bound_pods:
                 store._notify("Pod", "bind", pod)
@@ -1631,6 +1804,7 @@ class FastCycle:
         plugin's session-close conditions and the job's lifecycle
         policies handling a persistently failing member."""
         m = self.m
+        self._flush_aggr()
         failed = set(failed_keys)
         idx = [i for i, k in enumerate(keys) if k in failed]
         if not idx:
@@ -1842,7 +2016,7 @@ class FastCycle:
                 msg = self._gang_message(row, row in fit_failed)
                 unschedulable_jobs += 1
                 unschedulable_rows.add(row)
-                pg = store.pod_groups.get(m.j_uid[row])
+                pg = self.j_pgs[row]
                 if pg is not None:
                     # Condition refresh throttling (job_updater.go
                     # isPodGroupConditionsUpdated): an existing
@@ -1873,6 +2047,10 @@ class FastCycle:
                         ))
                         pg.status.conditions = conditions
                         cond_changed_rows.add(row)
+                        store.record_event(
+                            f"PodGroup/{pg.namespace}/{pg.name}",
+                            "Unschedulable", msg,
+                        )
                 metrics.unschedule_task_count.set(
                     int(m.j_minav[row] - self.j_ready_base[row]),
                     job_name=m.j_uid[row].split("/")[-1],
@@ -1886,7 +2064,7 @@ class FastCycle:
         # (framework.go jobStatus + job_updater.go
         # isPodGroupStatusUpdated: only changed statuses are written).
         for row in self.session_jobs:
-            pg = store.pod_groups.get(m.j_uid[row])
+            pg = self.j_pgs[row]
             if pg is None:
                 continue
             status = pg.status

@@ -261,6 +261,10 @@ class EvictState:
             except Exception:
                 pass
             store.evictor.evict(pod)
+            store.record_event(
+                f"Pod/{pod.namespace}/{pod.name}", "Evict",
+                "evicted by scheduler (preempt/reclaim)",
+            )
             if store._watchers:
                 store._notify("Pod", "evict", pod)
         if self.evicted_rows:

@@ -84,12 +84,24 @@ from .allocate import (
 from .resreq import less_equal
 from .scoring import ScoreWeights, node_score
 
-DEFAULT_WAVE = 1024
+import os as _os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(_os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+DEFAULT_WAVE = _env_int("VOLCANO_TPU_WAVE", 2048)
 # cnt0 tables above this element count ship as sparse entries and are
 # scattered on device (tests lower it to force the sparse path).
 CNT0_SPARSE_MIN = 4_000_000
-TOPK = 256  # diversification breadth: k-th contender takes its k-th best node
-SUBROUNDS = 16  # in-attempt re-walk rounds for conflict losers
+# diversification breadth: k-th contender takes its k-th best node
+TOPK = _env_int("VOLCANO_TPU_TOPK", 256)
+# in-attempt re-walk rounds for conflict losers
+SUBROUNDS = _env_int("VOLCANO_TPU_SUBROUNDS", 16)
 
 
 class SolveProfiles(NamedTuple):
@@ -960,9 +972,18 @@ def _solve_wave(
     q_alloc = state.q_alloc.at[queue_p[tjob]].add(-rsub)
     assigned = jnp.where(discard_t, -1, state.assigned)
 
+    pipelined = state.pipelined
+    if N <= 32000:
+        # Narrow the [P] result vectors on device: the device->host fetch
+        # of `assigned` dominates transfer time at north-star scale
+        # (100k x 4B through a ~3.5 MB/s tunnel), and node indices fit
+        # int16 whenever N does.  Hosts consume them as indices, where
+        # numpy upcasts transparently.
+        assigned = assigned.astype(jnp.int16)
+        pipelined = pipelined.astype(jnp.int16)
     return AllocResult(
         assigned=assigned,
-        pipelined=state.pipelined,
+        pipelined=pipelined,
         never_ready=never_ready_p[:J],
         fit_failed=state.fit_failed[:J],
         idle=idle,

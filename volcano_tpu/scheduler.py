@@ -152,6 +152,12 @@ class Scheduler:
         action_names = [
             a.strip() for a in conf.actions.split(",") if a.strip()
         ]
+        # Queued async-bind failures re-enter Pending (with backoff) before
+        # the cycle snapshots — on this thread, for BOTH the fast path and
+        # the object-session fallback (cache.go errTasks resync).
+        drain = getattr(self.store, "drain_bind_failures", None)
+        if drain is not None:
+            drain()
         with metrics.e2e_timer(), _device_trace():
             if self._fastpath_enabled():
                 enable_compilation_cache()

@@ -19,7 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
-from .api import Node, Queue
+from .api import GROUP_NAME_ANNOTATION, Node, Queue
 from .cache import ClusterStore
 from .controllers import Action, Command, ControllerManager, Job, LifecyclePolicy, TaskSpec
 from .metrics import metrics
@@ -116,6 +116,14 @@ class Service:
         lease_path: Optional[str] = None,
     ):
         self.store = store or ClusterStore()
+        # Production binds dispatch on the background worker with
+        # errTasks-style failure backoff (cache.go:536-552, 627-649);
+        # opt out with VOLCANO_TPU_ASYNC_BIND=0 (tests that assert binds
+        # synchronously construct their own ClusterStore instead).
+        import os as _os
+
+        if _os.environ.get("VOLCANO_TPU_ASYNC_BIND", "1") != "0":
+            self.store.async_bind = True
         self.state_path = state_path
         self.checkpoint_period = checkpoint_period
         if state_path:
@@ -202,6 +210,8 @@ class Service:
     def stop(self):
         self._stop.set()
         self.scheduler.stop()
+        self.store.flush_binds(timeout=5)
+        self.store.close()
         if self.elector is not None:
             self.elector.stop()
         if self.state_path and self._leading.is_set():
@@ -262,13 +272,46 @@ class Service:
                         ]
                         self._json(200, jobs)
                     elif parts[:2] == ["apis", "jobs"] and len(parts) == 4:
-                        job = service.store.batch_jobs.get(
-                            f"{parts[2]}/{parts[3]}"
-                        )
+                        jk = f"{parts[2]}/{parts[3]}"
+                        job = service.store.batch_jobs.get(jk)
                         if job is None:
                             self._json(404, {"error": "not found"})
                         else:
-                            self._json(200, job_to_dict(job))
+                            d = job_to_dict(job)
+                            # Per-object event trails (Scheduled / Evict /
+                            # FailedScheduling / Unschedulable — the
+                            # reference's kubectl-visible Events,
+                            # cache.go:487,540,584,790).
+                            evs = {}
+                            st = service.store
+                            pgnames = set()
+                            # Snapshot under the store lock: scheduler
+                            # threads mutate st.pods concurrently.
+                            with st._lock:
+                                job_pods = [
+                                    p for p in st.pods.values()
+                                    if getattr(p, "owner_job", None) == jk
+                                ]
+                            for p in job_pods:
+                                trail = st.events_for(
+                                    f"Pod/{p.namespace}/{p.name}"
+                                )
+                                if trail:
+                                    evs[f"Pod/{p.name}"] = trail
+                                g = (p.annotations or {}).get(
+                                    GROUP_NAME_ANNOTATION
+                                )
+                                if g:
+                                    pgnames.add(g)
+                            for g in pgnames:
+                                trail = st.events_for(
+                                    f"PodGroup/{parts[2]}/{g}"
+                                )
+                                if trail:
+                                    evs[f"PodGroup/{g}"] = trail
+                            if evs:
+                                d["events"] = evs
+                            self._json(200, d)
                     elif parts[:2] == ["apis", "queues"]:
                         self._json(
                             200,
