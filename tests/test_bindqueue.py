@@ -56,7 +56,10 @@ def test_async_bind_failure_reverts_with_backoff(monkeypatch):
     assert store.flush_binds(timeout=10)
     assert len(store.binder.binds) == 24
     assert all(p.node_name for p in store.pods.values())
-    # Successful rebind clears the backoff state.
+    # Successful rebind clears the backoff state at the next cycle's
+    # drain (clears are queued for the cycle thread, which owns
+    # bind_backoff — store._on_bind_success).
+    sched.run_once()
     assert not store.bind_backoff
 
 
@@ -111,3 +114,53 @@ tiers:
     key = evicted[0]
     evs = store.events_for(f"Pod/{key}")
     assert any(e["reason"] == "Evict" for e in evs)
+
+
+def test_indeterminate_batch_exception_redrives_per_key():
+    """A non-BindFailure exception from bind_keys must not fail the whole
+    batch: binds that already landed would be re-queued and later re-bound
+    (possibly to a different node).  The dispatcher re-drives per key
+    instead (bindqueue.py worker)."""
+    store = synthetic_cluster(n_nodes=8, n_pods=16, gang_size=1)
+    store.async_bind = True
+    orig = store.binder.bind_keys
+    state = {"left": 1}
+
+    def broken(keys, hosts):
+        if state["left"] > 0:
+            state["left"] -= 1
+            half = len(keys) // 2
+            orig(list(keys[:half]), list(hosts[:half]))
+            raise RuntimeError("transport blew up mid-batch")
+        orig(keys, hosts)
+
+    store.binder.bind_keys = broken
+    sched = Scheduler(store)
+    sched.run_once()
+    assert store.flush_binds(timeout=10)
+    # Per-key re-drive landed every bind exactly where the solver put it:
+    # no pod re-entered Pending, no backoff, all 16 bound.
+    assert len(store.binder.binds) == 16
+    sched.run_once()
+    assert not store.bind_backoff
+    assert all(p.node_name for p in store.pods.values())
+
+
+def test_deleted_pod_prunes_backoff_entry(monkeypatch):
+    from volcano_tpu.cache import bindqueue
+
+    monkeypatch.setattr(bindqueue, "BACKOFF_BASE", 60.0)
+    store = synthetic_cluster(n_nodes=8, n_pods=8, gang_size=1)
+    store.async_bind = True
+    _flaky(store, fail_times=1)
+    sched = Scheduler(store)
+    sched.run_once()
+    assert store.flush_binds(timeout=10)
+    sched.run_once()  # drain failures -> backoff entries
+    assert store.bind_backoff
+    key = next(iter(store.bind_backoff))
+    ns, name = key.split("/", 1)
+    pod = next(p for p in store.pods.values()
+               if p.namespace == ns and p.name == name)
+    store.delete_pod(pod)
+    assert key not in store.bind_backoff

@@ -211,3 +211,31 @@ def test_enqueue_transition_survives_failed_cycle(monkeypatch):
         f"stranded transition never persisted: {phases}"
     )
     assert not store._phase_dirty_uids
+
+
+def test_enqueue_accept_all_eps_boundary_falls_back_to_walk():
+    """When pending groups' MinResources total exactly consumes the
+    overcommitted idle budget, the sequential walk (enqueue.go:98-101)
+    accepts groups until idle goes empty and rejects everything after —
+    including MinResources-nil groups that charge nothing.  The
+    accept-all shortcut must not diverge at this eps boundary (it
+    requires a non-empty residual before accepting, else falls through
+    to the walk)."""
+    from volcano_tpu.api import Node, PodGroup
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    store.add_node(Node(name="n0", allocatable={"cpu": "10",
+                                                "memory": "10Gi"}))
+    # "a" consumes the whole 1.2x-overcommitted idle (12 cpu / 12Gi).
+    store.add_pod_group(PodGroup(name="a", min_member=1,
+                                 min_resources={"cpu": "12",
+                                                "memory": "12Gi"}))
+    store.add_pod_group(PodGroup(name="b", min_member=1))
+    Scheduler(store).run_once()
+    phases = {pg.name: pg.status.phase
+              for pg in store.pod_groups.values()}
+    assert phases["a"] == "Inqueue"
+    # The walk broke once idle went empty, so "b" never got examined.
+    assert phases["b"] == "Pending", phases

@@ -90,24 +90,36 @@ class BindDispatcher:
                     return
                 keys, hosts, pods = self._q.pop(0)
             failed: List[str] = []
-            try:
-                bind_keys = getattr(self._binder, "bind_keys", None)
-                if bind_keys is not None:
+            bind_keys = getattr(self._binder, "bind_keys", None)
+            batch_ok = False
+            if bind_keys is not None:
+                try:
+                    bind_keys(list(keys), list(hosts))
+                    batch_ok = True
+                except BindFailure as bf:
+                    failed = list(bf.failed)
+                    batch_ok = True
+                except Exception:
+                    # Indeterminate: some binds may have taken effect.
+                    # Failing the whole batch would re-queue pods that
+                    # are already bound and later re-bind them — possibly
+                    # to a different node — with no unbind of the first
+                    # placement.  Re-drive per key instead: Bind is
+                    # idempotent (key -> node assignment), so repeating a
+                    # key that already landed is a no-op, and each key
+                    # gets a definite outcome.
+                    log.exception(
+                        "bind batch indeterminate; retrying per key"
+                    )
+            if not batch_ok:
+                for pod, host, key in zip(pods, hosts, keys):
                     try:
-                        bind_keys(list(keys), list(hosts))
-                    except BindFailure as bf:
-                        failed = list(bf.failed)
-                else:
-                    for pod, host, key in zip(pods, hosts, keys):
-                        try:
-                            self._binder.bind(pod, host)
-                        except BindFailure:
-                            failed.append(key)
-            except Exception:
-                # A binder that throws something other than BindFailure
-                # fails the whole batch; the resync path retries.
-                log.exception("bind batch failed")
-                failed = list(keys)
+                        self._binder.bind(pod, host)
+                    except BindFailure:
+                        failed.append(key)
+                    except Exception:
+                        log.exception("bind failed for %s", key)
+                        failed.append(key)
             if failed:
                 try:
                     # Hand the pod objects back with the keys so the

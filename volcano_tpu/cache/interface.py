@@ -16,6 +16,11 @@ from ..api import JobInfo, PodGroup, TaskInfo
 
 
 class Binder(Protocol):
+    """``bind`` must be idempotent for a (task, hostname) pair: the
+    dispatcher re-drives individual binds after an indeterminate batch
+    failure, so a key that already landed may be bound again to the
+    same host (bindqueue.py worker)."""
+
     def bind(self, task: TaskInfo, hostname: str) -> None: ...
 
 

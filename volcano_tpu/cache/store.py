@@ -109,9 +109,16 @@ class ClusterStore:
         self.async_bind = False
         self._bind_dispatcher = None
         self._bind_fail_lock = threading.Lock()
+        # Successful binds whose backoff entries the cycle thread should
+        # clear at the next drain (tracked only while bind_backoff is
+        # non-empty, so steady-state binds pay nothing).
+        self._succeeded_bind_keys: List[str] = []
         # [(key, pod), ...] reported by the dispatcher thread.
         self._failed_bind_keys: List[tuple] = []
-        # "ns/name" -> (consecutive fails, retry-not-before timestamp).
+        # "ns/name" -> (consecutive fails, retry-not-before ts, pod uid).
+        # Cycle-thread-owned: mutated only by drain_bind_failures and
+        # delete_pod (both under _lock); the dispatcher thread queues
+        # clears via _succeeded_bind_keys instead of touching it.
         self.bind_backoff: Dict[str, tuple] = {}
 
         # Per-object user-visible event trail (the reference records
@@ -194,12 +201,13 @@ class ClusterStore:
             self._failed_bind_keys.extend(failed_pairs)
 
     def _on_bind_success(self, keys: List[str], hosts: List[str]) -> None:
-        """Dispatcher-thread hook: record Scheduled events (cache.go:540)
-        and clear any backoff the task had accumulated — all off the
-        scheduling cycle's critical path."""
+        """Dispatcher-thread hook: record Scheduled events (cache.go:540).
+        Backoff clears are queued for the cycle thread (``bind_backoff``
+        is cycle-thread-owned; popping it here could lose a concurrent
+        ``drain_bind_failures`` increment)."""
         if self.bind_backoff:
-            for key in keys:
-                self.bind_backoff.pop(key, None)
+            with self._bind_fail_lock:
+                self._succeeded_bind_keys.extend(keys)
         for key, host in zip(keys, hosts):
             self.record_event(f"Pod/{key}", "Scheduled",
                               f"bound to {host}")
@@ -216,6 +224,12 @@ class ClusterStore:
         with self._bind_fail_lock:
             failed = self._failed_bind_keys
             self._failed_bind_keys = []
+            succeeded = self._succeeded_bind_keys
+            self._succeeded_bind_keys = []
+        if succeeded and self.bind_backoff:
+            with self._lock:
+                for key in succeeded:
+                    self.bind_backoff.pop(key, None)
         if not failed:
             return 0
         now = _time.time()
@@ -227,10 +241,10 @@ class ClusterStore:
                 if (pod is None or self.pods.get(pod.uid) is not pod
                         or pod.node_name is None):
                     continue
-                fails, _ = self.bind_backoff.get(key, (0, 0.0))
+                fails, _, _ = self.bind_backoff.get(key, (0, 0.0, ""))
                 fails += 1
                 delay = min(BACKOFF_BASE * (2 ** (fails - 1)), BACKOFF_MAX)
-                self.bind_backoff[key] = (fails, now + delay)
+                self.bind_backoff[key] = (fails, now + delay, pod.uid)
                 pod.node_name = None
                 self.mirror.set_pod_state(
                     pod.uid, int(TaskStatus.Pending), -1
@@ -247,15 +261,6 @@ class ClusterStore:
                 self._notify("Pod", "update", pod)
                 n += 1
         return n
-
-    def bind_retry_ok(self, key: str, now: float) -> bool:
-        """True when the task is clear of its bind-failure backoff."""
-        ent = self.bind_backoff.get(key)
-        if ent is None:
-            return True
-        if now >= ent[1]:
-            return True
-        return False
 
     # ----------------------------------------------- lazy object model
 
@@ -399,6 +404,11 @@ class ClusterStore:
             old = self.pods.pop(pod.uid, None)
             if old is not None:
                 self._remove_task(old)
+            if self.bind_backoff:
+                # Deleted pods must not pin backoff entries forever.
+                self.bind_backoff.pop(
+                    f"{pod.namespace}/{pod.name}", None
+                )
             self.mirror.remove_pod(pod.uid)
             self.mirror.maybe_compact()
             self._notify("Pod", "delete", pod)

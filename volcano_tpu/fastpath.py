@@ -739,7 +739,16 @@ class FastCycle:
                         np.sum(np.stack(vecs), axis=0) if vecs
                         else np.zeros(self.R, F)
                     )
-                    if _vec_le(total, idle, eps, scalar_slot):
+                    # Strict fit with slack: the sequential walk below
+                    # stops as soon as idle goes empty mid-walk, which
+                    # rejects every later group (even MinResources-nil
+                    # groups that charge nothing, enqueue.go:98-101).
+                    # _vec_le alone tolerates total ≈ idle within eps,
+                    # where the walk and the shortcut would diverge —
+                    # require a non-empty residual so every prefix of
+                    # charges provably leaves a non-empty idle.
+                    if (_vec_le(total, idle, eps, scalar_slot)
+                            and not _vec_is_empty(idle - total, eps)):
                         for lst in jobs_map.values():
                             for row in lst:
                                 pg = row_pg[row]
@@ -1080,12 +1089,18 @@ class FastCycle:
         if self.store.bind_backoff:
             # Tasks inside their bind-failure backoff window sit out the
             # cycle (the rate-limited errTasks queue, cache.go:627-649).
+            # O(backed-off) host work, not O(pending): each entry carries
+            # its pod uid, mapped to a current row via the mirror.
             now = time.time()
-            ok = np.array([
-                self.store.bind_retry_ok(m.p_key[r], now)
-                for r in rows_all.tolist()
-            ])
-            rows_all = rows_all[ok]
+            blocked = [
+                m.p_row.get(uid, -1)
+                for _, nb, uid in self.store.bind_backoff.values()
+                if now < nb
+            ]
+            if blocked:
+                rows_all = rows_all[
+                    ~np.isin(rows_all, np.asarray(blocked, np.int64))
+                ]
             if not len(rows_all):
                 return None
         jr = self.jobr[rows_all]
