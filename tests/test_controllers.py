@@ -526,3 +526,206 @@ def test_lifecycle_policy_event_action_matrix(event, action,
     if action == "RestartTask":
         # Sync semantics: the failure is recorded (not restarted).
         assert store.batch_jobs["default/mx"].status.failed == 1
+
+
+def test_svc_network_policy_lifecycle():
+    """svc creates a job-scoped ingress-isolation record (the
+    NetworkPolicy of svc.go:252-299) and cleans it up with the job;
+    --disable-network-policy suppresses it (svc.go:67)."""
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="np",
+        min_available=1,
+        tasks=[TaskSpec(name="w", replicas=1,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        plugins={"svc": []},
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+    pol = store.network_policies["default/np"]
+    assert pol["pod_selector"]["volcano-tpu/job-name"] == "np"
+    assert pol["ingress_from"] == [pol["pod_selector"]]
+    assert pol["policy_types"] == ["Ingress"]
+
+    store.delete_batch_job("default/np")
+    converge(cm, sched, sim, cycles=6)
+    assert "default/np" not in store.network_policies
+
+    # Disabled via plugin argument.
+    job2 = Job(
+        name="np2",
+        min_available=1,
+        tasks=[TaskSpec(name="w", replicas=1,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        plugins={"svc": ["--disable-network-policy"]},
+    )
+    store.add_batch_job(job2)
+    converge(cm, sched, sim)
+    assert "default/np2" not in store.network_policies
+
+
+def test_job_volume_lifecycle():
+    """Job with a VolumeClaim spec: the controller creates the claim at
+    initiate (createJobIOIfNotExist, job_controller_actions.go:394-460),
+    pods mount it, the scheduler allocates+binds it with the pod, and
+    deleting the job reaps the controller-created claim (owner refs)."""
+    from volcano_tpu.controllers import VolumeSpec
+
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="vol",
+        min_available=2,
+        tasks=[TaskSpec(name="w", replicas=2,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        volumes=[VolumeSpec(mount_path="/data",
+                            volume_claim={"storage": "10Gi"})],
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+
+    # Claim generated + created + recorded in ControlledResources.
+    gen_name = job.volumes[0].volume_claim_name
+    assert gen_name.startswith("vol-volume-")
+    assert f"volume-pvc-{gen_name}" in job.status.controlled_resources
+    rec = store.pvcs[f"default/{gen_name}"]
+    assert rec["owner_job"] == "default/vol"
+    assert rec["spec"] == {"storage": "10Gi"}
+
+    # Pods mount the claim and are bound; the claim bound with them, on
+    # the node the scheduler picked.
+    pods = [p for p in store.pods.values() if p.owner_job == "default/vol"]
+    assert len(pods) == 2
+    assert all(p.volumes == [(gen_name, "/data")] for p in pods)
+    assert all(p.node_name for p in pods)
+    assert rec["phase"] == "Bound"
+    assert rec["node"] in {p.node_name for p in pods}
+
+    # Job deletion reaps the owned claim.
+    store.delete_batch_job("default/vol")
+    converge(cm, sched, sim, cycles=6)
+    assert f"default/{gen_name}" not in store.pvcs
+
+
+def test_job_missing_named_claim_gates_pods():
+    """A named claim that doesn't exist keeps the job Pending — no
+    PodGroup, no pods — until the claim appears (the reference returns
+    an error from initiateJob: 'pvc ... is not found, the job will be in
+    the Pending state until the PVC is created')."""
+    from volcano_tpu.controllers import VolumeSpec
+
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="nv",
+        min_available=1,
+        tasks=[TaskSpec(name="w", replicas=1,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        volumes=[VolumeSpec(mount_path="/data",
+                            volume_claim_name="user-data")],
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+    assert "default/nv" not in store.pod_groups
+    assert not [p for p in store.pods.values()
+                if p.owner_job == "default/nv"]
+    assert job.status.state.phase == JobPhase.Pending.value
+    evs = store.events_for("Job/default/nv")
+    assert any(e["reason"] == "PVCNotFound" for e in evs)
+
+    # The user creates the claim: the job converges to Running.
+    store.put_pvc("default", "user-data", {"storage": "5Gi"})
+    converge(cm, sched, sim)
+    pods = [p for p in store.pods.values() if p.owner_job == "default/nv"]
+    assert pods and all(p.node_name for p in pods)
+    assert store.pvcs["default/user-data"]["phase"] == "Bound"
+
+    # Deleting the job must NOT reap a user-created claim (no owner ref).
+    store.delete_batch_job("default/nv")
+    converge(cm, sched, sim, cycles=6)
+    assert "default/user-data" in store.pvcs
+
+
+def test_volume_admission_rules():
+    from volcano_tpu.controllers import VolumeSpec
+    from volcano_tpu.webhooks.admission import (AdmissionError,
+                                                validate_job_create)
+
+    store, _, _, _ = make_env()
+
+    def check(volumes, frag):
+        job = Job(name="adm", min_available=1,
+                  tasks=[TaskSpec(name="w", replicas=1,
+                                  containers=[{"cpu": "1"}])],
+                  volumes=volumes)
+        with pytest.raises(AdmissionError) as ei:
+            validate_job_create(job, store)
+        assert frag in str(ei.value)
+
+    check([VolumeSpec(mount_path="")], "mountPath is required")
+    check([VolumeSpec(mount_path="/d", volume_claim={"storage": "1Gi"}),
+           VolumeSpec(mount_path="/d", volume_claim={"storage": "1Gi"})],
+          "duplicated mountPath")
+    check([VolumeSpec(mount_path="/d")],
+          "either volumeClaim or volumeClaimName")
+    check([VolumeSpec(mount_path="/d", volume_claim_name="x",
+                      volume_claim={"storage": "1Gi"})], "conflict")
+    check([VolumeSpec(mount_path="/d", volume_claim_name="Bad_Name!")],
+          "invalid volumeClaimName")
+    # Valid spec admits.
+    ok = Job(name="okv", min_available=1,
+             tasks=[TaskSpec(name="w", replicas=1,
+                             containers=[{"cpu": "1"}])],
+             volumes=[VolumeSpec(mount_path="/d",
+                                 volume_claim={"storage": "1Gi"})])
+    validate_job_create(ok, store)
+
+
+def test_vanished_controller_pvc_recreated():
+    """A controller-created claim that vanishes (out-of-band delete /
+    store restore) is recreated from the retained volumeClaim spec
+    instead of wedging the job Pending."""
+    from volcano_tpu.controllers import VolumeSpec
+
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="rv",
+        min_available=1,
+        tasks=[TaskSpec(name="w", replicas=1,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        volumes=[VolumeSpec(mount_path="/data",
+                            volume_claim={"storage": "2Gi"})],
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+    name = job.volumes[0].volume_claim_name
+    assert store.pvcs[f"default/{name}"]["phase"] == "Bound"
+
+    store.delete_pvc("default", name)
+    # Trigger a resync (scale keeps spec valid; any job event works).
+    store.update_batch_job(job)
+    converge(cm, sched, sim)
+    rec = store.pvcs.get(f"default/{name}")
+    assert rec is not None and rec["spec"] == {"storage": "2Gi"}
+
+
+def test_invalid_volume_flags_job_not_phantom_claim():
+    """Raw (admission-bypassing) submission with neither volumeClaim nor
+    volumeClaimName: the job is flagged InvalidVolume and gated — no
+    generated name, no misleading PVCNotFound."""
+    from volcano_tpu.controllers import VolumeSpec
+
+    store, cm, sched, sim = make_env()
+    job = Job(
+        name="iv",
+        min_available=1,
+        tasks=[TaskSpec(name="w", replicas=1,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        volumes=[VolumeSpec(mount_path="/data")],
+    )
+    store.add_batch_job(job)
+    converge(cm, sched, sim)
+    assert not [p for p in store.pods.values()
+                if p.owner_job == "default/iv"]
+    assert job.volumes[0].volume_claim_name == ""
+    evs = store.events_for("Job/default/iv")
+    assert any(e["reason"] == "InvalidVolume" for e in evs)
+    assert not any(e["reason"] == "PVCNotFound" for e in evs)

@@ -239,3 +239,47 @@ def test_enqueue_accept_all_eps_boundary_falls_back_to_walk():
     assert phases["a"] == "Inqueue"
     # The walk broke once idle went empty, so "b" never got examined.
     assert phases["b"] == "Pending", phases
+
+
+def test_fastpath_volume_gate_and_revert():
+    """Fast-path commit runs claims through the volume binder before the
+    pod bind dispatches: an existing claim binds with the pod; a missing
+    claim reverts exactly that pod to Pending (statement.go allocate->
+    AllocateVolumes, commit->BindVolumes semantics)."""
+    from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    store.add_node(Node(name="n0", allocatable={"cpu": "8",
+                                                "memory": "16Gi"}))
+    store.put_pvc("default", "good-claim", {"storage": "1Gi"})
+    store.add_pod_group(PodGroup(name="g", min_member=1))
+    store.add_pod_group(PodGroup(name="h", min_member=1))
+    store.add_pod(Pod(
+        name="with-claim",
+        containers=[{"cpu": "1", "memory": "1Gi"}],
+        annotations={GROUP_NAME_ANNOTATION: "g"},
+        volumes=[("good-claim", "/data")],
+    ))
+    store.add_pod(Pod(
+        name="no-claim",
+        containers=[{"cpu": "1", "memory": "1Gi"}],
+        annotations={GROUP_NAME_ANNOTATION: "h"},
+        volumes=[("vanished", "/data")],
+    ))
+    Scheduler(store).run_once()
+
+    by_name = {p.name: p for p in store.pods.values()}
+    assert by_name["with-claim"].node_name == "n0"
+    assert store.pvcs["default/good-claim"]["phase"] == "Bound"
+    assert store.pvcs["default/good-claim"]["node"] == "n0"
+    # The claimless pod reverted: not bound, not dispatched to the binder.
+    assert by_name["no-claim"].node_name is None
+    assert "default/no-claim" not in store.binder.binds
+    evs = store.events_for("Pod/default/no-claim")
+    assert any(e["reason"] == "FailedScheduling"
+               and "vanished" in e["message"] for e in evs)
+    # Node accounting reverted with it: only one pod's worth used.
+    ni = store.nodes["n0"]
+    assert int(ni.used.milli_cpu) == 1000

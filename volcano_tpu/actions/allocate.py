@@ -28,6 +28,7 @@ import numpy as np
 from ..api import (FitError, FitErrors, JobInfo, PodGroupPhase,
                    Resource, TaskInfo, TaskStatus)
 from ..arrays import ResourceSlots, encode_affinity, encode_cluster
+from ..cache.interface import VolumeBindFailure
 from ..framework.arguments import get_action_args
 from ..metrics import metrics
 from ..utils.priority_queue import PriorityQueue
@@ -388,7 +389,15 @@ class AllocateAction:
                         "skipping", task.name, node_name,
                     )
                     continue
-                ssn.allocate_task(task, node_name)
+                try:
+                    ssn.allocate_task(task, node_name)
+                except VolumeBindFailure as e:
+                    # Claim can't be allocated on the picked node: skip
+                    # the task this cycle (allocate.go:226 logs the
+                    # failed stmt.Allocate and moves on).
+                    log.error("volume allocation failed for %s: %s",
+                              task.name, e)
+                    continue
                 progress = True
             elif pipe_idx >= 0:
                 node_name = maps.node_names[pipe_idx]

@@ -105,6 +105,10 @@ class Pod:
     # fewer pods of this pod's own job/PodGroup.
     topology_spread: List[Tuple[str, int]] = field(default_factory=list)
     env: Dict[str, str] = field(default_factory=dict)
+    # (claim_name, mount_path) pairs wired by the job controller from the
+    # Job's VolumeSpecs (job_controller_util.go:56-78); the volume binder
+    # gates the pod's bind on these claims.
+    volumes: List[Tuple[str, str]] = field(default_factory=list)
     exit_code: int = 0
     creation_timestamp: float = 0.0
     # Batch-job bookkeeping (set by the job controller):

@@ -40,6 +40,13 @@ class VolumeBinder(Protocol):
     def bind_volumes(self, task: TaskInfo) -> None: ...
 
 
+class VolumeBindFailure(Exception):
+    """Raised by a volume binder when a task's claims cannot be
+    allocated/bound (missing claim, conflicting node).  The commit path
+    treats the task like a failed bind: it reverts to Pending and
+    retries next cycle."""
+
+
 class BindFailure(Exception):
     """Raised by a binder when some binds could not be dispatched.
 

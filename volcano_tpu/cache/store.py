@@ -86,11 +86,21 @@ class ClusterStore:
         self.config_maps: Dict[str, Dict[str, str]] = {}  # ns/name -> data
         self.secrets: Dict[str, Dict[str, bytes]] = {}  # ns/name -> data
         self.services: Dict[str, Dict[str, object]] = {}  # ns/name -> spec
+        # ns/name -> ingress-isolation spec (NetworkPolicy analog).
+        self.network_policies: Dict[str, Dict[str, object]] = {}
+        # ns/name -> persistent-volume-claim record
+        # {"spec", "phase" Pending|Bound, "node", "owner_job"} — the PVC
+        # store the job controller creates into (initiateJob PVCs,
+        # job_controller_actions.go:394-531) and the volume binder
+        # allocates/binds against (cache.go:557-564).
+        self.pvcs: Dict[str, Dict[str, object]] = {}
 
         self.binder: Binder = binder or FakeBinder()
         self.evictor: Evictor = evictor or FakeEvictor()
         self.status_updater: StatusUpdater = status_updater or FakeStatusUpdater()
-        self.volume_binder: VolumeBinder = volume_binder or FakeVolumeBinder()
+        self.volume_binder: VolumeBinder = (
+            volume_binder or StoreVolumeBinder(self)
+        )
 
         # Watchers notified on spec mutations (the controllers' "informers").
         self._watchers: List[Callable[[str, str, object], None]] = []
@@ -246,6 +256,9 @@ class ClusterStore:
                 delay = min(BACKOFF_BASE * (2 ** (fails - 1)), BACKOFF_MAX)
                 self.bind_backoff[key] = (fails, now + delay, pod.uid)
                 pod.node_name = None
+                if pod.volumes:
+                    # Bind never landed: free the claims it pinned.
+                    self.release_claims_for(pod)
                 self.mirror.set_pod_state(
                     pod.uid, int(TaskStatus.Pending), -1
                 )
@@ -571,6 +584,68 @@ class ClusterStore:
         with self._lock:
             self.services.pop(f"{ns}/{name}", None)
 
+    def put_pvc(self, ns: str, name: str, spec,
+                owner_job: str = "") -> None:
+        """Create/replace a claim record (phase Pending until the volume
+        binder binds it)."""
+        with self._lock:
+            self.pvcs[f"{ns}/{name}"] = {
+                "spec": dict(spec) if spec else {},
+                "phase": "Pending",
+                "node": None,
+                "owner_job": owner_job,
+            }
+
+    def delete_pvc(self, ns: str, name: str) -> None:
+        with self._lock:
+            self.pvcs.pop(f"{ns}/{name}", None)
+
+    def release_claims_for(self, pod) -> None:
+        """Roll back a failed bind's claim state: claims this pod
+        provisioned/bound return to Pending (free to provision anywhere)
+        unless another placed pod still references them.  Without this a
+        bind failure would pin the claim to the failed node forever and
+        the pod could never re-place elsewhere."""
+        if not pod.volumes:
+            return
+        with self._lock:
+            claims = {f"{pod.namespace}/{c}" for c, _ in pod.volumes}
+            still_held = set()
+            for other in self.pods.values():
+                if (other.uid == pod.uid or not other.volumes
+                        or other.node_name is None):
+                    continue
+                for c, _ in other.volumes:
+                    k = f"{other.namespace}/{c}"
+                    if k in claims:
+                        still_held.add(k)
+            for k in claims - still_held:
+                rec = self.pvcs.get(k)
+                if rec is not None:
+                    rec["phase"] = "Pending"
+                    rec["node"] = None
+
+    def delete_pvcs_owned_by(self, job_key: str) -> int:
+        """Owner-reference cleanup: claims created by the controller for
+        a job die with the Job object (createPVC sets an owner ref,
+        job_controller_actions.go:512-531)."""
+        with self._lock:
+            doomed = [k for k, rec in self.pvcs.items()
+                      if rec.get("owner_job") == job_key]
+            for k in doomed:
+                del self.pvcs[k]
+        return len(doomed)
+
+    def put_network_policy(self, ns: str, name: str, spec) -> None:
+        """Job-scoped ingress isolation record (the NetworkPolicy the
+        reference svc plugin creates, svc.go:252-299)."""
+        with self._lock:
+            self.network_policies[f"{ns}/{name}"] = spec
+
+    def delete_network_policy(self, ns: str, name: str) -> None:
+        with self._lock:
+            self.network_policies.pop(f"{ns}/{name}", None)
+
     # -------------------------------------------------------------- snapshot
 
     def snapshot(self) -> ClusterInfo:
@@ -684,3 +759,53 @@ class ClusterStore:
 
     def task_in_store(self, uid: str) -> Optional[Pod]:
         return self.pods.get(uid)
+
+
+class StoreVolumeBinder:
+    """Volume binder against the store's claim registry (the
+    defaultVolumeBinder of cache.go:211-222, backed by ``store.pvcs``
+    instead of the upstream scheduler volume binder).
+
+    Accepts either a TaskInfo or a bare Pod (the fast path hands pods);
+    pods with no ``volumes`` cost one attribute read."""
+
+    def __init__(self, store: "ClusterStore"):
+        self._store = store
+
+    @staticmethod
+    def _pod(task):
+        return getattr(task, "pod", task)
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        from .interface import VolumeBindFailure
+
+        pod = self._pod(task)
+        with self._store._lock:
+            for claim, _mount in pod.volumes:
+                rec = self._store.pvcs.get(f"{pod.namespace}/{claim}")
+                if rec is None:
+                    raise VolumeBindFailure(
+                        f"claim {pod.namespace}/{claim} not found for "
+                        f"{pod.name}"
+                    )
+                if rec["phase"] == "Pending":
+                    # WaitForFirstConsumer analog: the claim provisions
+                    # on the node the scheduler picked.
+                    rec["node"] = hostname
+                elif rec["node"] not in (None, hostname):
+                    # Already provisioned elsewhere: node-local claims
+                    # can't follow the pod (RWO pinned to another host).
+                    raise VolumeBindFailure(
+                        f"claim {pod.namespace}/{claim} is bound to "
+                        f"{rec['node']}, pod placed on {hostname}"
+                    )
+
+    def bind_volumes(self, task) -> None:
+        pod = self._pod(task)
+        with self._store._lock:
+            for claim, _mount in pod.volumes:
+                rec = self._store.pvcs.get(f"{pod.namespace}/{claim}")
+                if rec is not None:
+                    rec["phase"] = "Bound"
+        if hasattr(task, "volume_ready"):
+            task.volume_ready = True

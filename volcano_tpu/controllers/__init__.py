@@ -19,6 +19,7 @@ from .apis import (
     LifecyclePolicy,
     Request,
     TaskSpec,
+    VolumeSpec,
 )
 from .gc import GarbageCollector
 from .job_controller import JobController, apply_policies
@@ -61,5 +62,6 @@ __all__ = [
     "QueueController",
     "Request",
     "TaskSpec",
+    "VolumeSpec",
     "apply_policies",
 ]

@@ -82,6 +82,20 @@ class LifecyclePolicy:
 
 
 @dataclass
+class VolumeSpec:
+    """A volume the job's pods mount (job.go:95-108 VolumeSpec).
+
+    Exactly one of ``volume_claim_name`` (use an existing claim) or
+    ``volume_claim`` (a claim spec the controller creates, e.g.
+    ``{"storage": "10Gi"}``) should be set — the admission validator
+    enforces the exclusivity (admit_job.go validateIO)."""
+
+    mount_path: str
+    volume_claim_name: str = ""
+    volume_claim: Optional[Dict[str, object]] = None
+
+
+@dataclass
 class TaskSpec:
     """One task group of a Job (job.go:163-178)."""
 
@@ -132,6 +146,7 @@ class Job:
     uid: str = ""
     min_available: int = 0
     tasks: List[TaskSpec] = field(default_factory=list)
+    volumes: List[VolumeSpec] = field(default_factory=list)
     policies: List[LifecyclePolicy] = field(default_factory=list)
     plugins: Dict[str, List[str]] = field(default_factory=dict)
     queue: str = "default"

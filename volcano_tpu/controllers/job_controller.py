@@ -86,6 +86,10 @@ class JobController:
     def __init__(self, store: ClusterStore):
         self.store = store
         self.queue: Deque[Request] = deque()
+        # Jobs whose sync failed on missing IO (named PVC not yet
+        # created): retried at the next reconcile pump — the analog of
+        # the reference's rate-limited workqueue requeue on syncJob error.
+        self._retry_keys: set = set()
         store.watch(self._on_store_event)
 
     # ------------------------------------------------------------- watchers
@@ -185,6 +189,14 @@ class JobController:
     # ------------------------------------------------------------- requests
 
     def process_all(self, max_iters: int = 10000) -> None:
+        if self._retry_keys:
+            retry, self._retry_keys = self._retry_keys, set()
+            for key in retry:
+                ns, name = key.split("/", 1)
+                self.queue.append(
+                    Request(namespace=ns, job_name=name,
+                            event=Event.OutOfSync.value)
+                )
         iters = 0
         while self.queue and iters < max_iters:
             req = self.queue.popleft()
@@ -271,14 +283,63 @@ class JobController:
                 out[name] = f"{int(quant)}m"
         return out
 
-    def _initiate_job(self, job: Job) -> None:
-        """+finalizer, phase Pending, PodGroup, plugins
-        (job_controller_actions.go:144-176,394-531)."""
+    def _create_job_io(self, job: Job) -> bool:
+        """PVC creation for the job's volumes (createJobIOIfNotExist,
+        job_controller_actions.go:394-460).  Returns False when a named
+        claim is missing — the job stays Pending (no PodGroup, no pods)
+        until the claim appears, exactly the reference's behavior."""
+        for vol in job.volumes:
+            name = vol.volume_claim_name
+            if not name and vol.volume_claim is None:
+                # Unvalidated submission path (raw store.add_batch_job
+                # bypasses admission): flag instead of generating a name
+                # for a claim that can never exist.
+                self.store.record_event(
+                    f"Job/{job.key}", "InvalidVolume",
+                    "either volumeClaim or volumeClaimName must be "
+                    "specified",
+                )
+                return False
+            if not name:
+                # Generate a unique claim name and persist it on the
+                # spec (GenPVCName + spec update, :404-420).
+                from ..api import new_uid
+
+                while True:
+                    name = f"{job.name}-volume-{new_uid('pvc')[-12:]}"
+                    if f"{job.namespace}/{name}" not in self.store.pvcs:
+                        break
+                vol.volume_claim_name = name
+            if f"{job.namespace}/{name}" not in self.store.pvcs:
+                if vol.volume_claim is not None:
+                    # Controller-owned claim: create it — including
+                    # recreating one that vanished after a restart or
+                    # out-of-band delete (we still hold the spec).
+                    self.store.put_pvc(job.namespace, name,
+                                       vol.volume_claim,
+                                       owner_job=job.key)
+                else:
+                    self.store.record_event(
+                        f"Job/{job.key}", "PVCNotFound",
+                        f"pvc {name} is not found, the job will be in "
+                        "the Pending state until the PVC is created",
+                    )
+                    return False
+            job.status.controlled_resources[f"volume-pvc-{name}"] = name
+        return True
+
+    def _initiate_job(self, job: Job) -> bool:
+        """+finalizer, phase Pending, PVCs, PodGroup, plugins
+        (job_controller_actions.go:144-176,394-531).  Returns False when
+        job IO isn't ready yet (missing claim): the sync is retried."""
         if "volcano-tpu/job-cleanup" not in job.finalizers:
             job.finalizers.append("volcano-tpu/job-cleanup")
         if not job.status.state.phase:
             job.status.state.phase = JobPhase.Pending.value
         job.status.min_available = job.min_available
+
+        if not self._create_job_io(job):
+            return False
 
         pg_uid = f"{job.namespace}/{job.name}"
         if pg_uid not in self.store.pod_groups:
@@ -301,6 +362,7 @@ class JobController:
                 continue
             plugin.on_job_add(job, self.store)
             job.status.controlled_resources[marker] = plugin.name
+        return True
 
     def _pod_name(self, job: Job, task, index: int) -> str:
         return f"{job.name}-{task.name}-{index}"
@@ -331,6 +393,15 @@ class JobController:
             owner_job=job.key,
             task_name=task.name,
         )
+        # Mount the job's volumes, one entry per claim (duplicate claim
+        # names collapse to the first mount, job_controller_util.go:56-78).
+        seen_claims = set()
+        for vol in job.volumes:
+            cn = vol.volume_claim_name
+            if not cn or cn in seen_claims:
+                continue
+            seen_claims.add(cn)
+            pod.volumes.append((cn, vol.mount_path))
         for plugin in self._plugins(job):
             plugin.on_pod_create(pod, job)
         return pod
@@ -340,7 +411,12 @@ class JobController:
     def sync_job(self, job: Job, update_status) -> None:
         if job.deleting:
             return
-        self._initiate_job(job)
+        if not self._initiate_job(job):
+            # Missing claim: job stays Pending, re-synced next reconcile
+            # (initiateJob error return, job_controller_actions.go:144).
+            self._retry_keys.add(job.key)
+            self.store.batch_jobs[job.key] = job
+            return
 
         pods = self._job_pods(job)
         pg = self.store.pod_groups.get(f"{job.namespace}/{job.name}")
@@ -424,5 +500,8 @@ class JobController:
         for pod in self._job_pods(job):
             self._delete_pod(pod)
         self.store.delete_pod_group(f"{job.namespace}/{job.name}")
+        # Controller-created claims carry the job as owner and die with
+        # it (owner refs on createPVC, job_controller_actions.go:512-531).
+        self.store.delete_pvcs_owned_by(job.key)
         for plugin in self._plugins(job):
             plugin.on_job_delete(job, self.store)

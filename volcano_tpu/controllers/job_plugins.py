@@ -59,6 +59,12 @@ class SvcPlugin:
 
     def __init__(self, arguments: List[str]):
         self.arguments = arguments
+        # Reference flag parity (svc.go:63-73): the plugin accepts
+        # "--disable-network-policy" in its argument list.
+        self.disable_network_policy = (
+            "--disable-network-policy" in arguments
+            or "--disable-network-policy=true" in arguments
+        )
 
     def _hosts(self, job) -> Dict[str, str]:
         data = {}
@@ -76,11 +82,25 @@ class SvcPlugin:
             job.name,
             {"headless": True, "selector": {"volcano-tpu/job-name": job.name}},
         )
+        if not self.disable_network_policy:
+            # Pods of the job accept ingress only from pods of the same
+            # job (svc.go:252-299: PodSelector = job labels, one Ingress
+            # rule from the same selector, PolicyTypes=[Ingress]).
+            selector = {"volcano-tpu/job-name": job.name,
+                        "volcano-tpu/job-namespace": job.namespace}
+            store.put_network_policy(
+                job.namespace,
+                job.name,
+                {"pod_selector": selector,
+                 "ingress_from": [selector],
+                 "policy_types": ["Ingress"]},
+            )
         job.status.controlled_resources["plugin-svc"] = "svc"
 
     def on_job_delete(self, job, store) -> None:
         store.delete_config_map(job.namespace, f"{job.name}-svc")
         store.delete_service(job.namespace, job.name)
+        store.delete_network_policy(job.namespace, job.name)
 
     def on_pod_create(self, pod: Pod, job) -> None:
         total = job.total_tasks()

@@ -1769,7 +1769,39 @@ class FastCycle:
                 hosts.append(hostname)
                 bound_pods.append(pod)
                 bound_rows.append(row)
-        from .cache.interface import BindFailure
+        from .cache.interface import BindFailure, VolumeBindFailure
+
+        # Volume gate (statement.go allocate->AllocateVolumes, commit->
+        # BindVolumes): pods carrying claims go through the volume binder
+        # BEFORE their bind dispatches; a claim failure reverts exactly
+        # that pod to Pending.  Pods without volumes pay one truthiness
+        # check — at north-star scale the loop is claim-free.
+        if any(pod.volumes for pod in bound_pods):
+            vb = store.volume_binder
+            vol_failed = []
+            for pod, hostname, key in zip(bound_pods, hosts, keys):
+                if not pod.volumes:
+                    continue
+                try:
+                    vb.allocate_volumes(pod, hostname)
+                    vb.bind_volumes(pod)
+                except VolumeBindFailure as e:
+                    store.record_event(f"Pod/{key}", "FailedScheduling",
+                                       str(e))
+                    vol_failed.append(key)
+            if vol_failed:
+                self._revert_failed_binds(vol_failed, keys, bound_rows,
+                                          bound_pods)
+                fset = set(vol_failed)
+                kept = [
+                    (k, h, p, r) for k, h, p, r
+                    in zip(keys, hosts, bound_pods, bound_rows)
+                    if k not in fset
+                ]
+                keys = [k for k, _, _, _ in kept]
+                hosts = [h for _, h, _, _ in kept]
+                bound_pods = [p for _, _, p, _ in kept]
+                bound_rows = [r for _, _, _, r in kept]
 
         if getattr(store, "async_bind", False):
             # Async dispatch (cache.go:536-552): the cycle only pays the
@@ -1853,6 +1885,12 @@ class FastCycle:
             )
         for i in idx:
             bound_pods[i].node_name = None
+        for i in idx:
+            # Claims the failed pod pinned/bound roll back with it
+            # (release only after every failed pod's node_name is
+            # cleared, so shared claims held by co-failed pods free up).
+            if bound_pods[i].volumes:
+                self.store.release_claims_for(bound_pods[i])
 
     def _record_fit_failures(self, solve_jobs: List[int],
                              fit_failed: np.ndarray) -> None:

@@ -470,7 +470,9 @@ class Session:
         self._dispatch_events(reclaimee, allocate=False)
 
     def dispatch_bind(self, task: TaskInfo) -> None:
-        """Send the bind to the cache (session.go dispatch)."""
+        """Send the bind to the cache (session.go:307-330 dispatch:
+        BindVolumes then Bind)."""
+        self.cache.bind_volumes(task)
         self.cache.bind(task, task.node_name)
         job = self.jobs.get(task.job)
         if job is not None:

@@ -56,11 +56,22 @@ def job_from_dict(data: dict) -> Job:
         )
         for t in data.get("tasks", [])
     ]
+    from .controllers import VolumeSpec
+
+    volumes = [
+        VolumeSpec(
+            mount_path=v.get("mountPath", ""),
+            volume_claim_name=v.get("volumeClaimName", ""),
+            volume_claim=v.get("volumeClaim"),
+        )
+        for v in data.get("volumes", [])
+    ]
     return Job(
         name=data["name"],
         namespace=data.get("namespace", "default"),
         min_available=int(data.get("minAvailable", 0)),
         tasks=tasks,
+        volumes=volumes,
         policies=[_policy_from_dict(p) for p in data.get("policies", [])],
         plugins=data.get("plugins", {}),
         queue=data.get("queue", "default"),

@@ -88,6 +88,36 @@ def _validate_policies(policies: List[LifecyclePolicy], where: str) -> List[str]
     return msgs
 
 
+def _validate_io(volumes) -> List[str]:
+    """VolumeSpec rules (admit_job.go validateIO, util.go:161-183)."""
+    msgs: List[str] = []
+    paths = set()
+    for vol in volumes:
+        if not vol.mount_path:
+            msgs.append("mountPath is required")
+            continue
+        if vol.mount_path in paths:
+            msgs.append(f"duplicated mountPath: {vol.mount_path}")
+        paths.add(vol.mount_path)
+        if vol.volume_claim is None and not vol.volume_claim_name:
+            msgs.append(
+                "either volumeClaim or volumeClaimName must be specified"
+            )
+        elif vol.volume_claim_name:
+            if vol.volume_claim is not None:
+                msgs.append(
+                    "conflict: if you want to use an existing PVC, just "
+                    "specify volumeClaimName; to create a new PVC, do "
+                    "not specify volumeClaimName"
+                )
+            elif not _DNS1123.match(vol.volume_claim_name):
+                msgs.append(
+                    f"invalid volumeClaimName {vol.volume_claim_name!r} "
+                    "(must be DNS-1123)"
+                )
+    return msgs
+
+
 def validate_job_create(job: Job, store) -> None:
     msgs: List[str] = []
     if job.min_available <= 0:
@@ -123,6 +153,7 @@ def validate_job_create(job: Job, store) -> None:
             "'minAvailable' should not be greater than total replicas in tasks"
         )
     msgs.extend(_validate_policies(job.policies, "job"))
+    msgs.extend(_validate_io(job.volumes))
 
     from ..controllers.job_plugins import PLUGIN_BUILDERS
 
@@ -169,11 +200,22 @@ def validate_job_update(old: Job, new: Job) -> None:
                 "job updates may not change fields other than "
                 "`minAvailable`, `tasks[*].replicas` under spec"
             )
+    # Volumes may not change; controller-generated claim names are
+    # normalized away before comparing (admit_job.go:224-236).
+    def _norm_vols(vols):
+        return [
+            (v.mount_path,
+             "" if v.volume_claim is not None else v.volume_claim_name,
+             v.volume_claim)
+            for v in vols
+        ]
+
     if (
         old.queue != new.queue
         or old.policies != new.policies
         or old.plugins != new.plugins
         or old.priority_class != new.priority_class
+        or _norm_vols(old.volumes) != _norm_vols(new.volumes)
     ):
         raise AdmissionError(
             "job updates may not change fields other than "
