@@ -1,0 +1,116 @@
+"""Binds crossing a real process boundary (PARITY deviation 5 proof).
+
+The reference scheduler's binds are RPCs to the API server
+(cache.go:492-554) with errTasks backoff on failure (:627-649).  These
+tests run a RemoteBindService in a SECOND PROCESS and drive the store's
+async BindDispatcher through the HttpBinder drop-in: success lands the
+bind table server-side; injected failures exercise BindFailure ->
+Pending revert -> backoff -> retry end to end across the boundary.
+"""
+
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.cache.remote import HttpBinder, RemoteBindService
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+
+@pytest.fixture()
+def remote_binder_process():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "volcano_tpu.cache.remote", "--port", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()  # "remote-binder listening on h:p"
+        assert "listening" in line, line
+        port = int(line.rsplit(":", 1)[1])
+        url = f"http://127.0.0.1:{port}"
+        # Healthz across the boundary.
+        with urllib.request.urlopen(f"{url}/healthz", timeout=5) as r:
+            assert r.status == 200
+        yield url
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _store_with_remote(url, **kw) -> ClusterStore:
+    store = synthetic_cluster(**kw)
+    store.binder = HttpBinder(url)
+    store.async_bind = True
+    return store
+
+
+def test_binds_cross_process_boundary(remote_binder_process):
+    url = remote_binder_process
+    store = _store_with_remote(url, n_nodes=8, n_pods=24, gang_size=4)
+    sched = Scheduler(store)
+    sched.run_once()
+    assert store.flush_binds(timeout=30)
+    binds = HttpBinder(url).binds()
+    assert len(binds) == 24
+    # Server-side placements agree with the store's pod records.
+    for pod in store.pods.values():
+        assert binds[f"{pod.namespace}/{pod.name}"] == pod.node_name
+    store.close()
+
+
+def test_remote_failure_exercises_backoff(remote_binder_process,
+                                          monkeypatch):
+    from volcano_tpu.cache import bindqueue
+
+    monkeypatch.setattr(bindqueue, "BACKOFF_BASE", 0.1)
+    url = remote_binder_process
+    store = _store_with_remote(url, n_nodes=8, n_pods=16, gang_size=1)
+    client = HttpBinder(url)
+    client.chaos_fail_next(1)  # the next batch fails wholesale
+
+    sched = Scheduler(store)
+    sched.run_once()
+    assert store.flush_binds(timeout=30)
+    assert not client.binds()  # nothing landed remotely
+
+    # Drain: every pod back to Pending with a backoff window.
+    sched.run_once()
+    assert len(store.bind_backoff) == 16
+    assert all(p.node_name is None for p in store.pods.values())
+
+    # Window expires -> re-solve -> binds land across the boundary.
+    time.sleep(0.25)
+    sched.run_once()
+    assert store.flush_binds(timeout=30)
+    assert len(client.binds()) == 16
+    assert all(p.node_name for p in store.pods.values())
+    store.close()
+
+
+def test_in_process_service_object_for_unit_use():
+    """RemoteBindService is also usable in-process (thread) for tests
+    that don't need the boundary."""
+    svc = RemoteBindService(port=0)
+    import threading
+
+    t = threading.Thread(target=svc.serve_forever, daemon=True)
+    t.start()
+    try:
+        b = HttpBinder(f"http://127.0.0.1:{svc.port}")
+        b.bind_keys(["default/a", "default/b"], ["n0", "n1"])
+        assert b.binds() == {"default/a": "n0", "default/b": "n1"}
+        # Idempotent re-drive lands on the same host, no error.
+        b.bind_keys(["default/a"], ["n0"])
+        assert b.binds()["default/a"] == "n0"
+    finally:
+        svc.shutdown()

@@ -1,0 +1,180 @@
+"""Remote binder: binds crossing a real process boundary.
+
+The reference's bind side effect is an RPC to the API server from an
+async goroutine (``pkg/scheduler/cache/cache.go:492-554``); the
+scheduler process never shares memory with the system of record.  This
+module is the demonstration that volcano_tpu's single-process design
+keeps that boundary pluggable (PARITY.md deviation 5): ``HttpBinder``
+implements the ``Binder`` protocol over HTTP/JSON against a second
+process running ``RemoteBindService``, and drops into ``ClusterStore``
+unchanged — the ``BindDispatcher`` drives it exactly like the in-process
+fake, including the errTasks backoff path on failures.
+
+Server:  ``python -m volcano_tpu.cache.remote --port 18476``
+Client:  ``ClusterStore(binder=HttpBinder("http://127.0.0.1:18476"))``
+
+Protocol (JSON over HTTP, stdlib only — no new dependencies):
+  POST /bind   {"binds": [{"key": "ns/name", "host": "n0"}, ...]}
+               -> 200 {"failed": ["ns/name", ...]}   (per-key outcomes)
+  GET  /binds  -> 200 {"ns/name": "n0", ...}         (test observability)
+  POST /chaos  {"fail_next": N}  -> fail the next N bind batches
+               (exercises BindFailure -> backoff -> retry end to end)
+  GET  /healthz -> 200 "ok"
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Sequence
+
+from .interface import BindFailure
+
+log = logging.getLogger(__name__)
+
+
+class HttpBinder:
+    """``Binder`` over HTTP/JSON (drop-in for the in-process binder).
+
+    ``bind_keys`` posts the whole batch in one request and raises
+    ``BindFailure`` with the per-key failures the server reports;
+    transport errors raise plain exceptions, which the dispatcher treats
+    as indeterminate and re-drives per key via ``bind`` (idempotent:
+    re-binding a landed key to the same host is a no-op server-side).
+    """
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------ transport
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    # --------------------------------------------------------------- Binder
+
+    def bind_keys(self, keys: Sequence[str],
+                  hostnames: Sequence[str]) -> None:
+        out = self._post("/bind", {
+            "binds": [{"key": k, "host": h}
+                      for k, h in zip(keys, hostnames)],
+        })
+        failed = out.get("failed", [])
+        if failed:
+            raise BindFailure(failed)
+
+    def bind(self, task, hostname: str) -> None:
+        key = f"{task.namespace}/{task.name}"
+        out = self._post("/bind", {"binds": [{"key": key,
+                                              "host": hostname}]})
+        if out.get("failed"):
+            raise BindFailure([key])
+
+    # ---------------------------------------------------------------- extras
+
+    def binds(self) -> Dict[str, str]:
+        """Fetch the server-side bind table (test observability)."""
+        with urllib.request.urlopen(f"{self.base_url}/binds",
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def chaos_fail_next(self, n: int) -> None:
+        self._post("/chaos", {"fail_next": n})
+
+
+class RemoteBindService:
+    """The second process: receives binds, records them, and can inject
+    failures on request (the cluster control plane of the demo)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 18476):
+        self.binds: Dict[str, str] = {}
+        self.fail_next = 0
+        self._lock = threading.Lock()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug("remote-binder: " + fmt, *args)
+
+            def _reply(self, code: int, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, b'"ok"')
+                elif self.path == "/binds":
+                    with service._lock:
+                        body = json.dumps(service.binds).encode()
+                    self._reply(200, body)
+                else:
+                    self._reply(404, b"{}")
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/bind":
+                    failed: List[str] = []
+                    with service._lock:
+                        if service.fail_next > 0:
+                            service.fail_next -= 1
+                            failed = [b["key"]
+                                      for b in payload.get("binds", [])]
+                        else:
+                            for b in payload.get("binds", []):
+                                service.binds[b["key"]] = b["host"]
+                    self._reply(200, json.dumps(
+                        {"failed": failed}).encode())
+                elif self.path == "/chaos":
+                    with service._lock:
+                        service.fail_next = int(
+                            payload.get("fail_next", 0))
+                    self._reply(200, b"{}")
+                else:
+                    self._reply(404, b"{}")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="volcano_tpu remote binder")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=18476)
+    args = ap.parse_args(argv)
+    svc = RemoteBindService(args.host, args.port)
+    # Readiness line for process supervisors / tests.
+    print(f"remote-binder listening on {args.host}:{svc.port}",
+          flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        svc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

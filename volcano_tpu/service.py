@@ -125,7 +125,14 @@ class Service:
         state_path: Optional[str] = None,
         checkpoint_period: float = 30.0,
         lease_path: Optional[str] = None,
+        remote_binder: Optional[str] = None,
     ):
+        if store is None and remote_binder:
+            # Binds cross a process boundary to the remote bind service
+            # (cache/remote.py) — the cache.go:492-554 RPC analog.
+            from .cache.remote import HttpBinder
+
+            store = ClusterStore(binder=HttpBinder(remote_binder))
         self.store = store or ClusterStore()
         # Production binds dispatch on the background worker with
         # errTasks-style failure backoff (cache.go:536-552, 627-649);
@@ -434,6 +441,10 @@ def main(argv=None) -> int:
                    help="leader-election lease file for active/passive HA")
     p.add_argument("--simulate", action="store_true",
                    help="run the built-in cluster simulator (dev mode)")
+    p.add_argument("--remote-binder", default=None,
+                   help="URL of a remote bind service (cache/remote.py); "
+                        "binds then cross a process boundary like the "
+                        "reference's API-server bind RPCs")
     args = p.parse_args(argv)
 
     svc = Service(
@@ -443,6 +454,7 @@ def main(argv=None) -> int:
         state_path=args.state_path,
         checkpoint_period=args.checkpoint_period,
         lease_path=args.lease_path,
+        remote_binder=args.remote_binder,
     )
     port = svc.start(http_port=args.listen_port,
                      bind_address=args.bind_address)
