@@ -114,3 +114,19 @@ def test_in_process_service_object_for_unit_use():
         assert b.binds()["default/a"] == "n0"
     finally:
         svc.shutdown()
+
+
+def test_service_remote_binder_startup_validation(remote_binder_process):
+    """--remote-binder fails fast on a dead URL, applies to caller-passed
+    stores, and probes /healthz at startup."""
+    from volcano_tpu.service import Service
+    from volcano_tpu.cache.remote import HttpBinder
+
+    # Dead URL: startup raises instead of looping Pending forever.
+    with pytest.raises(Exception):
+        Service(remote_binder="http://127.0.0.1:9")
+    # A caller-passed store is rewired, not silently left on the fake.
+    store = ClusterStore()
+    svc = Service(store=store, remote_binder=remote_binder_process)
+    assert isinstance(store.binder, HttpBinder)
+    svc.stop()

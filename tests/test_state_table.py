@@ -1,0 +1,213 @@
+"""Every job state x action transition cell, table-driven.
+
+The shape of ``pkg/controllers/job/job_state_test.go`` (1,295 LoC — the
+reference's largest test file), tightened: each row pins down which
+controller verb the state dispatches (sync_job vs kill_job), the
+pod-retain set, the resulting phase given a status-count scenario, and
+the retry-count delta.  Transition logic cites
+``pkg/controllers/job/state/*.go`` per state class in
+``volcano_tpu/controllers/state.py``.
+"""
+
+import pytest
+
+from volcano_tpu.controllers.apis import (
+    Action,
+    Job,
+    JobPhase,
+    JobStatus,
+    TaskSpec,
+)
+from volcano_tpu.controllers.state import (
+    POD_RETAIN_PHASE_NONE,
+    POD_RETAIN_PHASE_SOFT,
+    new_state,
+)
+
+
+class RecordingCtrl:
+    """Stands in for the JobController: records the dispatched verb and
+    retain set, then applies the transition closure to the scenario's
+    status counts — exactly what sync_job/kill_job do after reconciling
+    pods (job_controller.py)."""
+
+    def __init__(self):
+        self.verb = None
+        self.retain = None
+
+    def sync_job(self, job, update_status):
+        self.verb = "sync"
+        if update_status is not None:
+            update_status(job.status)
+
+    def kill_job(self, job, retain_phases, update_status):
+        self.verb = "kill"
+        self.retain = set(retain_phases)
+        if update_status is not None:
+            update_status(job.status)
+
+
+def make_job(phase, *, replicas=3, min_available=2, max_retry=3,
+             retry_count=0, pending=0, running=0, succeeded=0, failed=0,
+             terminating=0):
+    job = Job(
+        name="t",
+        min_available=min_available,
+        max_retry=max_retry,
+        tasks=[TaskSpec(name="w", replicas=replicas,
+                        containers=[{"cpu": "1"}])],
+    )
+    job.status = JobStatus(
+        pending=pending, running=running, succeeded=succeeded,
+        failed=failed, terminating=terminating,
+        retry_count=retry_count, min_available=min_available,
+    )
+    job.status.state.phase = phase.value
+    return job
+
+
+SYNC = ("sync", None)
+KILL_NONE = ("kill", POD_RETAIN_PHASE_NONE)
+KILL_SOFT = ("kill", POD_RETAIN_PHASE_SOFT)
+
+# Each row: (name, phase, action, job kwargs, expected (verb, retain),
+#            expected phase, expected retry delta)
+CELLS = [
+    # ---------------- Pending (state/pending.go) ----------------
+    ("pending-restart", JobPhase.Pending, Action.RestartJob, {},
+     KILL_NONE, JobPhase.Restarting, 1),
+    ("pending-abort", JobPhase.Pending, Action.AbortJob, {},
+     KILL_SOFT, JobPhase.Aborting, 0),
+    ("pending-complete", JobPhase.Pending, Action.CompleteJob, {},
+     KILL_SOFT, JobPhase.Completing, 0),
+    ("pending-terminate", JobPhase.Pending, Action.TerminateJob, {},
+     KILL_SOFT, JobPhase.Terminating, 0),
+    ("pending-sync-below-minavailable", JobPhase.Pending, Action.SyncJob,
+     dict(running=1), SYNC, JobPhase.Pending, 0),
+    ("pending-sync-reaches-minavailable", JobPhase.Pending,
+     Action.SyncJob, dict(running=2), SYNC, JobPhase.Running, 0),
+    ("pending-sync-minavailable-mixed-counts", JobPhase.Pending,
+     Action.SyncJob, dict(running=1, succeeded=1), SYNC,
+     JobPhase.Running, 0),
+    ("pending-resume-falls-to-sync", JobPhase.Pending, Action.ResumeJob,
+     dict(running=0), SYNC, JobPhase.Pending, 0),
+    # ---------------- Running (state/running.go) ----------------
+    ("running-restart", JobPhase.Running, Action.RestartJob,
+     dict(running=3), KILL_NONE, JobPhase.Restarting, 1),
+    ("running-abort", JobPhase.Running, Action.AbortJob, dict(running=3),
+     KILL_SOFT, JobPhase.Aborting, 0),
+    ("running-terminate", JobPhase.Running, Action.TerminateJob,
+     dict(running=3), KILL_SOFT, JobPhase.Terminating, 0),
+    ("running-complete", JobPhase.Running, Action.CompleteJob,
+     dict(running=3), KILL_SOFT, JobPhase.Completing, 0),
+    ("running-sync-still-running", JobPhase.Running, Action.SyncJob,
+     dict(running=3), SYNC, JobPhase.Running, 0),
+    ("running-sync-all-done-enough-succeeded", JobPhase.Running,
+     Action.SyncJob, dict(succeeded=2, failed=1), SYNC,
+     JobPhase.Completed, 0),
+    ("running-sync-all-done-too-few-succeeded", JobPhase.Running,
+     Action.SyncJob, dict(succeeded=1, failed=2), SYNC,
+     JobPhase.Failed, 0),
+    ("running-sync-partial-done", JobPhase.Running, Action.SyncJob,
+     dict(running=1, succeeded=2), SYNC, JobPhase.Running, 0),
+    # ---------------- Restarting (state/restarting.go) ----------------
+    # Any action: the state machine is already mid-restart.
+    ("restarting-retries-exhausted", JobPhase.Restarting, Action.SyncJob,
+     dict(retry_count=3), KILL_NONE, JobPhase.Failed, 0),
+    ("restarting-pods-gone-to-pending", JobPhase.Restarting,
+     Action.SyncJob, dict(retry_count=1, terminating=1), KILL_NONE,
+     JobPhase.Pending, 0),
+    ("restarting-waiting-on-terminating", JobPhase.Restarting,
+     Action.SyncJob, dict(retry_count=1, terminating=2), KILL_NONE,
+     JobPhase.Restarting, 0),
+    ("restarting-ignores-restart-action", JobPhase.Restarting,
+     Action.RestartJob, dict(retry_count=1, terminating=1), KILL_NONE,
+     JobPhase.Pending, 0),
+    # ---------------- Aborting (state/aborting.go) ----------------
+    ("aborting-resume", JobPhase.Aborting, Action.ResumeJob, {},
+     KILL_SOFT, JobPhase.Restarting, 1),
+    ("aborting-waits-for-pods", JobPhase.Aborting, Action.SyncJob,
+     dict(terminating=1), KILL_SOFT, JobPhase.Aborting, 0),
+    ("aborting-pods-gone", JobPhase.Aborting, Action.SyncJob, {},
+     KILL_SOFT, JobPhase.Aborted, 0),
+    ("aborting-abort-again-noop", JobPhase.Aborting, Action.AbortJob,
+     dict(running=1), KILL_SOFT, JobPhase.Aborting, 0),
+    # ---------------- Aborted (state/aborted.go) ----------------
+    ("aborted-resume", JobPhase.Aborted, Action.ResumeJob, {},
+     KILL_SOFT, JobPhase.Restarting, 1),
+    ("aborted-other-stays", JobPhase.Aborted, Action.RestartJob, {},
+     KILL_SOFT, JobPhase.Aborted, 0),
+    ("aborted-sync-stays", JobPhase.Aborted, Action.SyncJob, {},
+     KILL_SOFT, JobPhase.Aborted, 0),
+    # ---------------- Terminating (state/terminating.go) ----------------
+    ("terminating-waits-for-pods", JobPhase.Terminating, Action.SyncJob,
+     dict(pending=1), KILL_SOFT, JobPhase.Terminating, 0),
+    ("terminating-pods-gone", JobPhase.Terminating, Action.SyncJob, {},
+     KILL_SOFT, JobPhase.Terminated, 0),
+    ("terminating-ignores-resume", JobPhase.Terminating,
+     Action.ResumeJob, {}, KILL_SOFT, JobPhase.Terminated, 0),
+    # ---------------- Completing (state/completing.go) ----------------
+    ("completing-waits-for-pods", JobPhase.Completing, Action.SyncJob,
+     dict(running=1), KILL_SOFT, JobPhase.Completing, 0),
+    ("completing-pods-gone", JobPhase.Completing, Action.SyncJob,
+     dict(succeeded=3), KILL_SOFT, JobPhase.Completed, 0),
+    # ---------------- Finished (state/finished.go) ----------------
+    ("completed-any-action-stays", JobPhase.Completed, Action.RestartJob,
+     {}, KILL_SOFT, JobPhase.Completed, 0),
+    ("failed-any-action-stays", JobPhase.Failed, Action.ResumeJob, {},
+     KILL_SOFT, JobPhase.Failed, 0),
+    ("terminated-sync-stays", JobPhase.Terminated, Action.SyncJob, {},
+     KILL_SOFT, JobPhase.Terminated, 0),
+]
+
+
+@pytest.mark.parametrize(
+    "name,phase,action,kw,expected_call,expected_phase,retry_delta",
+    CELLS, ids=[c[0] for c in CELLS])
+def test_state_action_cell(name, phase, action, kw, expected_call,
+                           expected_phase, retry_delta):
+    job = make_job(phase, **kw)
+    before_retry = job.status.retry_count
+    ctrl = RecordingCtrl()
+    new_state(ctrl, job).execute(action.value)
+    verb, retain = expected_call
+    assert ctrl.verb == verb, f"{name}: dispatched {ctrl.verb}"
+    if retain is not None:
+        assert ctrl.retain == retain
+    assert job.status.state.phase == expected_phase.value
+    assert job.status.retry_count - before_retry == retry_delta
+
+
+def test_factory_maps_every_phase():
+    """state/factory.go NewState: each phase resolves to its state class,
+    unknown/terminal phases fall through to Finished semantics."""
+    from volcano_tpu.controllers import state as st
+
+    expected = {
+        JobPhase.Pending: st.PendingState,
+        JobPhase.Running: st.RunningState,
+        JobPhase.Restarting: st.RestartingState,
+        JobPhase.Aborting: st.AbortingState,
+        JobPhase.Aborted: st.AbortedState,
+        JobPhase.Terminating: st.TerminatingState,
+        JobPhase.Completing: st.CompletingState,
+        JobPhase.Completed: st.FinishedState,
+        JobPhase.Terminated: st.FinishedState,
+        JobPhase.Failed: st.FinishedState,
+    }
+    for phase, cls in expected.items():
+        job = make_job(phase)
+        assert isinstance(st.new_state(RecordingCtrl(), job), cls), phase
+    # Empty phase (fresh job) is Pending.
+    job = make_job(JobPhase.Pending)
+    job.status.state.phase = ""
+    assert isinstance(st.new_state(RecordingCtrl(), job), st.PendingState)
+
+
+def test_default_max_retry_applies_when_zero():
+    """RestartingState falls back to DEFAULT_MAX_RETRY when the spec's
+    maxRetry is 0 (restarting.go)."""
+    job = make_job(JobPhase.Restarting, max_retry=0, retry_count=3)
+    ctrl = RecordingCtrl()
+    new_state(ctrl, job).execute(Action.SyncJob.value)
+    assert job.status.state.phase == JobPhase.Failed.value

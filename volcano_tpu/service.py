@@ -127,12 +127,23 @@ class Service:
         lease_path: Optional[str] = None,
         remote_binder: Optional[str] = None,
     ):
-        if store is None and remote_binder:
+        if remote_binder:
             # Binds cross a process boundary to the remote bind service
             # (cache/remote.py) — the cache.go:492-554 RPC analog.
+            # Probe /healthz so a permanently wrong URL fails at startup
+            # (transient outages still ride the errTasks backoff).
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"{remote_binder.rstrip('/')}/healthz", timeout=10
+            ):
+                pass
             from .cache.remote import HttpBinder
 
-            store = ClusterStore(binder=HttpBinder(remote_binder))
+            if store is None:
+                store = ClusterStore(binder=HttpBinder(remote_binder))
+            else:
+                store.binder = HttpBinder(remote_binder)
         self.store = store or ClusterStore()
         # Production binds dispatch on the background worker with
         # errTasks-style failure backoff (cache.go:536-552, 627-649);

@@ -52,6 +52,7 @@ class AdmissionError(ValueError):
 def _validate_policies(policies: List[LifecyclePolicy], where: str) -> List[str]:
     msgs: List[str] = []
     seen_events = set()
+    seen_exit_codes = set()
     for policy in policies:
         has_event = bool(policy.event or policy.events)
         if has_event and policy.exit_code is not None:
@@ -85,6 +86,17 @@ def _validate_policies(policies: List[LifecyclePolicy], where: str) -> List[str]
             if policy.exit_code == 0:
                 msgs.append(f"{where}: 0 is not a valid error code")
                 break
+            if policy.exit_code in seen_exit_codes:
+                msgs.append(
+                    f"{where}: duplicate exitCode {policy.exit_code}"
+                )
+                break
+            seen_exit_codes.add(policy.exit_code)
+    # "if there's * here, no other policy should be here" (util.go).
+    if "*" in seen_events and len(seen_events) > 1:
+        msgs.append(
+            f"{where}: if there's * here, no other policy should be here"
+        )
     return msgs
 
 
