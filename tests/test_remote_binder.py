@@ -122,11 +122,39 @@ def test_service_remote_binder_startup_validation(remote_binder_process):
     from volcano_tpu.service import Service
     from volcano_tpu.cache.remote import HttpBinder
 
-    # Dead URL: startup raises instead of looping Pending forever.
-    with pytest.raises(Exception):
+    # Dead URL: startup raises instead of looping Pending forever
+    # (urllib's URLError subclasses OSError).
+    with pytest.raises(OSError):
         Service(remote_binder="http://127.0.0.1:9")
     # A caller-passed store is rewired, not silently left on the fake.
     store = ClusterStore()
     svc = Service(store=store, remote_binder=remote_binder_process)
     assert isinstance(store.binder, HttpBinder)
+    svc.stop()
+
+
+def test_service_rewires_already_dispatched_store(remote_binder_process):
+    """A store whose BindDispatcher already ran captured the OLD binder;
+    Service(remote_binder=...) must reset it so later async binds reach
+    the remote process."""
+    from volcano_tpu.service import Service
+    from volcano_tpu.cache.remote import HttpBinder
+
+    store = synthetic_cluster(n_nodes=4, n_pods=4, gang_size=1)
+    store.async_bind = True
+    Scheduler(store).run_once()
+    assert store.flush_binds(timeout=10)
+    assert len(store.binder.binds) == 4  # landed on the in-process fake
+
+    svc = Service(store=store, remote_binder=remote_binder_process)
+    # New pods bind through the remote service now.
+    from volcano_tpu.api import GROUP_NAME_ANNOTATION, Pod, PodGroup
+    store.add_pod_group(PodGroup(name="late", min_member=1))
+    store.add_pod(Pod(name="late-0",
+                      annotations={GROUP_NAME_ANNOTATION: "late"},
+                      containers=[{"cpu": "1", "memory": "1Gi"}]))
+    Scheduler(store).run_once()
+    assert store.flush_binds(timeout=30)
+    remote = HttpBinder(remote_binder_process).binds()
+    assert "default/late-0" in remote
     svc.stop()

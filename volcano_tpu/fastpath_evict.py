@@ -1210,14 +1210,17 @@ class FastEvictor:
                 queues_pq.push(qname)
                 continue
             init_req = st.init_req[prow]
-            # Reclaim requires the NEWLY reclaimed resources alone to
-            # cover the task (reclaim.go:166-168: `resreq.less_equal(
-            # reclaimed)`), so the prefilter is on evictable capacity
-            # only — exhausted nodes drop out as their victims go.
-            # Checked before the predicate mask: as victims deplete this
-            # empties and skips the mask wholesale.
+            # Node prefilter = validate_victims (scheduler_helper.go:
+            # 224-239): FutureIdle + victim capacity must cover the
+            # task.  NOT evictable-alone: reclaim.go's victim loop runs
+            # on any validated node and its evictions stand even when
+            # the reclaimed sum never covers the task (the pipeline
+            # check `resreq.less_equal(reclaimed)` gates only the
+            # pipeline, reclaim.go:166-175) — an evictable-only filter
+            # would skip those collateral evictions and diverge
+            # (caught by tests/test_evict_oracle.py fuzz seed 0).
             ev = self._evictable_for(("rq", qname))
-            feasible = self._le_rows(init_req, ev)
+            feasible = self._le_rows(init_req, st.fi, ev)
             if feasible.any():
                 feasible = feasible & self.feasible_mask(prow)
             for n in np.flatnonzero(feasible & c.n_alive):

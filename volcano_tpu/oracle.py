@@ -306,3 +306,108 @@ def solve_oracle(
         idle=idle,
         q_alloc=q_alloc + q_pip,
     )
+
+
+# ---------------------------------------------------------------------------
+# Eviction-side oracles (preempt/reclaim/enqueue/backfill): the Go-shaped
+# references for the victim-selection machinery in fastpath_evict.py and
+# the device victim kernel.  Deliberately naive, sequential NumPy.
+# ---------------------------------------------------------------------------
+
+
+class VictimSelection(NamedTuple):
+    evicted: np.ndarray  # indices into the victims arrays, eviction order
+    satisfied: bool  # preemptor fits the resulting future idle
+    future_idle: np.ndarray  # [R] after the evictions
+
+
+def oracle_victims(demand, future_idle, victims_res, victims_order,
+                   eps, scalar_slot) -> VictimSelection:
+    """Per-node victim pop loop (preempt.go:228-242): victims leave in
+    inverted task-order (lowest order first — preempt.go:219-224) until
+    the preemptor's init request fits the accumulating future idle; the
+    preemptor pipelines iff the final fit holds.
+
+    ``victims_order``: sort key per victim, ascending = evicted first
+    (the caller encodes task_order_fn: priority asc, creation desc, ...
+    inverted).  Ties broken by input index (stable), matching the
+    deterministic heap replay of the fast path."""
+    demand = np.asarray(demand, np.float32)
+    fi = np.array(future_idle, np.float32, copy=True)
+    victims_res = np.asarray(victims_res, np.float32)
+    order = np.argsort(np.asarray(victims_order), kind="stable")
+    evicted = []
+    for i in order:
+        if np_less_equal(demand, fi, eps, scalar_slot):
+            break
+        fi = fi + victims_res[i]
+        evicted.append(int(i))
+    return VictimSelection(
+        evicted=np.asarray(evicted, np.int64),
+        satisfied=bool(np_less_equal(demand, fi, eps, scalar_slot)),
+        future_idle=fi,
+    )
+
+
+def oracle_gang_protection(min_available, ready_counts, victim_jobs):
+    """gang.go:74-98 as a mask: walking the candidate victims in order,
+    a victim is allowed iff its job's remaining occupancy stays >= its
+    MinAvailable after this eviction, or MinAvailable == 1."""
+    occupied = {int(j): int(ready_counts[int(j)])
+                for j in set(int(j) for j in victim_jobs)}
+    allowed = np.zeros(len(victim_jobs), bool)
+    for i, j in enumerate(int(j) for j in victim_jobs):
+        cnt = occupied[j]
+        ma = int(min_available[j])
+        if ma <= cnt - 1 or ma == 1:
+            occupied[j] = cnt - 1
+            allowed[i] = True
+    return allowed
+
+
+def oracle_enqueue(min_res, queue_of_group, group_order, idle_budget,
+                   queue_caps, queue_alloc, eps, scalar_slot):
+    """enqueue.go:52-132 over dense vectors: groups in (queue order,
+    job order) charge MinResources against the overcommitted idle
+    budget; the walk stops for everyone once the budget goes empty.
+
+    ``min_res``: [G, R] (NaN row = MinResources nil: charges nothing,
+    always accepted while the walk lives); ``queue_caps``: [Q, R] with
+    +inf rows for capability-less queues (proportion JobEnqueueable).
+    Returns [G] bool inqueue mask."""
+    G = len(group_order)
+    idle = np.array(idle_budget, np.float32, copy=True)
+    q_alloc = np.array(queue_alloc, np.float32, copy=True)
+    inqueue = np.zeros(G, bool)
+    for g in group_order:
+        if bool(np.all(idle < eps)):
+            break
+        row = min_res[g]
+        if np.any(np.isnan(row)):
+            inqueue[g] = True
+            continue
+        q = int(queue_of_group[g])
+        if not np_less_equal(row + q_alloc[q], queue_caps[q], eps,
+                             scalar_slot):
+            continue
+        if np_less_equal(row, idle, eps, scalar_slot):
+            idle = idle - row
+            q_alloc[q] = q_alloc[q] + row
+            inqueue[g] = True
+    return inqueue
+
+
+def oracle_backfill(be_feasible, group_inqueue, task_group):
+    """backfill.go:39-88: zero-request pending tasks of Inqueue groups
+    place on the first feasible node in index order (no resource charge
+    — BestEffort).  ``be_feasible``: [T, N] bool.  Returns [T] node or
+    -1."""
+    T, N = be_feasible.shape
+    out = np.full(T, -1, np.int64)
+    for t in range(T):
+        if not group_inqueue[int(task_group[t])]:
+            continue
+        feas = np.flatnonzero(be_feasible[t])
+        if len(feas):
+            out[t] = int(feas[0])
+    return out

@@ -144,6 +144,10 @@ class Service:
                 store = ClusterStore(binder=HttpBinder(remote_binder))
             else:
                 store.binder = HttpBinder(remote_binder)
+                # An existing BindDispatcher captured the old binder at
+                # first dispatch; stop it so the next dispatch rebuilds
+                # against the remote one.
+                store.close()
         self.store = store or ClusterStore()
         # Production binds dispatch on the background worker with
         # errTasks-style failure backoff (cache.go:536-552, 627-649);
