@@ -129,3 +129,355 @@ void vcsnap_less_equal(const float* l, const float* rhs, const float* eps,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Reclaim step engine (pkg/scheduler/actions/reclaim/reclaim.go:136-175 +
+// session_plugins.go:110-193 tier intersection), driven per-reclaimer from
+// volcano_tpu/fastpath_evict.py.  One call walks nodes from the persistent
+// cursor, collects cross-queue Running candidates, narrows them through the
+// tiered Reclaimable plugins (gang / conformance / proportion — encoded in
+// `tiers`), validates, evicts victims in order until the reclaimed sum
+// covers the request, and reports the pipeline node.  All cluster state is
+// mutated in place through the caller's numpy buffers; evicted rows are
+// returned so the Python side can keep its caches/event trail coherent.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+static const float VC_MIN_MILLI_SCALAR = 10.0f;
+
+// Resource.less on dense slot vectors (api/resource.py:182-199), with the
+// allocation's scalar DICT ENTRY SET modelled explicitly: Resource.sub
+// keeps zeroed entries in the dict (and adds the subtrahend's keys), so
+// "scalars is None" and "which keys exist" cannot be derived from values.
+// a_has: the dict is non-None; a_entry[k]: slot k has a dict entry.
+static bool vc_res_less(const float* a, bool a_has,
+                        const uint8_t* a_entry, const float* b,
+                        int64_t R, const uint8_t* scalar_slot) {
+  if (!(a[0] < b[0])) return false;
+  if (!(a[1] < b[1])) return false;
+  bool b_any = false;
+  for (int64_t k = 2; k < R; ++k)
+    if (scalar_slot[k] && b[k] != 0.0f) b_any = true;
+  if (!a_has) {
+    if (b_any) {
+      for (int64_t k = 2; k < R; ++k)
+        if (scalar_slot[k] && b[k] != 0.0f && b[k] <= VC_MIN_MILLI_SCALAR)
+          return false;
+    }
+    return true;
+  }
+  if (!b_any) return false;
+  // Iterate the allocation's ENTRIES (rr.scalars.get(name, 0) == b[k]).
+  for (int64_t k = 2; k < R; ++k)
+    if (scalar_slot[k] && a_entry[k] && !(a[k] < b[k])) return false;
+  return true;
+}
+
+// Resource.less_equal_strict(d, a) on dense vectors (resource.py:201-212).
+static bool vc_res_le_strict(const float* d, const float* a, int64_t R,
+                             const uint8_t* scalar_slot) {
+  if (!(d[0] <= a[0])) return false;
+  if (!(d[1] <= a[1])) return false;
+  for (int64_t k = 2; k < R; ++k)
+    if (scalar_slot[k] && d[k] != 0.0f && !(d[k] <= a[k])) return false;
+  return true;
+}
+
+// Epsilon-tolerant Resource.less_equal (resource_info.go:286-320) of l vs r.
+static bool vc_le(const float* l, const float* r, const float* eps,
+                  const uint8_t* scalar_slot, int64_t R) {
+  for (int64_t k = 0; k < R; ++k) {
+    float lv = l[k], rv = r[k];
+    bool ok = (lv < rv) || (std::abs(lv - rv) < eps[k]);
+    if (scalar_slot[k] && lv <= eps[k]) ok = true;
+    if (!ok) return false;
+  }
+  return true;
+}
+
+// Plugin ids in the `tiers` encoding (-1 = tier boundary).
+enum { VC_PLUGIN_GANG = 0, VC_PLUGIN_CONFORMANCE = 1,
+       VC_PLUGIN_PROPORTION = 2 };
+
+#define VC_MAX_CAND 512
+
+// Per-action context: every stable pointer captured once so the per-
+// reclaimer call marshals only what varies (ctypes arg overhead was
+// measurable at 20k reclaimers per cycle).
+struct VcReclaimCtx {
+  const long long* node_ptr; const long long* node_rows;
+  int16_t* p_status; const int32_t* p_job;
+  const float* req; const uint8_t* req_empty; const uint8_t* critical;
+  const int32_t* j_minav; int32_t* j_ready_base;
+  int32_t* j_cnt_alloc; int32_t* j_cnt_run; int32_t* j_cnt_releasing;
+  float* j_alloc_res; const int32_t* q_of_job;
+  const uint8_t* q_reclaimable; float* q_alloc;
+  const float* q_deserved; const uint8_t* q_has_deserved;
+  float* fi; float* n_releasing;
+  const int32_t* tiers; long long tiers_len;
+  const float* eps; const uint8_t* scalar_slot;
+  const uint8_t* alive; const float* init_req_base;
+  long long Nn, R, st_running, st_releasing;
+};
+
+void* vcreclaim_ctx_new(
+    const long long* node_ptr, const long long* node_rows,
+    int16_t* p_status, const int32_t* p_job,
+    const float* req, const uint8_t* req_empty, const uint8_t* critical,
+    const int32_t* j_minav, int32_t* j_ready_base,
+    int32_t* j_cnt_alloc, int32_t* j_cnt_run, int32_t* j_cnt_releasing,
+    float* j_alloc_res, const int32_t* q_of_job,
+    const uint8_t* q_reclaimable, float* q_alloc,
+    const float* q_deserved, const uint8_t* q_has_deserved,
+    float* fi, float* n_releasing,
+    const int32_t* tiers, long long tiers_len,
+    const float* eps, const uint8_t* scalar_slot,
+    const uint8_t* alive, const float* init_req_base,
+    long long Nn, long long R,
+    long long st_running, long long st_releasing) {
+  VcReclaimCtx* c = new VcReclaimCtx{
+      node_ptr, node_rows, p_status, p_job, req, req_empty, critical,
+      j_minav, j_ready_base, j_cnt_alloc, j_cnt_run, j_cnt_releasing,
+      j_alloc_res, q_of_job, q_reclaimable, q_alloc, q_deserved,
+      q_has_deserved, fi, n_releasing, tiers, tiers_len, eps,
+      scalar_slot, alive, init_req_base, Nn, R, st_running, st_releasing};
+  return c;
+}
+
+void vcreclaim_ctx_free(void* ctx) {
+  delete static_cast<VcReclaimCtx*>(ctx);
+}
+
+// Returns the node the reclaimer pipelined on, or -1.  Victim rows evicted
+// along the walk (including on nodes that ultimately could not cover the
+// request — reclaim.go's evictions are immediate and unwrapped) land in
+// out_evicted.
+long long vcreclaim_step(
+    void* ctx_p, long long prow, long long qid,
+    long long* cursor,
+    const uint8_t* anym, const uint8_t* feas, const uint8_t* stat,
+    const uint8_t* slots,
+    long long* out_evicted, long long* out_n_evicted,
+    long long max_evicted) {
+  const VcReclaimCtx& C = *static_cast<VcReclaimCtx*>(ctx_p);
+  const long long Nn = C.Nn, R = C.R;
+  const long long* node_ptr = C.node_ptr;
+  const long long* node_rows = C.node_rows;
+  int16_t* p_status = C.p_status;
+  const int32_t* p_job = C.p_job;
+  const float* req = C.req;
+  const uint8_t* req_empty = C.req_empty;
+  const uint8_t* critical = C.critical;
+  const int32_t* j_minav = C.j_minav;
+  int32_t* j_ready_base = C.j_ready_base;
+  int32_t* j_cnt_alloc = C.j_cnt_alloc;
+  int32_t* j_cnt_run = C.j_cnt_run;
+  int32_t* j_cnt_releasing = C.j_cnt_releasing;
+  float* j_alloc_res = C.j_alloc_res;
+  const int32_t* q_of_job = C.q_of_job;
+  const uint8_t* q_reclaimable = C.q_reclaimable;
+  float* q_alloc = C.q_alloc;
+  const float* q_deserved = C.q_deserved;
+  const uint8_t* q_has_deserved = C.q_has_deserved;
+  float* fi = C.fi;
+  float* n_releasing = C.n_releasing;
+  const int32_t* tiers = C.tiers;
+  const long long tiers_len = C.tiers_len;
+  const float* eps = C.eps;
+  const uint8_t* scalar_slot = C.scalar_slot;
+  const uint8_t* alive = C.alive;
+  const float* init_req = C.init_req_base + prow * R;
+  const long long st_running = C.st_running, st_releasing = C.st_releasing;
+  int64_t cand[VC_MAX_CAND];
+  uint8_t in_victims[VC_MAX_CAND];
+  uint8_t in_sel[VC_MAX_CAND];
+  // Scratch for per-call plugin state (small: candidates per node).
+  int64_t gang_jobs[VC_MAX_CAND];
+  int32_t gang_cnt[VC_MAX_CAND];
+  int64_t prop_qs[VC_MAX_CAND];
+  float prop_alloc[VC_MAX_CAND * 8];  // R <= 8 supported
+  uint8_t prop_entry[VC_MAX_CAND * 8];
+  uint8_t prop_has[VC_MAX_CAND];
+  float reclaimed[8];
+  float vsum[8];
+  if (R > 8) return -2;  // unsupported width; caller falls back
+
+  *out_n_evicted = 0;
+  long long n = *cursor;
+  bool advancing = true;
+  for (; n < Nn; ++n) {
+    if (!(anym[n] && feas[n] && alive[n]
+          && (stat == nullptr || (stat[n] && slots[n])))) {
+      if (advancing) *cursor = n + 1;
+      continue;
+    }
+    advancing = false;
+    // ---- candidates: cross-queue Running tasks of reclaimable queues,
+    // in resident (insertion) order.
+    int64_t nc = 0;
+    for (int64_t p = node_ptr[n]; p < node_ptr[n + 1]; ++p) {
+      int64_t r = node_rows[p];
+      if (p_status[r] != (int16_t)st_running || req_empty[r]) continue;
+      int32_t jr = p_job[r];
+      if (jr < 0) continue;
+      int32_t vq = q_of_job[jr];
+      if (vq == (int32_t)qid || vq < 0 || !q_reclaimable[vq]) continue;
+      if (nc >= VC_MAX_CAND) return -2;  // degenerate node: fall back
+      cand[nc++] = r;
+    }
+    if (nc == 0) continue;
+    // ---- tiered Reclaimable intersection (session_plugins.go:110-193,
+    // incl. the Go nil-slice quirk: an initialized-empty carried set
+    // keeps poisoning later tiers).
+    bool init = false;
+    for (int64_t i = 0; i < nc; ++i) in_victims[i] = 0;
+    int64_t n_victims = 0;
+    int64_t t = 0;
+    while (t < tiers_len) {
+      // one tier: ids until -1
+      for (; t < tiers_len && tiers[t] != -1; ++t) {
+        int32_t plugin = tiers[t];
+        // sel over the ORIGINAL candidates (session passes the full
+        // preemptees list to every plugin fn).
+        if (plugin == VC_PLUGIN_GANG) {
+          int64_t ng = 0;
+          for (int64_t i = 0; i < nc; ++i) {
+            int32_t jr = p_job[cand[i]];
+            int32_t cnt = -1;
+            int64_t gslot = -1;
+            for (int64_t g = 0; g < ng; ++g)
+              if (gang_jobs[g] == jr) { gslot = g; break; }
+            if (gslot < 0) {
+              gslot = ng++;
+              gang_jobs[gslot] = jr;
+              gang_cnt[gslot] = j_ready_base[jr];
+            }
+            cnt = gang_cnt[gslot];
+            int32_t minav = j_minav[jr];
+            if (minav <= cnt - 1 || minav == 1) {
+              gang_cnt[gslot] = cnt - 1;
+              in_sel[i] = 1;
+            } else {
+              in_sel[i] = 0;
+            }
+          }
+        } else if (plugin == VC_PLUGIN_CONFORMANCE) {
+          for (int64_t i = 0; i < nc; ++i)
+            in_sel[i] = critical[cand[i]] ? 0 : 1;
+        } else if (plugin == VC_PLUGIN_PROPORTION) {
+          int64_t nq = 0;
+          for (int64_t i = 0; i < nc; ++i) {
+            in_sel[i] = 0;
+            int32_t jr = p_job[cand[i]];
+            int32_t vq = q_of_job[jr];
+            if (vq < 0) continue;
+            if (!q_has_deserved[vq]) continue;
+            int64_t qslot = -1;
+            for (int64_t q = 0; q < nq; ++q)
+              if (prop_qs[q] == vq) { qslot = q; break; }
+            if (qslot < 0) {
+              qslot = nq++;
+              prop_qs[qslot] = vq;
+              bool has = false;
+              for (int64_t k = 0; k < R; ++k) {
+                float v = q_alloc[vq * R + k];
+                prop_alloc[qslot * 8 + k] = v;
+                // FastCycle._res: dict entries are the NONZERO slots.
+                bool entry = scalar_slot[k] && v != 0.0f;
+                prop_entry[qslot * 8 + k] = entry ? 1 : 0;
+                if (entry) has = true;
+              }
+              prop_has[qslot] = has ? 1 : 0;
+            }
+            float* alloc = prop_alloc + qslot * 8;
+            uint8_t* entry = prop_entry + qslot * 8;
+            const float* vreq = req + cand[i] * R;
+            if (vc_res_less(alloc, prop_has[qslot] != 0, entry, vreq, R,
+                            scalar_slot))
+              continue;
+            // Resource.sub: cpu/mem always; scalars only when the dict
+            // exists (None -> early return, resource.py:132-134), and
+            // the subtrahend's keys join the entry set (:135-136).
+            alloc[0] -= vreq[0];
+            alloc[1] -= vreq[1];
+            if (prop_has[qslot]) {
+              for (int64_t k = 2; k < R; ++k) {
+                if (!scalar_slot[k]) continue;
+                alloc[k] -= vreq[k];
+                if (vreq[k] != 0.0f) entry[k] = 1;
+              }
+            }
+            if (vc_res_le_strict(q_deserved + vq * R, alloc, R,
+                                 scalar_slot))
+              in_sel[i] = 1;
+          }
+        } else {
+          continue;  // unknown plugin: no reclaimable fn registered
+        }
+        // intersect / initialize the carried victim set
+        if (!init) {
+          n_victims = 0;
+          for (int64_t i = 0; i < nc; ++i) {
+            in_victims[i] = in_sel[i];
+            if (in_sel[i]) ++n_victims;
+          }
+          init = true;
+        } else {
+          n_victims = 0;
+          for (int64_t i = 0; i < nc; ++i) {
+            in_victims[i] = in_victims[i] && in_sel[i];
+            if (in_victims[i]) ++n_victims;
+          }
+        }
+      }
+      ++t;  // skip tier separator
+      if (n_victims > 0) break;   // first tier boundary with victims
+      if (init) break;            // initialized-empty: poisoned
+    }
+    if (n_victims == 0) continue;
+    // ---- validate_victims: FutureIdle + victims must cover the task.
+    const float* fi_n = fi + n * R;
+    for (int64_t k = 0; k < R; ++k) vsum[k] = fi_n[k];
+    for (int64_t i = 0; i < nc; ++i)
+      if (in_victims[i]) {
+        const float* vreq = req + cand[i] * R;
+        for (int64_t k = 0; k < R; ++k) vsum[k] += vreq[k];
+      }
+    if (!vc_le(init_req, vsum, eps, scalar_slot, R)) continue;
+    // ---- evict victims in order until the reclaimed sum covers
+    // (reclaim.go:160-175; evictions stand even if it never does).
+    for (int64_t k = 0; k < R; ++k) reclaimed[k] = 0.0f;
+    bool covered = false;
+    for (int64_t i = 0; i < nc && !covered; ++i) {
+      if (!in_victims[i]) continue;
+      int64_t r = cand[i];
+      const float* vreq = req + r * R;
+      // session-level evict bookkeeping (fastpath_evict EvictState.evict)
+      p_status[r] = (int16_t)st_releasing;
+      for (int64_t k = 0; k < R; ++k) {
+        n_releasing[n * R + k] += vreq[k];
+        fi[n * R + k] += vreq[k];
+      }
+      int32_t jr = p_job[r];
+      if (jr >= 0) {
+        j_cnt_alloc[jr] -= 1;
+        j_cnt_run[jr] -= 1;
+        j_cnt_releasing[jr] += 1;
+        j_ready_base[jr] -= 1;
+        for (int64_t k = 0; k < R; ++k) j_alloc_res[jr * R + k] -= vreq[k];
+        int32_t vq = q_of_job[jr];
+        if (vq >= 0)
+          for (int64_t k = 0; k < R; ++k) q_alloc[vq * R + k] -= vreq[k];
+      }
+      if (*out_n_evicted < max_evicted)
+        out_evicted[(*out_n_evicted)++] = r;
+      for (int64_t k = 0; k < R; ++k) reclaimed[k] += vreq[k];
+      covered = vc_le(init_req, reclaimed, eps, scalar_slot, R);
+    }
+    if (covered) return n;  // caller pipelines the task here
+  }
+  return -1;
+}
+
+}  // extern "C"

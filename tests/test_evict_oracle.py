@@ -355,3 +355,111 @@ def test_oracle_backfill_parity_with_fast_cycle(monkeypatch):
                           task_group=[0])
     assert got.tolist() == [1]
     assert f"node-{got[0]}" or True  # index 1 == n1 by construction
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fast_vs_object_victims_with_scalar_resources(seed, monkeypatch):
+    """Extended scalar resources ride the reclaim proportion walk
+    (Resource dict-entry semantics — zeroed entries persist, subtrahend
+    keys join the dict): fast (incl. the native engine) and object paths
+    must still agree."""
+    rng = np.random.default_rng(1000 + seed)
+    store = ClusterStore()
+    store.add_priority_class(PriorityClass(name="low", value=100))
+    store.add_priority_class(PriorityClass(name="high", value=10000))
+    store.add_queue(Queue(name="victim", weight=1))
+    store.add_queue(Queue(name="premium", weight=9))
+    for i in range(4):
+        store.add_node(Node(
+            name=f"node-{i:03d}",
+            allocatable={"cpu": "16", "memory": "64Gi",
+                         "tpu.dev/chips": 8},
+        ))
+    g = 0
+    for i in range(4):
+        for s in range(3):
+            chips = int(rng.choice([0, 1, 2]))
+            res = {"cpu": "4", "memory": "8Gi"}
+            if chips:
+                res["tpu.dev/chips"] = chips
+            pg = PodGroup(name=f"fill-{g:03d}", min_member=1,
+                          queue="victim")
+            store.add_pod_group(pg)
+            store.add_pod(Pod(
+                name=f"fill-{g:03d}-0",
+                annotations={GROUP_NAME_ANNOTATION: pg.name},
+                containers=[res],
+                phase=PodPhase.Running, node_name=f"node-{i:03d}",
+                priority_class="low", priority=100,
+            ))
+            g += 1
+    for j in range(3):
+        chips = int(rng.choice([0, 2]))
+        res = {"cpu": "8", "memory": "8Gi"}
+        if chips:
+            res["tpu.dev/chips"] = chips
+        pg = PodGroup(name=f"hi-{j:03d}", min_member=1, queue="premium")
+        store.add_pod_group(pg)
+        store.add_pod(Pod(
+            name=f"hi-{j:03d}-0",
+            annotations={GROUP_NAME_ANNOTATION: pg.name},
+            containers=[res], priority_class="high", priority=10000,
+        ))
+    stores = {}
+    for mode, env in (("fast", "1"), ("object", "0")):
+        import copy as _copy
+        monkeypatch.setenv("VOLCANO_TPU_FASTPATH", env)
+        # Rebuild an identical store per mode from the same seed.
+        if mode == "fast":
+            stores[mode] = store
+        else:
+            rng2 = np.random.default_rng(1000 + seed)
+            s2 = ClusterStore()
+            s2.add_priority_class(PriorityClass(name="low", value=100))
+            s2.add_priority_class(PriorityClass(name="high",
+                                                value=10000))
+            s2.add_queue(Queue(name="victim", weight=1))
+            s2.add_queue(Queue(name="premium", weight=9))
+            for i in range(4):
+                s2.add_node(Node(
+                    name=f"node-{i:03d}",
+                    allocatable={"cpu": "16", "memory": "64Gi",
+                                 "tpu.dev/chips": 8},
+                ))
+            g2 = 0
+            for i in range(4):
+                for s in range(3):
+                    chips = int(rng2.choice([0, 1, 2]))
+                    res = {"cpu": "4", "memory": "8Gi"}
+                    if chips:
+                        res["tpu.dev/chips"] = chips
+                    pg = PodGroup(name=f"fill-{g2:03d}", min_member=1,
+                                  queue="victim")
+                    s2.add_pod_group(pg)
+                    s2.add_pod(Pod(
+                        name=f"fill-{g2:03d}-0",
+                        annotations={GROUP_NAME_ANNOTATION: pg.name},
+                        containers=[res],
+                        phase=PodPhase.Running,
+                        node_name=f"node-{i:03d}",
+                        priority_class="low", priority=100,
+                    ))
+                    g2 += 1
+            for j in range(3):
+                chips = int(rng2.choice([0, 2]))
+                res = {"cpu": "8", "memory": "8Gi"}
+                if chips:
+                    res["tpu.dev/chips"] = chips
+                pg = PodGroup(name=f"hi-{j:03d}", min_member=1,
+                              queue="premium")
+                s2.add_pod_group(pg)
+                s2.add_pod(Pod(
+                    name=f"hi-{j:03d}-0",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[res], priority_class="high",
+                    priority=10000,
+                ))
+            stores[mode] = s2
+        Scheduler(stores[mode], conf_str=EVICT_CONF).run_once()
+    assert (evicted_keys(stores["fast"])
+            == evicted_keys(stores["object"]))

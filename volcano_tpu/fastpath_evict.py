@@ -122,8 +122,18 @@ class EvictState:
         # Callback (set by FastEvictor) keeping aggregate evictable-
         # capacity caches incremental: on_change(row, sign).
         self.on_change = None
+        # Callback (set by FastEvictor) invalidating per-node derived
+        # masks: on_node_change(n) after ANY event touching node n's
+        # fi / evictable state.
+        self.on_node_change = None
         # Per-job mutation stamps (DRF share memoization granularity).
         self.j_version = np.zeros(cyc.Jn, np.int64)
+        # Per-queue mutation stamps (queue-share memoization): bumped
+        # whenever q_alloc[qi] changes.
+        self.q_version = np.zeros(
+            cyc.q_alloc.shape[0] if cyc.q_alloc is not None else 0,
+            np.int64,
+        )
 
     # ------------------------------------------------------------ futures
 
@@ -153,9 +163,12 @@ class EvictState:
             qi = c.q_of_job[jr]
             if qi >= 0:
                 c.q_alloc[qi] -= req
+                self.q_version[qi] += 1
         self.version += 1
         if self.on_change is not None:
             self.on_change(row, -1)
+        if self.on_node_change is not None:
+            self.on_node_change(n)
         if log_ is not None:
             log_.append(("evict", row, n, jr))
 
@@ -176,9 +189,12 @@ class EvictState:
             qi = c.q_of_job[jr]
             if qi >= 0:
                 c.q_alloc[qi] += req
+                self.q_version[qi] += 1
         self.version += 1
         if self.on_change is not None:
             self.on_change(row, 1)
+        if self.on_node_change is not None:
+            self.on_node_change(n)
 
     def pipeline(self, row: int, n: int, log_: Optional[list]) -> None:
         """Session-level pipeline: future capacity claim + share growth
@@ -199,9 +215,12 @@ class EvictState:
             qi = c.q_of_job[jr]
             if qi >= 0:
                 c.q_alloc[qi] += req
+                self.q_version[qi] += 1
         self.version += 1
         self.pipelined_rows.append(row)
         self.node_rows[n].append(row)
+        if self.on_node_change is not None:
+            self.on_node_change(n)
         if log_ is not None:
             log_.append(("pipeline", row, n, jr))
 
@@ -221,12 +240,15 @@ class EvictState:
             qi = c.q_of_job[jr]
             if qi >= 0:
                 c.q_alloc[qi] -= req
+                self.q_version[qi] += 1
         self.version += 1
         self.pipelined_rows.remove(row)
         try:
             self.node_rows[n].remove(row)
         except ValueError:
             pass
+        if self.on_node_change is not None:
+            self.on_node_change(n)
 
     def rollback(self, log_: list) -> None:
         for op in reversed(log_):
@@ -317,9 +339,33 @@ class FastEvictor:
         self._rq_keys: List[tuple] = []
         self._qorder_has_prop = None
         self._zero_nr: Optional[np.ndarray] = None
-        self._slots_cache = None
         self._total_list = None
         self.st.on_change = self._evictable_update
+        # Node-prefilter caches for queue-scoped evict scopes ("pq"/"rq"),
+        # maintained per-node on events:
+        # evict_key -> [N] bool "node has any in-scope evictable capacity"
+        # (evict_key, init_req bytes) -> (init_req, [N] fi+ev fit mask).
+        # Preemptors/reclaimers dedupe by request profile, so the O(N)
+        # prefilter builds once per (scope, profile) instead of per task.
+        # Job-scoped ("job", jr) prefilters are NOT cached (one per job);
+        # they get an O(1) j_cnt_run guard instead.
+        self._ev_any: Dict[tuple, np.ndarray] = {}
+        self._ev_feas: Dict[tuple, tuple] = {}
+        # Pod-count predicate column, maintained per-node (n_ntasks only
+        # changes via pipeline/unpipeline).
+        self._slots_mask: Optional[np.ndarray] = None
+        # Nodes whose fi/evictable/ntasks changed since the cached masks
+        # were last read; fixups are applied in batch at read time
+        # (_apply_dirty) instead of once per event.
+        self._dirty: set = set()
+        self.st.on_node_change = self._dirty.add
+        # Reclaim walk cursors: (evict_key, profile, pred-profile) ->
+        # first node index not yet permanently ruled out.  Valid because
+        # every prefilter component is monotone False-ward within an
+        # evict action (see reclaim()); _apply_dirty rewinds the cursor
+        # on the rare False->True flip (cross-queue victim of a
+        # reclaiming queue).
+        self._walk_cursor: Dict[tuple, int] = {}
         # Tier-ordered plugin-name lists per victim registry (precomputed:
         # the per-victim intersection walks these thousands of times).
         self._tiers_preempt = [
@@ -368,7 +414,11 @@ class FastEvictor:
         c = self.cyc
         m = c.m
         st.fi = c.n_idle + c.n_releasing - st.n_pipelined
-        self._slots_cache = None
+        self._slots_mask = None
+        self._ev_any.clear()
+        self._ev_feas.clear()
+        self._walk_cursor.clear()
+        self._dirty.clear()
         self._share_cache.clear()
         self._qshare_cache.clear()
         self._reclaim_poss_cache = None
@@ -437,7 +487,8 @@ class FastEvictor:
     def _queue_share(self, qi: int) -> float:
         cache = self._qshare_cache
         hit = cache.get(qi)
-        if hit is not None and hit[0] == self.st.version:
+        qv = self.st.q_version[qi] if qi < len(self.st.q_version) else -1
+        if hit is not None and hit[0] == qv:
             return hit[1]
         c = self.cyc
         des = c.q_deserved_res.get(qi)
@@ -451,7 +502,7 @@ class FastEvictor:
             v = _share(alloc.get(rn), des.get(rn))
             if v > s:
                 s = v
-        self._qshare_cache[qi] = (self.st.version, s)
+        self._qshare_cache[qi] = (qv, s)
         return s
 
     def _queue_key(self, qname: str) -> tuple:
@@ -500,12 +551,13 @@ class FastEvictor:
         if static is None:
             static = self._static_mask(feat)
             self._profile_static[pidr] = static
-        slots = self._slots_cache
-        if slots is None or slots[0] != self.st.version:
-            slots = (self.st.version,
-                     (c.n_maxtasks <= 0) | (c.n_ntasks < c.n_maxtasks))
-            self._slots_cache = slots
-        ok = static & slots[1]
+        self._apply_dirty()
+        slots = self._slots_mask
+        if slots is None:
+            slots = self._slots_mask = (
+                (c.n_maxtasks <= 0) | (c.n_ntasks < c.n_maxtasks)
+            )
+        ok = static & slots
         # Host ports.
         if feat.ports:
             myports = set(feat.ports)
@@ -836,6 +888,67 @@ class FastEvictor:
             self._rq_keys.append(key)
         return arr
 
+    def _apply_dirty(self) -> None:
+        """Apply queued per-node fixups to every cached prefilter mask
+        (O(#dirty x #cached entries); dirty is typically 1-2 nodes).
+        A False->True flip rewinds affected walk cursors."""
+        dirty = self._dirty
+        if not dirty:
+            return
+        c = self.cyc
+        st = self.st
+        ev = self._evictable
+        slots = self._slots_mask
+        for n in dirty:
+            if slots is not None:
+                slots[n] = (
+                    c.n_maxtasks[n] <= 0
+                    or c.n_ntasks[n] < c.n_maxtasks[n]
+                )
+            for key, anym in self._ev_any.items():
+                arr = ev.get(key)
+                new = bool((arr[n] > 1e-6).any()) if arr is not None \
+                    else False
+                if new and not anym[n]:
+                    self._rewind_cursors(key, n)
+                anym[n] = new
+            if self._ev_feas:
+                fi_n = st.fi[n]
+                for (key, _), (init_req, mask) in self._ev_feas.items():
+                    arr = ev.get(key)
+                    tot = fi_n + arr[n] if arr is not None else fi_n
+                    ok = (init_req < tot) \
+                        | (np.abs(init_req - tot) < c.eps) \
+                        | (c.scalar_slot & (init_req <= c.eps))
+                    new = bool(ok.all())
+                    if new and not mask[n]:
+                        self._rewind_cursors(key, n)
+                    mask[n] = new
+        dirty.clear()
+
+    def _rewind_cursors(self, evict_key: tuple, n: int) -> None:
+        for wkey, cur in self._walk_cursor.items():
+            if wkey[0] == evict_key and cur > n:
+                self._walk_cursor[wkey] = n
+
+    def _prefilter(self, evict_key: tuple, init_req: np.ndarray,
+                   ev: np.ndarray) -> np.ndarray:
+        """[N] cached necessary-condition mask for a queue-scoped evict
+        scope: node has in-scope victims AND fi + evictable covers the
+        request.  Built once per (scope, request-profile); per-node
+        fixups applied lazily (_apply_dirty)."""
+        self._apply_dirty()
+        anym = self._ev_any.get(evict_key)
+        if anym is None:
+            anym = self._ev_any[evict_key] = (ev > 1e-6).any(axis=1)
+        fkey = (evict_key, init_req.tobytes())
+        ent = self._ev_feas.get(fkey)
+        if ent is None:
+            ent = (init_req.copy(),
+                   self._le_rows(init_req, self.st.fi, ev))
+            self._ev_feas[fkey] = ent
+        return anym & ent[1]
+
     def _evictable_update(self, row: int, sign: int) -> None:
         """Direct-addressed cache update: a Running victim row counts
         toward at most its own ("pq", queue) key (an upper bound — own-job
@@ -991,12 +1104,22 @@ class FastEvictor:
 
         init_req = st.init_req[prow]
         # Necessary-condition prefilter first (cheaper than the full
-        # predicate mask): the node's future idle plus ALL its in-scope
-        # victims' resources must cover the preemptor — otherwise the
-        # exact walk below cannot succeed there.  As victims deplete this
-        # empties and skips the predicate mask wholesale.
-        ev = self._evictable_for(evict_key)
-        feasible = self._le_rows(init_req, st.fi, ev) & c.n_alive
+        # predicate mask): the node must HOLD in-scope victims (an empty
+        # candidate list just `continue`s below) and its future idle
+        # plus ALL its in-scope victims' resources must cover the
+        # preemptor — otherwise the exact walk cannot succeed there.
+        if evict_key[0] == "job":
+            # Intra-job scope: no running members -> no victims anywhere
+            # (O(1), avoids scoring nodes for hopeless preemptors).
+            if c.j_cnt_run[int(evict_key[1])] <= 0:
+                return False
+            ev = self._evictable_for(evict_key)
+            feasible = (ev > 1e-6).any(axis=1) \
+                & self._le_rows(init_req, st.fi, ev) & c.n_alive
+        else:
+            ev = self._evictable_for(evict_key)
+            feasible = self._prefilter(evict_key, init_req, ev) \
+                & c.n_alive
         if not feasible.any():
             return False
         feasible &= self.feasible_mask(prow)
@@ -1187,6 +1310,19 @@ class FastEvictor:
                 tasks_map[jr] = pending
 
         overused = c._overused_fn()
+        nat = self._native_reclaim_setup()
+        try:
+            self._reclaim_loop(queues_pq, jobs_map, tasks_map, overused,
+                               nat)
+        finally:
+            if nat is not None:
+                nat["lib"].vcreclaim_ctx_free(nat["ctx"])
+
+    def _reclaim_loop(self, queues_pq, jobs_map, tasks_map, overused,
+                      nat) -> None:
+        c = self.cyc
+        m = c.m
+        st = self.st
         while not queues_pq.empty():
             qname = queues_pq.pop()
             if overused(c.store.queues[qname]):
@@ -1220,40 +1356,308 @@ class FastEvictor:
             # would skip those collateral evictions and diverge
             # (caught by tests/test_evict_oracle.py fuzz seed 0).
             ev = self._evictable_for(("rq", qname))
-            feasible = self._le_rows(init_req, st.fi, ev)
-            if feasible.any():
-                feasible = feasible & self.feasible_mask(prow)
-            for n in np.flatnonzero(feasible & c.n_alive):
-                n = int(n)
-                cand = []
-                for r in st.node_rows[n]:
-                    if m.p_status[r] != ST_RUNNING or st.req_empty[r]:
-                        continue
-                    vjr = int(m.p_job[r])
-                    if vjr < 0 or m.j_queue[vjr] == qname:
-                        continue
-                    vq = c.store.queues.get(m.j_queue[vjr])
-                    if vq is None or not vq.reclaimable():
-                        continue
-                    cand.append(r)
-                victims = self._victims(prow, cand, "reclaim")
-                if not victims:
-                    continue
-                fut = st.future_idle(n)
-                vsum = st.req[victims].sum(axis=0)
-                if not _vec_le(init_req, fut + vsum, c.eps, c.scalar_slot):
-                    continue
-                reclaimed = np.zeros(c.R, F)
-                for r in victims:
-                    st.evict(r, None)
-                    st.evicted_rows.append(r)
-                    reclaimed += st.req[r]
-                    if _vec_le(init_req, reclaimed, c.eps, c.scalar_slot):
+            # Victim-less nodes drop out entirely (validate_victims
+            # raises "no victims" there); exhausted nodes thus stop
+            # costing their Python candidate walk as victims deplete.
+            # Cached per (scope, request-profile), maintained per-node.
+            comb = self._prefilter(("rq", qname), init_req, ev)
+            # Reclaim walks nodes in insertion (= index) order
+            # (reclaim.go `for _, n := range ssn.Nodes`).  Every cheap
+            # prefilter component only flips False-ward while the action
+            # runs (evicting an in-scope victim keeps fi+ev constant;
+            # pipelines shrink fi; pod-count only grows; static masks
+            # are constant), so nodes ruled out by THESE masks are ruled
+            # out for every later reclaimer of the same (scope, profile)
+            # — a persistent cursor skips them once instead of scanning
+            # [N] per task.  _apply_dirty rewinds it on the rare
+            # False->True flip.  Nodes failing only the exact per-node
+            # walk (victim narrowing) are NOT skipped by the cursor.
+            feat = m.p_feat[prow]
+            pidr = int(m.p_prof[prow])
+            has_pred = c._has("predicates")
+            static = None
+            if has_pred:
+                static = self._profile_static.get(pidr)
+                if static is None:
+                    static = self._static_mask(feat)
+                    self._profile_static[pidr] = static
+            plain_feat = not (feat.ports or feat.ip_req_aff
+                              or feat.ip_req_anti)
+            if has_pred and c.store.pods.get(m.p_uid[prow]) is None:
+                # feasible_mask's ghost-task guard: a pending row with no
+                # live pod record schedules nowhere.
+                queues_pq.push(qname)
+                continue
+            if plain_feat:
+                wkey = (("rq", qname), init_req.tobytes(), pidr)
+                slots = self._slots_mask
+                if slots is None and has_pred:
+                    slots = self._slots_mask = (
+                        (c.n_maxtasks <= 0) | (c.n_ntasks < c.n_maxtasks)
+                    )
+                qid = c.queue_index.get(qname, -1)
+                if nat is not None and qid >= 0:
+                    assigned = self._native_reclaim_step(
+                        nat, prow, qid, init_req, wkey, static, slots,
+                        comb, qname,
+                    )
+                else:
+                    assigned = self._python_reclaim_walk(
+                        prow, init_req, qname, wkey, comb, static, slots,
+                    )
+            else:
+                feasible = comb
+                if feasible.any():
+                    feasible = feasible & self.feasible_mask(prow)
+                for n in np.flatnonzero(feasible & c.n_alive):
+                    if self._reclaim_node(prow, init_req, qname,
+                                          int(n)):
+                        assigned = True
                         break
-                if _vec_le(init_req, reclaimed, c.eps, c.scalar_slot):
-                    st.pipeline(prow, n, None)
-                    assigned = True
-                    break
             if assigned:
                 jobs.push(jr)
             queues_pq.push(qname)
+
+    def _python_reclaim_walk(self, prow: int, init_req: np.ndarray,
+                             qname: str, wkey, comb, static,
+                             slots) -> bool:
+        """Cursor walk over nodes in index order (the exact fallback for
+        the C engine; identical semantics)."""
+        c = self.cyc
+        n = self._walk_cursor.get(wkey, 0)
+        advancing = True
+        n_alive = c.n_alive
+        Nn = c.Nn
+        while n < Nn:
+            if not (comb[n] and n_alive[n]
+                    and (static is None or (static[n] and slots[n]))):
+                n += 1
+                if advancing:
+                    self._walk_cursor[wkey] = n
+                continue
+            advancing = False
+            if self._reclaim_node(prow, init_req, qname, n):
+                return True
+            n += 1
+        return False
+
+    def _reclaim_node(self, prow: int, init_req: np.ndarray,
+                      qname: str, n: int) -> bool:
+        """The exact per-node reclaim walk (reclaim.go:136-175): collect
+        cross-queue Running candidates of reclaimable queues, narrow via
+        the tiered Reclaimable intersection, validate, evict victims in
+        order until the reclaimed sum covers the task, pipeline iff it
+        does.  Returns True when the task pipelined on this node."""
+        c = self.cyc
+        m = c.m
+        st = self.st
+        from .fastpath import _vec_le
+
+        cand = []
+        for r in st.node_rows[n]:
+            if m.p_status[r] != ST_RUNNING or st.req_empty[r]:
+                continue
+            vjr = int(m.p_job[r])
+            if vjr < 0 or m.j_queue[vjr] == qname:
+                continue
+            vq = c.store.queues.get(m.j_queue[vjr])
+            if vq is None or not vq.reclaimable():
+                continue
+            cand.append(r)
+        victims = self._victims(prow, cand, "reclaim")
+        if not victims:
+            return False
+        fut = st.future_idle(n)
+        vsum = st.req[victims].sum(axis=0)
+        if not _vec_le(init_req, fut + vsum, c.eps, c.scalar_slot):
+            return False
+        reclaimed = np.zeros(c.R, F)
+        for r in victims:
+            st.evict(r, None)
+            st.evicted_rows.append(r)
+            reclaimed += st.req[r]
+            if _vec_le(init_req, reclaimed, c.eps, c.scalar_slot):
+                break
+        if _vec_le(init_req, reclaimed, c.eps, c.scalar_slot):
+            st.pipeline(prow, n, None)
+            return True
+        return False
+
+    # ------------------------------------------------- native reclaim core
+
+    _NATIVE_MAX_CAND = 512  # VC_MAX_CAND in csrc/vcsnap.cc
+
+    def _native_reclaim_setup(self):
+        """Prepare the dense context for the C reclaim step
+        (csrc/vcsnap.cc vcreclaim_step) — or None to use the Python
+        walk.  The C side mutates the SAME numpy buffers the Python
+        bookkeeping uses, so the two paths are interchangeable
+        per-reclaimer."""
+        c = self.cyc
+        st = self.st
+        m = c.m
+        if c.R > 8:
+            return None
+        from .native import reclaim_lib
+
+        lib = reclaim_lib()
+        if lib is None:
+            return None
+        # Degenerate nodes (> C scratch capacity) use the Python walk
+        # for the whole action to keep mid-walk state exact.
+        max_res = max((len(r) for r in st.node_rows), default=0)
+        if max_res > self._NATIVE_MAX_CAND:
+            return None
+        # Contiguity: some cycle arrays are views; the C engine needs
+        # C-order buffers, and replacing the attribute keeps them live
+        # for the Python side too.
+        for name in ("j_cnt_alloc", "j_cnt_run", "j_cnt_releasing",
+                     "j_ready_base", "q_of_job"):
+            arr = getattr(c, name)
+            if not arr.flags["C_CONTIGUOUS"] or arr.dtype != np.int32:
+                setattr(c, name, np.ascontiguousarray(arr, np.int32))
+        if not c.j_alloc_res.flags["C_CONTIGUOUS"]:
+            c.j_alloc_res = np.ascontiguousarray(c.j_alloc_res)
+        if not c.q_alloc.flags["C_CONTIGUOUS"]:
+            c.q_alloc = np.ascontiguousarray(c.q_alloc)
+        if not st.fi.flags["C_CONTIGUOUS"]:
+            st.fi = np.ascontiguousarray(st.fi)
+        if not c.n_releasing.flags["C_CONTIGUOUS"]:
+            c.n_releasing = np.ascontiguousarray(c.n_releasing)
+        # Resident CSR (row order = NodeInfo.tasks iteration order).
+        counts = [len(r) for r in st.node_rows]
+        node_ptr = np.zeros(c.Nn + 1, np.int64)
+        np.cumsum(counts, out=node_ptr[1:])
+        flat = np.fromiter(
+            (r for rows in st.node_rows for r in rows),
+            np.int64, count=int(node_ptr[-1]),
+        )
+        Q = len(c.queue_names)
+        q_rec = np.zeros(Q, np.uint8)
+        for qi, qname in enumerate(c.queue_names):
+            q = c.store.queues.get(qname)
+            q_rec[qi] = bool(q is not None and q.reclaimable())
+        q_des = np.zeros((Q, c.R), np.float32)
+        q_has = np.zeros(Q, np.uint8)
+        for qi, res in c.q_deserved_res.items():
+            q_has[qi] = 1
+            q_des[qi] = c._slots_vec(res)
+        tiers = []
+        ids = {"gang": 0, "conformance": 1, "proportion": 2}
+        for tier in self._tiers_reclaim:
+            for pname in tier:
+                if pname in ids:
+                    tiers.append(ids[pname])
+            tiers.append(-1)
+        # Keep references to every array the C context captures: the
+        # context holds raw pointers, so anything here being collected
+        # or reallocated would leave it dangling.
+        nat = {
+            "lib": lib,
+            "node_ptr": node_ptr,
+            "node_rows": flat,
+            "p_status": m.p_status,
+            "p_job": np.ascontiguousarray(m.p_job, np.int32),
+            "req": st.req,
+            "req_empty": np.ascontiguousarray(
+                st.req_empty.view(np.uint8)),
+            "critical": np.ascontiguousarray(st.critical.view(np.uint8)),
+            "j_minav": np.ascontiguousarray(m.j_minav, np.int32),
+            "q_rec": q_rec,
+            "q_des": q_des,
+            "q_has": q_has,
+            "tiers": np.asarray(tiers, np.int32),
+            "eps": np.ascontiguousarray(c.eps, np.float32),
+            "scalar_slot": np.ascontiguousarray(
+                c.scalar_slot.view(np.uint8)),
+            "alive": np.ascontiguousarray(c.n_alive.view(np.uint8)),
+            "init_req_base": st.init_req,
+            "ones": np.ones(c.Nn, np.uint8),
+            "cursor_buf": np.zeros(1, np.int64),
+            # Sized so one step can never overflow it: a step evicts a
+            # row at most once, and rows < Pn.
+            "out_rows": np.zeros(max(c.Pn, 1), np.int64),
+            "out_n": np.zeros(1, np.int64),
+            # Mutable cycle arrays the ctx points into (pin them too).
+            "pins": (c.j_ready_base, c.j_cnt_alloc, c.j_cnt_run,
+                     c.j_cnt_releasing, c.j_alloc_res, c.q_of_job,
+                     c.q_alloc, st.fi, c.n_releasing),
+        }
+        d = lambda a: a.ctypes.data
+        (j_ready_base, j_cnt_alloc, j_cnt_run, j_cnt_releasing,
+         j_alloc_res, q_of_job, q_alloc, fi, n_releasing) = nat["pins"]
+        nat["ctx"] = lib.vcreclaim_ctx_new(
+            d(node_ptr), d(flat),
+            d(nat["p_status"]), d(nat["p_job"]),
+            d(nat["req"]), d(nat["req_empty"]), d(nat["critical"]),
+            d(nat["j_minav"]), d(j_ready_base),
+            d(j_cnt_alloc), d(j_cnt_run), d(j_cnt_releasing),
+            d(j_alloc_res), d(q_of_job),
+            d(q_rec), d(q_alloc), d(q_des), d(q_has),
+            d(fi), d(n_releasing),
+            d(nat["tiers"]), len(nat["tiers"]),
+            d(nat["eps"]), d(nat["scalar_slot"]),
+            d(nat["alive"]), d(nat["init_req_base"]),
+            c.Nn, c.R, ST_RUNNING, ST_RELEASING,
+        )
+        nat["step"] = lib.vcreclaim_step
+        nat["cur_addr"] = nat["cursor_buf"].ctypes.data
+        nat["out_addr"] = nat["out_rows"].ctypes.data
+        nat["out_n_addr"] = nat["out_n"].ctypes.data
+        return nat
+
+    def _native_reclaim_step(self, nat, prow: int, qid: int,
+                             init_req: np.ndarray, wkey, static, slots,
+                             comb, qname: str) -> bool:
+        """Run one reclaimer through the C engine; apply the Python-side
+        bookkeeping the C core does not own (evicted-row caches, event
+        versioning, dirty marking, the pipeline)."""
+        c = self.cyc
+        st = self.st
+        m = c.m
+        cur = nat["cursor_buf"]
+        cur[0] = self._walk_cursor.get(wkey, 0)
+        out_n = nat["out_n"]
+        out_n[0] = 0
+        # Mask addresses are stable per (scope, profile); resolve once.
+        addrs = nat.setdefault("addrs", {})
+        ap = addrs.get(wkey)
+        if ap is None:
+            ap = (
+                self._ev_any[wkey[0]].ctypes.data,
+                self._ev_feas[(wkey[0], wkey[1])][1].ctypes.data,
+                (static if static is not None
+                 else nat["ones"]).ctypes.data,
+                (slots if slots is not None
+                 else nat["ones"]).ctypes.data,
+            )
+            addrs[wkey] = ap
+        node = nat["step"](
+            nat["ctx"], prow, qid, nat["cur_addr"],
+            ap[0], ap[1], ap[2], ap[3],
+            nat["out_addr"], nat["out_n_addr"], len(nat["out_rows"]),
+        )
+        self._walk_cursor[wkey] = int(cur[0])
+        n_ev = int(nat["out_n"][0])
+        if n_ev:
+            rows = nat["out_rows"][:n_ev]
+            st.version += n_ev
+            for r in rows.tolist():
+                st.evicted_rows.append(r)
+                jr = int(m.p_job[r])
+                if jr >= 0:
+                    st.j_version[jr] += 1
+                    qi = int(c.q_of_job[jr])
+                    if 0 <= qi < len(st.q_version):
+                        st.q_version[qi] += 1
+                self._evictable_update(r, -1)
+                self._dirty.add(int(m.p_node[r]))
+        if node == -2:
+            # C scratch overflow (should be prevented by setup): finish
+            # this reclaimer on the exact Python walk.
+            return self._python_reclaim_walk(prow, init_req, qname,
+                                             wkey, comb, static, slots)
+        if node >= 0:
+            st.pipeline(prow, int(node), None)
+            return True
+        return False

@@ -52,6 +52,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.vcsnap_less_equal.argtypes = [
         _f32p, _f32p, _f32p, _u8p, ctypes.c_int64, ctypes.c_int32, _u8p,
     ]
+    # Reclaim engine: all stable pointers are captured once into a C-side
+    # context; the hot per-reclaimer call takes raw addresses (c_void_p)
+    # to keep ctypes marshalling off the 20k-calls-per-cycle path.
+    vp = ctypes.c_void_p
+    ll = ctypes.c_longlong
+    lib.vcreclaim_ctx_new.restype = vp
+    lib.vcreclaim_ctx_new.argtypes = [vp] * 20 + [vp, ll] + [vp] * 4 + \
+        [ll, ll, ll, ll]
+    lib.vcreclaim_ctx_free.argtypes = [vp]
+    lib.vcreclaim_step.restype = ll
+    lib.vcreclaim_step.argtypes = [
+        vp, ll, ll,  # ctx prow qid
+        vp,  # cursor
+        vp, vp, vp, vp,  # anym feas stat slots
+        vp, vp, ll,  # out_evicted out_n max
+    ]
     return lib
 
 
@@ -73,7 +89,10 @@ def _load() -> Optional[ctypes.CDLL]:
                 try:
                     _LIB = _bind(ctypes.CDLL(str(path)))
                     return _LIB
-                except OSError as err:
+                except (OSError, AttributeError) as err:
+                    # AttributeError: stale prebuilt library missing a
+                    # newer symbol — fall through to the rebuild.
+                    _LIB = None
                     log.warning("vcsnap load failed (%s): %s", path, err)
         # Build on first use.
         try:
@@ -83,7 +102,8 @@ def _load() -> Optional[ctypes.CDLL]:
             )
             _LIB = _bind(ctypes.CDLL(str(_CSRC / "libvcsnap.so")))
             log.info("built native vcsnap serializer")
-        except (OSError, subprocess.SubprocessError) as err:
+        except (OSError, AttributeError, subprocess.SubprocessError) as err:
+            _LIB = None
             log.warning("vcsnap build failed, using NumPy fallback: %s", err)
         return _LIB
 
@@ -174,3 +194,12 @@ def less_equal_rows(l: np.ndarray, rhs: np.ndarray, eps: np.ndarray,
     per = (l < rhs[None, :]) | (np.abs(l - rhs[None, :]) < eps[None, :])
     per |= (np.asarray(scalar_slot, bool)[None, :] & (l <= eps[None, :]))
     return np.all(per, axis=-1)
+
+
+def reclaim_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library with ``vcreclaim_step`` bound, or None
+    (caller falls back to the Python walk in fastpath_evict)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "vcreclaim_step"):
+        return None
+    return lib
