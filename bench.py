@@ -57,11 +57,14 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
     warm-up compiles, then fresh stores of the same shape hit the jit cache."""
     from volcano_tpu.scheduler import Scheduler
 
-    store = warm_store if warm_store is not None else make_store(0)
     # Bind dispatch is async in production (the reference's goroutine
     # binds are not part of its e2e cycle latency either); binds are
-    # flushed after timing before counting.
-    store.async_bind = True
+    # flushed after timing before counting.  BENCH_SYNC_BIND=1 keeps the
+    # binder calls inside the timed cycle — the control run quantifying
+    # the measurement-boundary change.
+    async_bind = os.environ.get("BENCH_SYNC_BIND") != "1"
+    store = warm_store if warm_store is not None else make_store(0)
+    store.async_bind = async_bind
     binder = store.binder
     t0 = time.perf_counter()
     Scheduler(store, conf_str=conf).run_once()
@@ -71,20 +74,31 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
     evicted = len(getattr(store.evictor, "evicts", []))
 
     times = []
+    lanes_best = None
     for r in range(repeats):
         store_r = make_store(r + 1)
-        store_r.async_bind = True
+        store_r.async_bind = async_bind
         sched_r = Scheduler(store_r, conf_str=conf)
         t0 = time.perf_counter()
         sched_r.run_once()
         times.append(time.perf_counter() - t0)
+        if times[-1] == min(times):
+            lanes_best = getattr(store_r, "last_cycle_lanes", None)
         store_r.flush_binds()
         # The dispatcher thread's callbacks pin the store; stop it so the
         # repeat's full mirror is actually freed.
         store_r.close()
         del store_r, sched_r
     e2e_ms = min(times) * 1e3 if times else warm_s * 1e3
-    return e2e_ms, bound, evicted, warm_s, times
+    return e2e_ms, bound, evicted, warm_s, times, lanes_best
+
+
+def _lane_note(lanes) -> str:
+    if not lanes:
+        return ""
+    parts = [f"{k}={v * 1e3:.0f}ms" for k, v in
+             sorted(lanes.items(), key=lambda kv: -kv[1]) if v >= 5e-4]
+    return " lanes[" + " ".join(parts) + "]"
 
 
 CONF_BASE = """
@@ -177,7 +191,7 @@ def config_2(n_nodes, n_pods, gang, repeats):
     build_t0 = time.perf_counter()
     store = synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods, gang_size=gang)
     build_s = time.perf_counter() - build_t0
-    e2e_ms, bound, _, warm_s, times = _cycle_bench(
+    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(
         lambda r: synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods,
                                     gang_size=gang, seed=r),
         CONF_BASE, repeats, warm_store=store,
@@ -188,7 +202,8 @@ def config_2(n_nodes, n_pods, gang, repeats):
         e2e_ms, n_pods,
         f"warmup={warm_s:.2f}s bound={bound} "
         f"pods/s={bound / (e2e_ms / 1e3):.0f} build={build_s:.2f}s "
-        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
     )
 
 
@@ -201,12 +216,13 @@ def config_3(repeats):
         n_nodes=n_nodes, n_pods=n_pods, n_queues=4,
         queue_weights=(1, 2, 4, 8), gang_sizes=(2, 4, 8, 16), seed=r,
     )
-    e2e_ms, bound, _, warm_s, times = _cycle_bench(mk, CONF_BASE, repeats)
+    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(mk, CONF_BASE, repeats)
     _emit(
         f"DRF multi-queue e2e @ {n_nodes} nodes x {n_pods} pods, 4 queues",
         e2e_ms, n_pods,
         f"warmup={warm_s:.2f}s bound={bound} "
-        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
     )
 
 
@@ -217,14 +233,15 @@ def config_4(repeats):
     n_pending = int(os.environ.get("BENCH_PODS", 20000))
     mk = lambda r: preempt_cluster(n_nodes=n_nodes, n_pending=n_pending,
                                    seed=r)
-    e2e_ms, bound, evicted, warm_s, times = _cycle_bench(
+    e2e_ms, bound, evicted, warm_s, times, lanes = _cycle_bench(
         mk, CONF_PREEMPT, repeats)
     _emit(
         f"preempt+reclaim e2e @ {n_nodes} nodes oversubscribed, "
         f"{n_pending} pending high-pri pods",
         e2e_ms, n_pending,
         f"warmup={warm_s:.2f}s bound={bound} evicted={evicted} "
-        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
     )
 
 
@@ -239,13 +256,14 @@ def config_5(repeats):
         affinity_fraction=0.05, anti_affinity_fraction=0.05,
         spread_fraction=0.1, seed=r,
     )
-    e2e_ms, bound, _, warm_s, times = _cycle_bench(mk, CONF_BASE, repeats)
+    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(mk, CONF_BASE, repeats)
     _emit(
         f"hyperscale binpack+affinity e2e @ {n_nodes} nodes x "
         f"{n_pods} pods",
         e2e_ms, n_pods,
         f"warmup={warm_s:.2f}s bound={bound} "
-        f"cycles_ms={[round(t * 1e3, 1) for t in times]}",
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
     )
 
 

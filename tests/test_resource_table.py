@@ -1,0 +1,229 @@
+"""Resource arithmetic tables (the resource_info_test.go shape, 574 LoC
+in the reference — every comparison/arithmetic rule as an asserting
+case, including the scalar-dict edge semantics the fit decisions load-
+bear on: epsilon quanta, nil-vs-empty scalar dicts, sub's early return,
+and the MIN_MILLI_SCALAR pass in less())."""
+
+import pytest
+
+from volcano_tpu.api.resource import (
+    MIN_MEMORY,
+    MIN_MILLI_CPU,
+    MIN_MILLI_SCALAR,
+    Resource,
+    parse_quantity,
+    res_min,
+    share,
+)
+
+GPU = "nvidia.com/gpu"
+Mi = 1024.0 * 1024.0
+
+
+def R(cpu=0.0, mem=0.0, **scalars):
+    r = Resource(cpu, mem)
+    for k, v in scalars.items():
+        r.set_scalar(k.replace("__", "/").replace("_", "."), v)
+    return r
+
+
+def G(cpu=0.0, mem=0.0, gpu=None):
+    r = Resource(cpu, mem)
+    if gpu is not None:
+        r.set_scalar(GPU, gpu)
+    return r
+
+
+# ---- less_equal (epsilon-tolerant fit, resource_info.go:286-320) ----
+
+LESS_EQUAL_CASES = [
+    ("equal", G(4000, 4000), G(4000, 4000), True),
+    ("all-below", G(3000, 3000), G(4000, 4000), True),
+    ("cpu-above", G(5000, 3000), G(4000, 4000), False),
+    ("mem-above", G(3000, 5000 * Mi), G(4000, 4000 * Mi), False),
+    ("cpu-within-quantum", G(4000 + MIN_MILLI_CPU / 2, 4000),
+     G(4000, 4000), True),
+    ("cpu-at-quantum", G(4000 + MIN_MILLI_CPU, 4000),
+     G(4000, 4000), False),
+    ("mem-within-quantum", G(4000, 4000 + MIN_MEMORY / 2),
+     G(4000, 4000), True),
+    ("mem-at-quantum", G(4000, 4000 + MIN_MEMORY), G(4000, 4000), False),
+    ("gpu-below", G(1000, 1000, gpu=2), G(4000, 4000, gpu=4), True),
+    ("gpu-above", G(1000, 1000, gpu=8000), G(4000, 4000, gpu=4000), False),
+    # A scalar request of at most one quantum always fits.
+    ("gpu-single-quantum-fits-nothing",
+     G(1000, 1000, gpu=MIN_MILLI_SCALAR), G(4000, 4000), True),
+    ("gpu-missing-on-right", G(1000, 1000, gpu=2 * MIN_MILLI_SCALAR),
+     G(4000, 4000), False),
+    ("zero-fits-zero", G(), G(), True),
+]
+
+
+@pytest.mark.parametrize("name,l,r,want", LESS_EQUAL_CASES,
+                         ids=[c[0] for c in LESS_EQUAL_CASES])
+def test_less_equal(name, l, r, want):
+    assert l.less_equal(r) is want
+
+
+# ---- less (strict, resource_info.go:226-261) ----
+
+LESS_CASES = [
+    ("all-strictly-below", G(3000, 3000), G(4000, 4000), True),
+    ("equal-not-less", G(4000, 4000), G(4000, 4000), False),
+    ("cpu-equal-blocks", G(4000, 3000), G(4000, 4000 * Mi), False),
+    # nil self scalars vs rhs scalars above the quantum: allowed.
+    ("nil-self-scalars-rhs-large", G(1, 1), G(2, 2, gpu=100), True),
+    # rhs scalar at/below one quantum blocks the nil-self branch.
+    ("nil-self-scalars-rhs-quantum", G(1, 1),
+     G(2, 2, gpu=MIN_MILLI_SCALAR), False),
+    ("self-scalars-rhs-nil", G(1, 1, gpu=1), G(2, 2), False),
+    ("scalar-strictly-below", G(1, 1, gpu=1), G(2, 2, gpu=2), True),
+    ("scalar-equal-blocks", G(1, 1, gpu=2), G(2, 2, gpu=2), False),
+    # Missing key on rhs reads as 0.
+    ("scalar-missing-on-rhs", R(1, 1, a__b=1),
+     R(2, 2, c__d=5), False),
+]
+
+
+@pytest.mark.parametrize("name,l,r,want", LESS_CASES,
+                         ids=[c[0] for c in LESS_CASES])
+def test_less(name, l, r, want):
+    assert l.less(r) is want
+
+
+# ---- less_equal_strict (no epsilon, resource_info.go:264-283) ----
+
+LES_CASES = [
+    ("equal", G(4000, 4000), G(4000, 4000), True),
+    ("cpu-above-by-epsilon", G(4000 + 1, 4000), G(4000, 4000), False),
+    ("scalar-equal", G(1, 1, gpu=2), G(1, 1, gpu=2), True),
+    ("scalar-above", G(1, 1, gpu=3), G(1, 1, gpu=2), False),
+    ("self-scalar-vs-missing", G(1, 1, gpu=1), G(1, 1), False),
+    ("zero-scalar-entry-vs-missing", G(1, 1, gpu=0), G(1, 1), True),
+]
+
+
+@pytest.mark.parametrize("name,l,r,want", LES_CASES,
+                         ids=[c[0] for c in LES_CASES])
+def test_less_equal_strict(name, l, r, want):
+    assert l.less_equal_strict(r) is want
+
+
+# ---- add / sub (resource_info.go:118-159) ----
+
+def test_add_merges_scalars():
+    a = G(1000, 1000, gpu=1)
+    b = Resource(2000, 2000)
+    b.set_scalar("gpu.x", 3)
+    a.add(b)
+    assert a.milli_cpu == 3000 and a.memory == 3000
+    assert a.scalars[GPU] == 1 and a.scalars["gpu.x"] == 3
+
+
+def test_add_into_nil_scalars():
+    a = G(1000, 1000)
+    a.add(G(1, 1, gpu=2))
+    assert a.scalars == {GPU: 2}
+
+
+def test_sub_keeps_zeroed_entries():
+    a = G(4000, 4000, gpu=2)
+    a.sub(G(1000, 1000, gpu=2))
+    # The zeroed entry STAYS in the dict — load-bearing for less()'s
+    # nil-vs-empty branch (proportion reclaim semantics).
+    assert a.scalars == {GPU: 0.0}
+
+
+def test_sub_on_nil_scalars_early_returns():
+    # sub with self.scalars None skips scalar subtraction entirely
+    # (resource.py:132-134) — the subtrahend's scalars must be within
+    # epsilon for the sufficiency assert to pass.
+    a = G(4000, 4000)
+    a.sub(G(1000, 1000, gpu=MIN_MILLI_SCALAR / 2))
+    assert a.scalars is None
+    assert a.milli_cpu == 3000
+
+
+def test_sub_asserts_sufficiency():
+    a = G(1000, 1000)
+    with pytest.raises(AssertionError):
+        a.sub(G(2000, 1000))
+
+
+def test_sub_adds_missing_keys():
+    a = G(4000, 4000, gpu=2)
+    b = R(0, 0, other_res=0.0)
+    a.sub(b)
+    assert a.scalars["other.res"] == 0.0
+
+
+# ---- is_empty / is_zero (resource_info.go:92-116) ----
+
+def test_is_empty_quantum_tolerance():
+    assert G(MIN_MILLI_CPU / 2, MIN_MEMORY / 2,
+             gpu=MIN_MILLI_SCALAR / 2).is_empty()
+    assert not G(MIN_MILLI_CPU * 2, 0).is_empty()
+    assert not G(0, 0, gpu=MIN_MILLI_SCALAR).is_empty()
+
+
+def test_is_zero_per_dimension():
+    r = G(MIN_MILLI_CPU / 2, MIN_MEMORY * 2, gpu=MIN_MILLI_SCALAR / 2)
+    assert r.is_zero("cpu")
+    assert not r.is_zero("memory")
+    assert r.is_zero(GPU)
+    # Unknown scalar name counts as zero (no entry).
+    assert G(0, 0).is_zero(GPU)
+
+
+# ---- set_max_resource / diff / fit_delta / multi / res_min / share ----
+
+def test_set_max_resource():
+    a = G(1000, 4000, gpu=1)
+    a.set_max_resource(G(2000, 3000, gpu=4))
+    assert (a.milli_cpu, a.memory, a.scalars[GPU]) == (2000, 4000, 4)
+
+
+def test_diff_splits_increase_and_decrease():
+    a = G(3000, 1000, gpu=4)
+    b = G(1000, 2000, gpu=1)
+    inc, dec = a.diff(b)
+    assert inc.milli_cpu == 2000 and inc.memory == 0
+    assert inc.scalars[GPU] == 3
+    assert dec.milli_cpu == 0 and dec.memory == 1000
+
+
+def test_multi_scales_everything():
+    a = G(1000, 2000, gpu=2).multi(2.5)
+    assert (a.milli_cpu, a.memory, a.scalars[GPU]) == (2500, 5000, 5)
+
+
+def test_res_min():
+    m = res_min(G(1000, 4000, gpu=3), G(2000, 3000, gpu=1))
+    assert (m.milli_cpu, m.memory, m.scalars[GPU]) == (1000, 3000, 1)
+
+
+def test_share_zero_denominator():
+    assert share(0.0, 0.0) == 0.0
+    assert share(5.0, 0.0) == 1.0
+    assert share(5.0, 10.0) == 0.5
+
+
+# ---- parsing (kube resource.Quantity grammar subset) ----
+
+PARSE_CASES = [
+    ("1", 1.0),
+    ("100m", 0.1),
+    ("1500m", 1.5),
+    ("1Gi", float(1024 ** 3)),
+    ("512Mi", 512 * Mi),
+    ("1G", 1e9),
+    ("2.5", 2.5),
+    (3, 3.0),
+    (2.5, 2.5),
+]
+
+
+@pytest.mark.parametrize("q,want", PARSE_CASES,
+                         ids=[str(c[0]) for c in PARSE_CASES])
+def test_parse_quantity(q, want):
+    assert parse_quantity(q) == pytest.approx(want)

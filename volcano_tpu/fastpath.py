@@ -601,12 +601,24 @@ class FastCycle:
         if not hasattr(store, "_phase_dirty_uids"):
             store._phase_dirty_uids = set()
         self._phase_dirty = store._phase_dirty_uids
+        # Per-lane wall-clock breakdown of this cycle (seconds),
+        # published as store.last_cycle_lanes for bench.py / operators:
+        # derive (mirror -> cycle arrays), order/pending (job ordering +
+        # row prep), encode (solver input build), device (solve dispatch
+        # + device->host fetch), commit, evict actions, close.
+        self.lanes: Dict[str, float] = {}
+        # Clear immediately: a failed cycle (slow-path fallback) must not
+        # leave a previous cycle's breakdown masquerading as its own.
+        store.last_cycle_lanes = None
+        t0 = time.perf_counter()
         self.derive()
         self._proportion()
+        self.lanes["derive"] = time.perf_counter() - t0
         self.new_conditions: Dict[int, PodGroupCondition] = {}
         self._evictor = None
         try:
             for name in self.action_names:
+                t0 = time.perf_counter()
                 with metrics.action_timer(name):
                     if name == "enqueue":
                         self._enqueue()
@@ -618,6 +630,11 @@ class FastCycle:
                         self._evict_machinery().preempt()
                     elif name == "reclaim":
                         self._evict_machinery().reclaim()
+                if name in ("preempt", "reclaim", "enqueue", "backfill"):
+                    self.lanes[name] = (
+                        self.lanes.get(name, 0.0)
+                        + time.perf_counter() - t0
+                    )
         except BaseException:
             # A failed cycle may leave uncommitted status mutations in the
             # mirror (evictions mid-statement); re-derive dynamic state
@@ -626,7 +643,10 @@ class FastCycle:
             raise
         if self._evictor is not None:
             self._evictor.st.flush()
+        t0 = time.perf_counter()
         self._close()
+        self.lanes["close"] = time.perf_counter() - t0
+        store.last_cycle_lanes = dict(self.lanes)
 
     def _evict_machinery(self):
         self._flush_aggr()
@@ -846,19 +866,26 @@ class FastCycle:
         max_rounds = max(rounds, 1) + (3 if solver == "wave" else 0)
         solve_fn = solve_wave if solver == "wave" else solve
 
+        lanes = self.lanes
         retry = False
         for rnd in range(max_rounds):
             if rnd >= max(rounds, 1) and not retry:
                 break
+            t_ord = time.perf_counter()
             ordered = self._ordered_jobs()
             prep = self._pending_rows(ordered)
+            lanes["order"] = (lanes.get("order", 0.0)
+                              + time.perf_counter() - t_ord)
             if prep is None:
                 return
             solve_jobs, task_rows = prep
             progress_any = False
             never_any = False
             for cjobs, crows in self._solve_chunks(solve_jobs, task_rows):
+                t_enc = time.perf_counter()
                 inputs, pid, profiles = self._solve_inputs(cjobs, crows)
+                lanes["encode"] = (lanes.get("encode", 0.0)
+                                   + time.perf_counter() - t_enc)
                 t0 = time.perf_counter()
                 if solver == "wave":
                     result = solve_fn(*inputs, pid=pid, profiles=profiles)
@@ -884,13 +911,16 @@ class FastCycle:
                      result.fit_failed)
                 )
                 assigned = assigned[:len(crows)]
-                metrics.device_solve_latency.observe(
-                    (time.perf_counter() - t0) * 1e3
-                )
+                dt_dev = time.perf_counter() - t0
+                lanes["device"] = lanes.get("device", 0.0) + dt_dev
+                metrics.device_solve_latency.observe(dt_dev * 1e3)
+                t_cm = time.perf_counter()
                 progress = self._commit(
                     cjobs, crows, assigned, never_ready, fit_failed,
                     req_gather,
                 )
+                lanes["commit"] = (lanes.get("commit", 0.0)
+                                   + time.perf_counter() - t_cm)
                 progress_any |= progress
                 never_any |= bool(never_ready.any())
             retry = never_any and progress_any

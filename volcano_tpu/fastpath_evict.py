@@ -421,6 +421,8 @@ class FastEvictor:
         self._dirty.clear()
         self._share_cache.clear()
         self._qshare_cache.clear()
+        if hasattr(self, "_jkey_cache"):
+            self._jkey_cache.clear()
         self._reclaim_poss_cache = None
         # Rebuild the per-node resident lists (allocate binds appear as
         # new residents; the host-port predicate walks these).  Session
@@ -447,7 +449,16 @@ class FastEvictor:
     def _job_key(self, jr: int) -> tuple:
         """Live tier-ordered job sort key (shares move during the action,
         so _LazyHeap re-derives this on pop).  Lexicographic order of the
-        tuple == the reference's tiered job-order comparator."""
+        tuple == the reference's tiered job-order comparator.  Memoized
+        per (job, j_version) — every live input is versioned by the same
+        events that bump j_version."""
+        cache = getattr(self, "_jkey_cache", None)
+        if cache is None:
+            cache = self._jkey_cache = {}
+        jv = self.st.j_version[jr]
+        hit = cache.get(jr)
+        if hit is not None and hit[0] == jv:
+            return hit[1]
         c = self.cyc
         m = c.m
         parts = []
@@ -463,7 +474,9 @@ class FastEvictor:
                 parts.append(self._drf_share(jr))
         parts.append(m.j_create[jr])
         parts.append(m.j_uid[jr])
-        return tuple(parts)
+        key = tuple(parts)
+        cache[jr] = (jv, key)
+        return key
 
     def _drf_share(self, jr: int) -> float:
         cache = self._share_cache

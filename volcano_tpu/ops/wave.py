@@ -102,6 +102,13 @@ CNT0_SPARSE_MIN = 4_000_000
 TOPK = _env_int("VOLCANO_TPU_TOPK", 256)
 # in-attempt re-walk rounds for conflict losers
 SUBROUNDS = _env_int("VOLCANO_TPU_SUBROUNDS", 16)
+# live affinity steering inside sub-rounds ([UM,EW]x[EW,N] matmuls per
+# dirty sub-round).  Default OFF: measured at the north-star affinity
+# shape (10k nodes x 100k pods, 5/5/10% affinity mix) the steering costs
+# more per attempt than it saves in attempt count — identical placements
+# land ~25% faster without it (see BASELINE.md affinity analysis).
+# Re-enable with VOLCANO_TPU_AFF_STEER=1 for term-heavy small clusters.
+AFF_STEER = _env_int("VOLCANO_TPU_AFF_STEER", 0)
 
 
 class SolveProfiles(NamedTuple):
@@ -392,12 +399,18 @@ def _solve_wave(
                     # resident match in the node's domain (or the
                     # self-match rule).
                     selfok = (total == 0)[None, :] & p_t_matches  # [UM, E]
-                    need = (p_t_req_aff & ~selfok).astype(f32)
-                    aff_viol = jnp.matmul(need, (cv == 0).astype(f32).T)
+                    # 0/1 indicator products feeding a zero/nonzero
+                    # decision: bf16 is exact for the classification
+                    # (true sums are integers; a bf16-rounded value >= 1
+                    # can never land below 0.5, and true 0 stays 0) and
+                    # runs ~4x faster on the MXU than f32.
+                    bf = jnp.bfloat16
+                    need = (p_t_req_aff & ~selfok).astype(bf)
+                    aff_viol = jnp.matmul(need, (cv == 0).astype(bf).T)
                     anti_viol = jnp.matmul(
-                        p_t_req_anti.astype(f32), (cv > 0).astype(f32).T
+                        p_t_req_anti.astype(bf), (cv > 0).astype(bf).T
                     )
-                    return cv, (aff_viol == 0) & (anti_viol == 0)
+                    return cv, (aff_viol < 0.5) & (anti_viol < 0.5)
 
                 def _aff_skip(cnt):
                     return (
@@ -574,24 +587,29 @@ def _solve_wave(
                         selfok_p = (
                             (total_live_n == 0)[None, :] & p_t_matches
                         )  # [UM, EW]
-                        need_l = (p_t_req_aff & ~selfok_p).astype(f32)
+                        # bf16 indicator matmuls: see _aff_parts.
+                        bf_ = jnp.bfloat16
+                        need_l = (p_t_req_aff & ~selfok_p).astype(bf_)
                         aff_viol_l = jnp.matmul(
-                            need_l, (cval_live == 0).astype(f32).T
+                            need_l, (cval_live == 0).astype(bf_).T
                         )
                         anti_viol_l = jnp.matmul(
-                            p_t_req_anti.astype(f32),
-                            (cval_live > 0).astype(f32).T,
+                            p_t_req_anti.astype(bf_),
+                            (cval_live > 0).astype(bf_).T,
                         )
-                        p_feas_sub = p_feasible & (aff_viol_l == 0) & (
-                            anti_viol_l == 0
+                        p_feas_sub = p_feasible & (aff_viol_l < 0.5) & (
+                            anti_viol_l < 0.5
                         )
                         return jnp.take_along_axis(
                             p_feas_sub, ranked, axis=1
                         )
 
-                    feas_k = jax.lax.cond(
-                        aff_dirty, steer, lambda _: feas_k_c, None
-                    )
+                    if AFF_STEER:
+                        feas_k = jax.lax.cond(
+                            aff_dirty, steer, lambda _: feas_k_c, None
+                        )
+                    else:
+                        feas_k = feas_k_c
                 else:
                     feas_k = feas_k_c
 
