@@ -18,6 +18,9 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <queue>
+#include <vector>
+#include <cstdio>
 #include <cstring>
 #include <functional>
 #include <thread>
@@ -146,6 +149,7 @@ extern "C" {
 
 static const float VC_MIN_MILLI_SCALAR = 10.0f;
 
+
 // Resource.less on dense slot vectors (api/resource.py:182-199), with the
 // allocation's scalar DICT ENTRY SET modelled explicitly: Resource.sub
 // keeps zeroed entries in the dict (and adds the subtrahend's keys), so
@@ -219,6 +223,23 @@ struct VcReclaimCtx {
   const float* eps; const uint8_t* scalar_slot;
   const uint8_t* alive; const float* init_req_base;
   long long Nn, R, st_running, st_releasing;
+  // ---- driver mode (vcreclaim_drive) ----
+  float* n_pipelined;          // [N,R]
+  int32_t* n_ntasks;           // [N]
+  const int32_t* n_maxtasks;   // [N]
+  long long* pipe_node;        // [P]
+  int32_t* j_cnt_pending;      // [J]
+  long long* j_waiting;        // [J]
+  long long* j_version;        // [J]
+  long long* q_version;        // [Q]
+  long long Qn;
+  const int32_t* j_prio;       // [J]
+  const int32_t* j_rank;       // [J] (create, uid) rank
+  const int32_t* p_node;       // [P]
+  const float* total_res;      // [R]
+  const int32_t* job_order;    // encoding: 0=priority 1=gang 2=drf
+  long long job_order_len;
+  uint8_t reclaim_gated;       // proportion sits in first reclaim tier
 };
 
 void* vcreclaim_ctx_new(
@@ -235,13 +256,23 @@ void* vcreclaim_ctx_new(
     const float* eps, const uint8_t* scalar_slot,
     const uint8_t* alive, const float* init_req_base,
     long long Nn, long long R,
-    long long st_running, long long st_releasing) {
+    long long st_running, long long st_releasing,
+    float* n_pipelined, int32_t* n_ntasks, const int32_t* n_maxtasks,
+    long long* pipe_node, int32_t* j_cnt_pending, long long* j_waiting,
+    long long* j_version, long long* q_version, long long Qn,
+    const int32_t* j_prio, const int32_t* j_rank,
+    const int32_t* p_node,
+    const float* total_res, const int32_t* job_order,
+    long long job_order_len, long long reclaim_gated) {
   VcReclaimCtx* c = new VcReclaimCtx{
       node_ptr, node_rows, p_status, p_job, req, req_empty, critical,
       j_minav, j_ready_base, j_cnt_alloc, j_cnt_run, j_cnt_releasing,
       j_alloc_res, q_of_job, q_reclaimable, q_alloc, q_deserved,
       q_has_deserved, fi, n_releasing, tiers, tiers_len, eps,
-      scalar_slot, alive, init_req_base, Nn, R, st_running, st_releasing};
+      scalar_slot, alive, init_req_base, Nn, R, st_running, st_releasing,
+      n_pipelined, n_ntasks, n_maxtasks, pipe_node, j_cnt_pending,
+      j_waiting, j_version, q_version, Qn, j_prio, j_rank, p_node,
+      total_res, job_order, job_order_len, (uint8_t)reclaim_gated};
   return c;
 }
 
@@ -253,14 +284,13 @@ void vcreclaim_ctx_free(void* ctx) {
 // along the walk (including on nodes that ultimately could not cover the
 // request — reclaim.go's evictions are immediate and unwrapped) land in
 // out_evicted.
-long long vcreclaim_step(
-    void* ctx_p, long long prow, long long qid,
+static long long vc_walk_one(
+    const VcReclaimCtx& C, long long prow, long long qid,
     long long* cursor,
     const uint8_t* anym, const uint8_t* feas, const uint8_t* stat,
     const uint8_t* slots,
     long long* out_evicted, long long* out_n_evicted,
     long long max_evicted) {
-  const VcReclaimCtx& C = *static_cast<VcReclaimCtx*>(ctx_p);
   const long long Nn = C.Nn, R = C.R;
   const long long* node_ptr = C.node_ptr;
   const long long* node_rows = C.node_rows;
@@ -303,7 +333,8 @@ long long vcreclaim_step(
   float vsum[8];
   if (R > 8) return -2;  // unsupported width; caller falls back
 
-  *out_n_evicted = 0;
+  // NOTE: out_n_evicted is owned by the caller (vcreclaim_batch
+  // accumulates across turns); do not reset it here.
   long long n = *cursor;
   bool advancing = true;
   for (; n < Nn; ++n) {
@@ -478,6 +509,292 @@ long long vcreclaim_step(
     if (covered) return n;  // caller pipelines the task here
   }
   return -1;
+}
+
+
+long long vcreclaim_step(
+    void* ctx_p, long long prow, long long qid,
+    long long* cursor,
+    const uint8_t* anym, const uint8_t* feas, const uint8_t* stat,
+    const uint8_t* slots,
+    long long* out_evicted, long long* out_n_evicted,
+    long long max_evicted) {
+  const VcReclaimCtx& C = *static_cast<VcReclaimCtx*>(ctx_p);
+  *out_n_evicted = 0;
+  return vc_walk_one(C, prow, qid, cursor, anym, feas, stat, slots,
+                     out_evicted, out_n_evicted, max_evicted);
+}
+
+// ---- batch mode helpers -------------------------------------------------
+
+// In-scope evictable sum at one node (fresh walk over residents).
+static bool vc_scope_ev(const VcReclaimCtx& C, long long qid, long long n,
+                        float* ev_out) {
+  for (long long k = 0; k < C.R; ++k) ev_out[k] = 0.0f;
+  bool any = false;
+  for (long long p = C.node_ptr[n]; p < C.node_ptr[n + 1]; ++p) {
+    long long r = C.node_rows[p];
+    if (C.p_status[r] != (int16_t)C.st_running || C.req_empty[r]) continue;
+    int32_t jr = C.p_job[r];
+    if (jr < 0) continue;
+    int32_t vq = C.q_of_job[jr];
+    if (vq == (int32_t)qid || vq < 0 || !C.q_reclaimable[vq]) continue;
+    const float* vreq = C.req + r * C.R;
+    for (long long k = 0; k < C.R; ++k) {
+      ev_out[k] += vreq[k];
+      if (ev_out[k] > 1e-6f) any = true;
+    }
+  }
+  return any;
+}
+
+// Refresh the batch profile's cached masks at one node after C-side
+// mutations (the Python _apply_dirty equivalent for the active profile;
+// other profiles are fixed up post-batch via the dirty set).
+static void vc_refresh_node(const VcReclaimCtx& C, long long qid,
+                            long long n, const float* init_req,
+                            uint8_t* anym, uint8_t* feas, uint8_t* slots) {
+  float ev[8];
+  bool any = vc_scope_ev(C, qid, n, ev);
+  anym[n] = any ? 1 : 0;
+  float tot[8];
+  const float* fi_n = C.fi + n * C.R;
+  for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev[k];
+  feas[n] = vc_le(init_req, tot, C.eps, C.scalar_slot, C.R) ? 1 : 0;
+  if (slots != nullptr)
+    slots[n] = (C.n_maxtasks[n] <= 0 || C.n_ntasks[n] < C.n_maxtasks[n])
+                   ? 1 : 0;
+}
+
+// The live job-order key in doubles (fastpath_evict._job_key with the
+// (create, uid) tail replaced by the precomputed rank).  Component
+// arithmetic matches the Python float math bit-for-bit: float32 inputs
+// widened to double, same divisions.
+static void vc_job_key(const VcReclaimCtx& C, long long jr, double* out) {
+  long long o = 0;
+  for (long long i = 0; i < C.job_order_len; ++i) {
+    int32_t id = C.job_order[i];
+    if (id == 0) {  // priority
+      out[o++] = -(double)C.j_prio[jr];
+    } else if (id == 1) {  // gang: ready jobs order last
+      out[o++] = (C.j_ready_base[jr] >= C.j_minav[jr]) ? 1.0 : 0.0;
+    } else if (id == 2) {  // drf share
+      double s = 0.0;
+      for (long long k = 0; k < C.R; ++k) {
+        double t = (double)C.total_res[k];
+        double a = (double)C.j_alloc_res[jr * C.R + k];
+        double v = t > 0.0 ? a / t : (a > 0.0 ? 1.0 : 0.0);
+        if (v > s) s = v;
+      }
+      out[o++] = s;
+    }
+  }
+  out[o++] = (double)C.j_rank[jr];
+}
+
+// proportion's reclaim-possible veto: some OTHER reclaimable queue still
+// at/above its deserved share (fastpath_evict._reclaim_possible).
+static bool vc_reclaim_possible(const VcReclaimCtx& C, long long qid) {
+  if (!C.reclaim_gated) return true;
+  for (long long qi = 0; qi < C.Qn; ++qi) {
+    if (qi == qid || !C.q_reclaimable[qi] || !C.q_has_deserved[qi])
+      continue;
+    if (vc_res_le_strict(C.q_deserved + qi * C.R, C.q_alloc + qi * C.R,
+                         C.R, C.scalar_slot))
+      return true;
+  }
+  return false;
+}
+
+// ---- full single-queue reclaim driver ----------------------------------
+//
+// Runs the ENTIRE reclaim turn loop for the one queue holding pending
+// reclaimers (the common oversubscribed shape): a lazy max-ordered job
+// heap with live keys (fastpath_evict._LazyHeap semantics), per-turn
+// reclaim-possible veto, the cursor node walk per (profile) mask set,
+// and pipeline/evict bookkeeping — everything except the store replay,
+// which Python applies from the output buffers.  Turns involving tasks
+// the C side cannot handle exactly (ports / inter-pod terms / ghost
+// pods) return control to Python with the job identified (rc -3).
+
+struct VcKey {
+  double v[8];
+  int len;
+  long long jr;
+  bool operator<(const VcKey& o) const {
+    // std::priority_queue is a MAX-heap; invert for min-pop.
+    for (int i = 0; i < len; ++i) {
+      if (v[i] < o.v[i]) return false;
+      if (v[i] > o.v[i]) return true;
+    }
+    return false;
+  }
+};
+
+// Per-profile mask set registered by the Python driver.
+struct VcMaskSet {
+  uint8_t* anym;
+  uint8_t* feas;
+  const uint8_t* stat;   // may be the shared all-ones array
+  uint8_t* slots;        // mutable when has_pred
+  const float* init_req; // representative request vector
+  long long cursor;
+};
+
+long long vcreclaim_drive(
+    void* ctx_p, long long qid, long long has_pred,
+    // jobs + tasks
+    const long long* job_ids, long long n_jobs,
+    const long long* task_ptr,   // [n_jobs+1] CSR into task_rows
+    const long long* task_rows,  // all jobs' pending rows, job-major
+    long long* task_cursor,      // [n_jobs] consumed count (in/out)
+    const int32_t* row_maskidx,  // [P] mask-set index per row (-1 = yield)
+    // mask sets (parallel arrays of pointers)
+    long long n_masks,
+    unsigned long long* anym_ptrs, unsigned long long* feas_ptrs,
+    unsigned long long* stat_ptrs, unsigned long long* slots_ptrs,
+    unsigned long long* initreq_ptrs,
+    long long* mask_cursors,     // [n_masks] in/out
+    // outputs
+    long long* out_evicted, long long* out_n_evicted, long long max_ev,
+    long long* out_pipe_rows, long long* out_pipe_nodes,
+    long long* out_n_pipe,
+    long long* out_touched, long long* out_n_touched,
+    long long max_touched,
+    long long* out_yield_job,    // job index to hand back (rc -3)
+    uint8_t* out_job_dropped     // [n_jobs] jobs that left the heap
+) {
+  const VcReclaimCtx& C = *static_cast<VcReclaimCtx*>(ctx_p);
+  *out_n_evicted = 0;
+  *out_n_pipe = 0;
+  *out_n_touched = 0;
+  *out_yield_job = -1;
+  if (C.job_order_len + 1 > 8) return -4;  // VcKey/mykey buffer bound
+  std::vector<VcMaskSet> masks((size_t)n_masks);
+  for (long long i = 0; i < n_masks; ++i) {
+    masks[i].anym = (uint8_t*)anym_ptrs[i];
+    masks[i].feas = (uint8_t*)feas_ptrs[i];
+    masks[i].stat = (const uint8_t*)stat_ptrs[i];
+    masks[i].slots = (uint8_t*)slots_ptrs[i];
+    masks[i].init_req = (const float*)initreq_ptrs[i];
+    masks[i].cursor = mask_cursors[i];
+  }
+  auto make_key = [&](long long ji) {
+    VcKey k;
+    k.len = 0;
+    vc_job_key(C, job_ids[ji], k.v);
+    k.len = (int)C.job_order_len + 1;
+    k.jr = ji;
+    return k;
+  };
+  std::priority_queue<VcKey> heap;
+  for (long long ji = 0; ji < n_jobs; ++ji)
+    heap.push(make_key(ji));
+  long long rc = 0;
+  while (!heap.empty()) {
+    VcKey top = heap.top();
+    heap.pop();
+    // Lazy re-derivation (the _LazyHeap stale-key re-push).
+    VcKey fresh = make_key(top.jr);
+    bool stale = false;
+    for (int i = 0; i < fresh.len; ++i)
+      if (fresh.v[i] != top.v[i]) { stale = true; break; }
+    if (stale) { heap.push(fresh); continue; }
+    long long ji = top.jr;
+    long long base = task_ptr[ji];
+    long long ntask = task_ptr[ji + 1] - base;
+    if (task_cursor[ji] >= ntask)
+      break;  // drained top job ends the queue's reclaim for the cycle
+              // (reclaim.go: the empty-tasks `continue` skips the queue
+              // re-push, so the queue drops out — a faithful quirk)
+    long long prow = task_rows[base + task_cursor[ji]];
+    int32_t mi = row_maskidx[prow];
+    if (mi < 0) { *out_yield_job = ji; rc = -3; break; }
+    task_cursor[ji] += 1;
+    if (!vc_reclaim_possible(C, qid)) {
+      // Task consumed without a walk; the job drops from the heap.
+      out_job_dropped[ji] = 1;
+      continue;
+    }
+    VcMaskSet& M = masks[mi];
+    long long before_ev = *out_n_evicted;
+    long long node = vc_walk_one(
+        C, prow, qid, &M.cursor, M.anym, M.feas,
+        has_pred ? M.stat : nullptr, M.slots,
+        out_evicted, out_n_evicted, max_ev);
+    // anym refresh (+ dirty marks) at evict nodes for EVERY mask set.
+    for (long long i = before_ev; i < *out_n_evicted; ++i) {
+      long long n_r = C.p_node[out_evicted[i]];
+      float ev_tmp[8];
+      bool any = vc_scope_ev(C, qid, n_r, ev_tmp);
+      float tot[8];
+      const float* fi_n = C.fi + n_r * C.R;
+      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_tmp[k];
+      for (long long mset = 0; mset < n_masks; ++mset) {
+        masks[mset].anym[n_r] = any ? 1 : 0;
+        masks[mset].feas[n_r] =
+            vc_le(masks[mset].init_req, tot, C.eps, C.scalar_slot, C.R)
+                ? 1 : 0;
+      }
+      if (*out_n_touched < max_touched)
+        out_touched[(*out_n_touched)++] = n_r;
+    }
+    if (node == -2) { task_cursor[ji] -= 1; *out_yield_job = ji;
+                      rc = -3; break; }
+    if (node >= 0) {
+      const float* req_r = C.req + prow * C.R;
+      for (long long k = 0; k < C.R; ++k) {
+        C.n_pipelined[node * C.R + k] += req_r[k];
+        C.fi[node * C.R + k] -= req_r[k];
+      }
+      C.pipe_node[prow] = node;
+      C.n_ntasks[node] += 1;
+      int32_t pj = C.p_job[prow];
+      if (pj >= 0) {
+        C.j_version[pj] += 1;
+        C.j_waiting[pj] += 1;
+        C.j_cnt_pending[pj] -= 1;
+        for (long long k = 0; k < C.R; ++k)
+          C.j_alloc_res[pj * C.R + k] += req_r[k];
+        int32_t qi = C.q_of_job[pj];
+        if (qi >= 0) {
+          for (long long k = 0; k < C.R; ++k)
+            C.q_alloc[qi * C.R + k] += req_r[k];
+          C.q_version[qi] += 1;
+        }
+      }
+      out_pipe_rows[*out_n_pipe] = prow;
+      out_pipe_nodes[*out_n_pipe] = node;
+      ++*out_n_pipe;
+      // refresh feas/slots for every mask at the pipeline node
+      float ev_tmp[8];
+      bool any = vc_scope_ev(C, qid, node, ev_tmp);
+      float tot[8];
+      const float* fi_n = C.fi + node * C.R;
+      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_tmp[k];
+      for (long long mset = 0; mset < n_masks; ++mset) {
+        masks[mset].anym[node] = any ? 1 : 0;
+        masks[mset].feas[node] =
+            vc_le(masks[mset].init_req, tot, C.eps, C.scalar_slot, C.R)
+                ? 1 : 0;
+        if (has_pred)
+          masks[mset].slots[node] =
+              (C.n_maxtasks[node] <= 0
+               || C.n_ntasks[node] < C.n_maxtasks[node]) ? 1 : 0;
+      }
+      if (*out_n_touched < max_touched)
+        out_touched[(*out_n_touched)++] = node;
+      // Turn assigned: the job re-enters the heap (fresh key) —
+      // unconditionally, like the Python jobs.push(jr); a drained job
+      // popped later kills the queue (see the break above).
+      heap.push(make_key(ji));
+      continue;
+    }
+    // Walk failed: assigned False -> the job drops from the heap.
+    out_job_dropped[ji] = 1;
+  }
+  for (long long i = 0; i < n_masks; ++i) mask_cursors[i] = masks[i].cursor;
+  return rc;
 }
 
 }  // extern "C"

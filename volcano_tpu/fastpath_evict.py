@@ -1193,6 +1193,25 @@ class FastEvictor:
                 tasks_map[jr] = pending
         for qname in queue_seq:
             preemptors = preemptors_map.get(qname)
+            # Phase 1 can only evict RUNNING same-queue victims
+            # (job_filter below; no victims -> _try_preempt never
+            # pipelines, preempt.go's empty-preemptees continue).  A
+            # queue with no running tasks at all makes every phase-1
+            # turn a no-op whose only observable effect is draining the
+            # preemptor task lists — do exactly that, wholesale.
+            if preemptors is not None and not preemptors.empty():
+                qi = c.queue_index.get(qname)
+                if qi is not None:
+                    has_running = bool(np.any(
+                        (c.q_of_job[:c.Jn] == qi)
+                        & (c.j_cnt_run[:c.Jn] > 0)
+                    ))
+                    if not has_running:
+                        for _k, jr0 in preemptors.h:
+                            lst = tasks_map.get(jr0)
+                            if lst:
+                                lst.clear()
+                        preemptors.h.clear()
             # Phase 1: inter-job preemption within the queue.
             while preemptors is not None and not preemptors.empty():
                 jr = preemptors.pop()
@@ -1259,20 +1278,25 @@ class FastEvictor:
 
     # ------------------------------------------------------------- reclaim
 
+    def _reclaim_prop_gated(self) -> bool:
+        """True when proportion sits in the FIRST tier containing any
+        reclaimable-registered plugin: only then does its queue-slack
+        veto gate the walk (an earlier tier producing victims stops
+        before proportion is consulted — session_plugins.go tier-
+        boundary semantics).  Shared by the Python veto and the C
+        engine's reclaim_gated flag."""
+        registered = {"gang", "conformance", "proportion"}
+        first = next(
+            (t for t in self._tiers_reclaim if registered & set(t)), None
+        )
+        return bool(first is not None and "proportion" in first)
+
     def _reclaim_possible(self, qname: str) -> bool:
         """True when some OTHER reclaimable queue still has slack above
         its deserved share (necessary for any proportion-admitted victim;
         trivially true when proportion is not in the reclaim tiers)."""
         c = self.cyc
-        # The veto only gates when proportion sits in the FIRST tier that
-        # contains any reclaimable-registered plugin: an earlier tier
-        # producing victims stops the walk before proportion is consulted
-        # (session_plugins.go tier-boundary semantics).
-        registered = {"gang", "conformance", "proportion"}
-        first = next(
-            (t for t in self._tiers_reclaim if registered & set(t)), None
-        )
-        if first is None or "proportion" not in first:
+        if not self._reclaim_prop_gated():
             return True
         cache = getattr(self, "_reclaim_poss_cache", None)
         if cache is not None and cache[0] == self.st.version:
@@ -1325,8 +1349,10 @@ class FastEvictor:
         overused = c._overused_fn()
         nat = self._native_reclaim_setup()
         try:
-            self._reclaim_loop(queues_pq, jobs_map, tasks_map, overused,
-                               nat)
+            if nat is None or not self._native_reclaim_drive(
+                    nat, jobs_map, tasks_map, overused):
+                self._reclaim_loop(queues_pq, jobs_map, tasks_map,
+                                   overused, nat)
         finally:
             if nat is not None:
                 nat["lib"].vcreclaim_ctx_free(nat["ctx"])
@@ -1525,7 +1551,8 @@ class FastEvictor:
         # C-order buffers, and replacing the attribute keeps them live
         # for the Python side too.
         for name in ("j_cnt_alloc", "j_cnt_run", "j_cnt_releasing",
-                     "j_ready_base", "q_of_job"):
+                     "j_ready_base", "j_cnt_pending", "q_of_job",
+                     "n_ntasks", "n_maxtasks"):
             arr = getattr(c, name)
             if not arr.flags["C_CONTIGUOUS"] or arr.dtype != np.int32:
                 setattr(c, name, np.ascontiguousarray(arr, np.int32))
@@ -1596,9 +1623,42 @@ class FastEvictor:
                      c.j_cnt_releasing, c.j_alloc_res, c.q_of_job,
                      c.q_alloc, st.fi, c.n_releasing),
         }
+        # Batch-mode inputs: job-order encoding, (create, uid) rank,
+        # and the pipeline-side arrays the C batch mutates.
+        Jn = c.Jn
+        uids = np.array([m.j_uid[j] for j in range(Jn)])
+        order = np.lexsort((uids, m.j_create[:Jn]))
+        j_rank = np.empty(Jn, np.int32)
+        j_rank[order] = np.arange(Jn, dtype=np.int32)
+        order_ids = {"priority": 0, "gang": 1, "drf": 2}
+        job_order = np.asarray(
+            [order_ids[n] for n in self._job_order_names
+             if n in order_ids], np.int32,
+        )
+        reclaim_gated = self._reclaim_prop_gated()
+        nat_extra = {
+            "j_rank": j_rank,
+            "j_prio": np.ascontiguousarray(m.j_prio, np.int32),
+            "p_node": np.ascontiguousarray(m.p_node, np.int32),
+            "job_order": job_order,
+            "total_res": np.ascontiguousarray(c.total_res, np.float32),
+            "out_pipe_rows": np.zeros(max(c.Pn, 1), np.int64),
+            "out_pipe_nodes": np.zeros(max(c.Pn, 1), np.int64),
+            "out_n_pipe": np.zeros(1, np.int64),
+            "out_touched": np.zeros(2 * max(c.Pn, 1), np.int64),
+            "out_n_touched": np.zeros(1, np.int64),
+            "reclaim_gated": reclaim_gated,
+        }
         d = lambda a: a.ctypes.data
         (j_ready_base, j_cnt_alloc, j_cnt_run, j_cnt_releasing,
          j_alloc_res, q_of_job, q_alloc, fi, n_releasing) = nat["pins"]
+        if not st.pipe_node.flags["C_CONTIGUOUS"] \
+                or st.pipe_node.dtype != np.int64:
+            st.pipe_node = np.ascontiguousarray(st.pipe_node, np.int64)
+        nat["pins2"] = (st.n_pipelined, c.n_ntasks, c.n_maxtasks,
+                        st.pipe_node, c.j_cnt_pending, st.j_waiting,
+                        st.j_version, st.q_version)
+        nat.update(nat_extra)
         nat["ctx"] = lib.vcreclaim_ctx_new(
             d(node_ptr), d(flat),
             d(nat["p_status"]), d(nat["p_job"]),
@@ -1612,12 +1672,218 @@ class FastEvictor:
             d(nat["eps"]), d(nat["scalar_slot"]),
             d(nat["alive"]), d(nat["init_req_base"]),
             c.Nn, c.R, ST_RUNNING, ST_RELEASING,
+            d(st.n_pipelined), d(c.n_ntasks), d(c.n_maxtasks),
+            d(st.pipe_node), d(c.j_cnt_pending), d(st.j_waiting),
+            d(st.j_version), d(st.q_version),
+            int(len(st.q_version)),
+            d(nat["j_prio"]), d(nat["j_rank"]), d(nat["p_node"]),
+            d(nat["total_res"]), d(nat["job_order"]),
+            len(nat["job_order"]), int(reclaim_gated),
         )
         nat["step"] = lib.vcreclaim_step
         nat["cur_addr"] = nat["cursor_buf"].ctypes.data
         nat["out_addr"] = nat["out_rows"].ctypes.data
         nat["out_n_addr"] = nat["out_n"].ctypes.data
         return nat
+
+    def _native_reclaim_drive(self, nat, jobs_map, tasks_map,
+                              overused) -> bool:
+        """Run the ENTIRE reclaim turn loop in C when exactly one queue
+        holds pending reclaimers (vcreclaim_drive: lazy job heap with
+        live keys, per-turn proportion veto, cursor node walks, pipeline
+        bookkeeping).  Tasks the C side cannot handle exactly (inter-pod
+        terms / host ports / ghost pods) yield back here, are run through
+        the exact Python turn, and the drive resumes.  Returns False to
+        fall back to the Python loop (multi-queue)."""
+        c = self.cyc
+        st = self.st
+        m = c.m
+        live = [(q, h) for q, h in jobs_map.items() if not h.empty()]
+        if len(live) != 1:
+            return False
+        qname, jobs_heap = live[0]
+        qid = c.queue_index.get(qname, -1)
+        if qid < 0:
+            return False
+        if overused(c.store.queues[qname]):
+            return True  # the queue is skipped wholesale
+        has_pred = c._has("predicates")
+        pods = c.store.pods
+        scope = ("rq", qname)
+        active = [it for (_k, it) in jobs_heap.h]
+        lib = nat["lib"]
+        while True:
+            task_ptr = [0]
+            flat: List[int] = []
+            for jr in active:
+                flat.extend(tasks_map.get(jr, []))
+                task_ptr.append(len(flat))
+            if not flat:
+                return True
+            ev = self._evictable_for(scope)
+            row_maskidx = np.full(c.Pn, -1, np.int32)
+            regs: List[dict] = []
+            seen_prof: Dict[tuple, int] = {}
+            for r in flat:
+                feat = m.p_feat[r]
+                if feat.ports or feat.ip_req_aff or feat.ip_req_anti:
+                    continue
+                if has_pred and pods.get(m.p_uid[r]) is None:
+                    continue
+                key = (int(m.p_prof[r]), st.init_req[r].tobytes())
+                mi = seen_prof.get(key)
+                if mi is None:
+                    init_req = st.init_req[r]
+                    self._prefilter(scope, init_req, ev)
+                    static = None
+                    if has_pred:
+                        static = self._profile_static.get(key[0])
+                        if static is None:
+                            static = self._static_mask(feat)
+                            self._profile_static[key[0]] = static
+                    slots = self._slots_mask
+                    if slots is None and has_pred:
+                        slots = self._slots_mask = (
+                            (c.n_maxtasks <= 0)
+                            | (c.n_ntasks < c.n_maxtasks)
+                        )
+                    wkey = (scope, key[1], key[0])
+                    mi = len(regs)
+                    seen_prof[key] = mi
+                    regs.append({
+                        "wkey": wkey,
+                        "anym": self._ev_any[scope],
+                        "feas": self._ev_feas[(scope, key[1])][1],
+                        "static": static if static is not None
+                        else nat["ones"],
+                        "slots": slots if slots is not None
+                        else nat["ones"],
+                        "init_req": np.ascontiguousarray(
+                            init_req, np.float32),
+                    })
+                row_maskidx[r] = mi
+            M = len(regs)
+            d = lambda a: a.ctypes.data
+            anym_p = np.asarray([d(g["anym"]) for g in regs], np.uint64)
+            feas_p = np.asarray([d(g["feas"]) for g in regs], np.uint64)
+            stat_p = np.asarray([d(g["static"]) for g in regs],
+                                np.uint64)
+            slot_p = np.asarray([d(g["slots"]) for g in regs], np.uint64)
+            ireq_p = np.asarray([d(g["init_req"]) for g in regs],
+                                np.uint64)
+            mask_cur = np.asarray(
+                [self._walk_cursor.get(g["wkey"], 0) for g in regs],
+                np.int64,
+            )
+            job_arr = np.asarray(active, np.int64)
+            ptr_arr = np.asarray(task_ptr, np.int64)
+            flat_arr = np.asarray(flat, np.int64)
+            task_cur = np.zeros(len(active), np.int64)
+            j_dropped = np.zeros(max(len(active), 1), np.uint8)
+            yield_job = np.zeros(1, np.int64)
+            out_n_ev = nat["out_n"]
+            out_n_ev[0] = 0
+            nat["out_n_pipe"][0] = 0
+            nat["out_n_touched"][0] = 0
+            rc = lib.vcreclaim_drive(
+                nat["ctx"], qid, 1 if has_pred else 0,
+                job_arr.ctypes.data, len(active),
+                ptr_arr.ctypes.data, flat_arr.ctypes.data,
+                task_cur.ctypes.data,
+                row_maskidx.ctypes.data,
+                M,
+                anym_p.ctypes.data, feas_p.ctypes.data,
+                stat_p.ctypes.data, slot_p.ctypes.data,
+                ireq_p.ctypes.data,
+                mask_cur.ctypes.data,
+                nat["out_addr"], out_n_ev.ctypes.data,
+                len(nat["out_rows"]),
+                nat["out_pipe_rows"].ctypes.data,
+                nat["out_pipe_nodes"].ctypes.data,
+                nat["out_n_pipe"].ctypes.data,
+                nat["out_touched"].ctypes.data,
+                nat["out_n_touched"].ctypes.data,
+                len(nat["out_touched"]),
+                yield_job.ctypes.data,
+                j_dropped.ctypes.data,
+            )
+            # ---- replay the store-facing bookkeeping
+            n_ev = int(out_n_ev[0])
+            if n_ev:
+                st.version += n_ev
+                for r in nat["out_rows"][:n_ev].tolist():
+                    st.evicted_rows.append(r)
+                    vjr = int(m.p_job[r])
+                    if vjr >= 0:
+                        st.j_version[vjr] += 1
+                        qi = int(c.q_of_job[vjr])
+                        if 0 <= qi < len(st.q_version):
+                            st.q_version[qi] += 1
+                    self._evictable_update(r, -1)
+            n_pipe = int(nat["out_n_pipe"][0])
+            if n_pipe:
+                st.version += n_pipe
+                for row, node in zip(
+                        nat["out_pipe_rows"][:n_pipe].tolist(),
+                        nat["out_pipe_nodes"][:n_pipe].tolist()):
+                    st.pipelined_rows.append(row)
+                    st.node_rows[node].append(row)
+            n_t = int(nat["out_n_touched"][0])
+            if n_t:
+                self._dirty.update(
+                    int(x) for x in nat["out_touched"][:n_t].tolist())
+            for g, cur in zip(regs, mask_cur.tolist()):
+                self._walk_cursor[g["wkey"]] = int(cur)
+            for i, jr in enumerate(active):
+                k = int(task_cur[i])
+                if k:
+                    del tasks_map[jr][:k]
+            if rc == -4:
+                # Key buffer bound exceeded (very long job-order config):
+                # nothing was mutated — use the Python loop.
+                return False
+            if rc == 0:
+                jobs_heap.h.clear()
+                return True
+            # rc == -3: one exact Python turn for the yielded job.
+            ji = int(yield_job[0])
+            jr_y = active[ji]
+            keep = self._drive_python_turn(jr_y, tasks_map, qname)
+            active = [
+                j for j, dr in zip(active, j_dropped[:len(active)])
+                if not dr and j != jr_y
+            ]
+            if keep:
+                active.append(jr_y)
+            if not active:
+                jobs_heap.h.clear()
+                return True
+
+    def _drive_python_turn(self, jr: int, tasks_map, qname: str) -> bool:
+        """One exact reclaim turn for a task the C driver yielded
+        (mirror of the _reclaim_loop body for one (job, task))."""
+        c = self.cyc
+        st = self.st
+        m = c.m
+        tasks = tasks_map.get(jr, [])
+        if not tasks:
+            return False
+        prow = tasks.pop(0)
+        if not self._reclaim_possible(qname):
+            return False
+        if c._has("predicates") \
+                and c.store.pods.get(m.p_uid[prow]) is None:
+            return False
+        init_req = st.init_req[prow]
+        ev = self._evictable_for(("rq", qname))
+        comb = self._prefilter(("rq", qname), init_req, ev)
+        feasible = comb
+        if feasible.any():
+            feasible = feasible & self.feasible_mask(prow)
+        for n in np.flatnonzero(feasible & c.n_alive):
+            if self._reclaim_node(prow, init_req, qname, int(n)):
+                return True
+        return False
 
     def _native_reclaim_step(self, nat, prow: int, qid: int,
                              init_req: np.ndarray, wkey, static, slots,

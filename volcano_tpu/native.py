@@ -58,8 +58,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     vp = ctypes.c_void_p
     ll = ctypes.c_longlong
     lib.vcreclaim_ctx_new.restype = vp
-    lib.vcreclaim_ctx_new.argtypes = [vp] * 20 + [vp, ll] + [vp] * 4 + \
-        [ll, ll, ll, ll]
+    lib.vcreclaim_ctx_new.argtypes = (
+        [vp] * 20 + [vp, ll] + [vp] * 4 + [ll, ll, ll, ll]
+        # batch-mode tail: n_pipelined n_ntasks n_maxtasks pipe_node
+        # j_cnt_pending j_waiting j_version q_version Qn j_prio j_rank
+        # p_node total_res job_order job_order_len reclaim_gated
+        + [vp] * 8 + [ll] + [vp] * 5 + [ll, ll]
+    )
     lib.vcreclaim_ctx_free.argtypes = [vp]
     lib.vcreclaim_step.restype = ll
     lib.vcreclaim_step.argtypes = [
@@ -67,6 +72,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         vp,  # cursor
         vp, vp, vp, vp,  # anym feas stat slots
         vp, vp, ll,  # out_evicted out_n max
+    ]
+    lib.vcreclaim_drive.restype = ll
+    lib.vcreclaim_drive.argtypes = [
+        vp, ll, ll,  # ctx qid has_pred
+        vp, ll,  # job_ids n_jobs
+        vp, vp, vp,  # task_ptr task_rows task_cursor
+        vp,  # row_maskidx
+        ll,  # n_masks
+        vp, vp, vp, vp, vp,  # anym feas stat slots initreq ptr arrays
+        vp,  # mask_cursors
+        vp, vp, ll,  # out_evicted out_n max_ev
+        vp, vp, vp,  # out_pipe_rows out_pipe_nodes out_n_pipe
+        vp, vp, ll,  # out_touched out_n_touched max_touched
+        vp,  # out_yield_job
+        vp,  # out_job_dropped
     ]
     return lib
 
