@@ -20,7 +20,6 @@
 #include <cstdint>
 #include <queue>
 #include <vector>
-#include <cstdio>
 #include <cstring>
 #include <functional>
 #include <thread>
@@ -548,24 +547,6 @@ static bool vc_scope_ev(const VcReclaimCtx& C, long long qid, long long n,
   return any;
 }
 
-// Refresh the batch profile's cached masks at one node after C-side
-// mutations (the Python _apply_dirty equivalent for the active profile;
-// other profiles are fixed up post-batch via the dirty set).
-static void vc_refresh_node(const VcReclaimCtx& C, long long qid,
-                            long long n, const float* init_req,
-                            uint8_t* anym, uint8_t* feas, uint8_t* slots) {
-  float ev[8];
-  bool any = vc_scope_ev(C, qid, n, ev);
-  anym[n] = any ? 1 : 0;
-  float tot[8];
-  const float* fi_n = C.fi + n * C.R;
-  for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev[k];
-  feas[n] = vc_le(init_req, tot, C.eps, C.scalar_slot, C.R) ? 1 : 0;
-  if (slots != nullptr)
-    slots[n] = (C.n_maxtasks[n] <= 0 || C.n_ntasks[n] < C.n_maxtasks[n])
-                   ? 1 : 0;
-}
-
 // The live job-order key in doubles (fastpath_evict._job_key with the
 // (create, uid) tail replaced by the precomputed rank).  Component
 // arithmetic matches the Python float math bit-for-bit: float32 inputs
@@ -739,8 +720,17 @@ long long vcreclaim_drive(
       if (*out_n_touched < max_touched)
         out_touched[(*out_n_touched)++] = n_r;
     }
-    if (node == -2) { task_cursor[ji] -= 1; *out_yield_job = ji;
-                      rc = -3; break; }
+    if (node == -2) {
+      // Mid-walk bail: the veto already ran and evictions may have
+      // landed; the task is rewound and must resume WALK-ONLY in
+      // Python (rc -5, vs -3 whose turn starts from the veto).
+      // Unreachable while setup gates max residents <= VC_MAX_CAND,
+      // kept as a defensive exact path.
+      task_cursor[ji] -= 1;
+      *out_yield_job = ji;
+      rc = -5;
+      break;
+    }
     if (node >= 0) {
       const float* req_r = C.req + prow * C.R;
       for (long long k = 0; k < C.R; ++k) {

@@ -12,6 +12,13 @@ mid-solve.  Knobs:
                               chunks (delete result refs + clear jax
                               caches every chunk batch) — the "device
                               re-attach" experiment
+  REPRO_CYCLES=N              run N full cycles on FRESH stores in one
+                              process (default 1).  Round-3 finding: one
+                              cycle completes; the historic worker crash
+                              reproduces on the SECOND full-scale cycle
+                              of the same process (cumulative device
+                              state), which is exactly what bench.py's
+                              warm+repeat loop does.
   REPRO_NODES / REPRO_PODS    override the 50000 x 500000 shape
 
 Artifact: hack/hyperscale_affinity_repro.jsonl (one line per chunk +
@@ -91,26 +98,40 @@ def main() -> int:
 
     fastpath.FastCycle._solve_chunks = chunks_logged
 
-    emit({"event": "build_store"})
-    store = synthetic_cluster(
-        n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16,
-        affinity_fraction=0.05, anti_affinity_fraction=0.05,
-        spread_fraction=0.1, seed=0,
-    )
-    store.async_bind = True
-    emit({"event": "cycle_start"})
-    t0 = time.perf_counter()
-    try:
-        Scheduler(store).run_once()
-    except BaseException as e:  # noqa: BLE001 — record then re-raise
-        emit({"event": "crash", "error": repr(e)[:500],
-              "after_s": round(time.perf_counter() - t0, 1),
-              "chunks_done": chunk_no["i"]})
-        raise
-    store.flush_binds()
-    bound = sum(1 for p in store.pods.values() if p.node_name)
-    emit({"event": "done", "cycle_s": round(time.perf_counter() - t0, 1),
-          "bound": bound, "chunks": chunk_no["i"]})
+    n_cycles = int(os.environ.get("REPRO_CYCLES", 1))
+    for cyc in range(n_cycles):
+        emit({"event": "build_store", "cycle": cyc})
+        store = synthetic_cluster(
+            n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16,
+            affinity_fraction=0.05, anti_affinity_fraction=0.05,
+            spread_fraction=0.1, seed=cyc,
+        )
+        store.async_bind = True
+        emit({"event": "cycle_start", "cycle": cyc})
+        t0 = time.perf_counter()
+        try:
+            Scheduler(store).run_once()
+        except BaseException as e:  # noqa: BLE001 — record then re-raise
+            emit({"event": "crash", "cycle": cyc,
+                  "error": repr(e)[:500],
+                  "after_s": round(time.perf_counter() - t0, 1),
+                  "chunks_done": chunk_no["i"]})
+            raise
+        store.flush_binds()
+        bound = sum(1 for p in store.pods.values() if p.node_name)
+        emit({"event": "done", "cycle": cyc,
+              "cycle_s": round(time.perf_counter() - t0, 1),
+              "bound": bound, "chunks": chunk_no["i"]})
+        store.close()
+        del store
+        if release:
+            import gc
+
+            import jax
+
+            gc.collect()
+            jax.clear_caches()
+            emit({"event": "released", "cycle": cyc})
     return 0
 
 

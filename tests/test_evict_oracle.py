@@ -463,3 +463,76 @@ def test_fast_vs_object_victims_with_scalar_resources(seed, monkeypatch):
         Scheduler(stores[mode], conf_str=EVICT_CONF).run_once()
     assert (evicted_keys(stores["fast"])
             == evicted_keys(stores["object"]))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_drive_yield_path_parity(seed, monkeypatch):
+    """The C reclaim driver yields tasks it cannot handle exactly
+    (host ports here) back to a Python turn; fast and object paths must
+    still produce identical victim sets, and the yield path must
+    actually run (guarded by instrumentation)."""
+    import volcano_tpu.fastpath_evict as FE
+
+    def build():
+        rng = np.random.default_rng(3000 + seed)
+        store = ClusterStore()
+        store.add_priority_class(PriorityClass(name="low", value=100))
+        store.add_priority_class(PriorityClass(name="high", value=10000))
+        store.add_queue(Queue(name="victim", weight=1))
+        store.add_queue(Queue(name="premium", weight=9))
+        for i in range(4):
+            store.add_node(Node(
+                name=f"node-{i:03d}",
+                allocatable={"cpu": "16", "memory": "64Gi", "pods": 64},
+            ))
+        g = 0
+        for i in range(4):
+            for s in range(2):
+                pg = PodGroup(name=f"fill-{g:03d}", min_member=1,
+                              queue="victim")
+                store.add_pod_group(pg)
+                store.add_pod(Pod(
+                    name=f"fill-{g:03d}-0",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[{"cpu": str(int(rng.choice([4, 8]))),
+                                 "memory": "8Gi"}],
+                    phase=PodPhase.Running, node_name=f"node-{i:03d}",
+                    priority_class="low", priority=100,
+                ))
+                g += 1
+        for j in range(4):
+            pg = PodGroup(name=f"hi-{j:03d}", min_member=1,
+                          queue="premium")
+            store.add_pod_group(pg)
+            # Half the reclaimers carry host ports -> non-plain feature
+            # -> the C drive must yield them to the Python turn.
+            ports = [9000 + j] if j % 2 == 0 else []
+            store.add_pod(Pod(
+                name=f"hi-{j:03d}-0",
+                annotations={GROUP_NAME_ANNOTATION: pg.name},
+                containers=[{"cpu": "8", "memory": "8Gi"}],
+                host_ports=ports,
+                priority_class="high", priority=10000,
+            ))
+        return store
+
+    yields = {"n": 0}
+    orig = FE.FastEvictor._drive_python_turn
+
+    def counting(self, *a, **k):
+        yields["n"] += 1
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(FE.FastEvictor, "_drive_python_turn", counting)
+    res = {}
+    for mode, env in (("fast", "1"), ("object", "0")):
+        monkeypatch.setenv("VOLCANO_TPU_FASTPATH", env)
+        store = build()
+        Scheduler(store, conf_str=EVICT_CONF).run_once()
+        res[mode] = set(getattr(store.evictor, "evicts", []))
+    assert res["fast"] == res["object"], (
+        f"seed {seed}: {res['fast'] ^ res['object']}"
+    )
+    from volcano_tpu.native import reclaim_lib
+    if reclaim_lib() is not None:
+        assert yields["n"] > 0, "yield path never exercised"

@@ -1712,6 +1712,7 @@ class FastEvictor:
         scope = ("rq", qname)
         active = [it for (_k, it) in jobs_heap.h]
         lib = nat["lib"]
+        n_yields = 0
         while True:
             task_ptr = [0]
             flat: List[int] = []
@@ -1720,6 +1721,11 @@ class FastEvictor:
                 task_ptr.append(len(flat))
             if not flat:
                 return True
+            if n_yields and n_yields * 4 > len(flat):
+                # Many yielding (port/inter-pod/ghost) reclaimers: each
+                # yield re-registers O(pending) state, so the Python
+                # loop's linear walk is cheaper past this ratio.
+                return False
             ev = self._evictable_for(scope)
             row_maskidx = np.full(c.Pn, -1, np.int32)
             regs: List[dict] = []
@@ -1846,9 +1852,14 @@ class FastEvictor:
                 jobs_heap.h.clear()
                 return True
             # rc == -3: one exact Python turn for the yielded job.
+            # rc == -5: the turn's veto already ran in C and the walk
+            # bailed mid-node; resume walk-only (re-running the veto
+            # here could diverge after the turn's partial evictions).
+            n_yields += 1
             ji = int(yield_job[0])
             jr_y = active[ji]
-            keep = self._drive_python_turn(jr_y, tasks_map, qname)
+            keep = self._drive_python_turn(jr_y, tasks_map, qname,
+                                           walk_only=(rc == -5))
             active = [
                 j for j, dr in zip(active, j_dropped[:len(active)])
                 if not dr and j != jr_y
@@ -1859,9 +1870,12 @@ class FastEvictor:
                 jobs_heap.h.clear()
                 return True
 
-    def _drive_python_turn(self, jr: int, tasks_map, qname: str) -> bool:
+    def _drive_python_turn(self, jr: int, tasks_map, qname: str,
+                           walk_only: bool = False) -> bool:
         """One exact reclaim turn for a task the C driver yielded
-        (mirror of the _reclaim_loop body for one (job, task))."""
+        (mirror of the _reclaim_loop body for one (job, task)).
+        ``walk_only`` resumes a turn whose veto/guards already ran in C
+        before its walk bailed."""
         c = self.cyc
         st = self.st
         m = c.m
@@ -1869,11 +1883,12 @@ class FastEvictor:
         if not tasks:
             return False
         prow = tasks.pop(0)
-        if not self._reclaim_possible(qname):
-            return False
-        if c._has("predicates") \
-                and c.store.pods.get(m.p_uid[prow]) is None:
-            return False
+        if not walk_only:
+            if not self._reclaim_possible(qname):
+                return False
+            if c._has("predicates") \
+                    and c.store.pods.get(m.p_uid[prow]) is None:
+                return False
         init_req = st.init_req[prow]
         ev = self._evictable_for(("rq", qname))
         comb = self._prefilter(("rq", qname), init_req, ev)
