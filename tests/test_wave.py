@@ -213,3 +213,62 @@ def test_sparse_cnt0_path_matches_dense(monkeypatch):
     sparse = np.asarray(wave.solve_wave(*args2).assigned)
     assert np.array_equal(dense, sparse)
     assert (sparse >= 0).sum() == 3
+
+
+def test_sparse_profile_tables_match_dense(monkeypatch):
+    """Forcing the sparse profile-term shipping path (PROF_SPARSE_MIN=0)
+    must produce identical placements to the dense path — guards the
+    flag bit-packing and the device-side scatter rebuild."""
+    import volcano_tpu.ops.wave as wave
+    from volcano_tpu.api import (
+        GROUP_NAME_ANNOTATION,
+        AffinityTerm,
+        Node,
+        Pod,
+        PodGroup,
+    )
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.synth import solve_args_from_store
+
+    def build():
+        store = ClusterStore()
+        for z in ("z1", "z2"):
+            for i in range(2):
+                store.add_node(Node(
+                    name=f"{z}-n{i}",
+                    allocatable={"cpu": "8", "memory": "16Gi"},
+                    labels={"zone": z},
+                ))
+        res = Pod(name="seed", labels={"app": "db"},
+                  containers=[{"cpu": "1", "memory": "1Gi"}],
+                  node_name="z1-n0", phase="Running")
+        store.add_pod(res)
+        aff_term = AffinityTerm(match_labels={"app": "db"},
+                                topology_key="zone")
+        anti_term = AffinityTerm(match_labels={"app": "lonely"},
+                                 topology_key="kubernetes.io/hostname")
+        store.add_pod_group(PodGroup(name="g", min_member=3))
+        for k in range(3):
+            store.add_pod(Pod(
+                name=f"g-{k}", labels={"app": "db"},
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+                annotations={GROUP_NAME_ANNOTATION: "g"},
+                affinity=[aff_term],
+            ))
+        store.add_pod_group(PodGroup(name="solo", min_member=2))
+        for k in range(2):
+            store.add_pod(Pod(
+                name=f"solo-{k}", labels={"app": "lonely"},
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+                annotations={GROUP_NAME_ANNOTATION: "solo"},
+                anti_affinity=[anti_term],
+            ))
+        return store
+
+    args, _ = solve_args_from_store(build())
+    dense = np.asarray(wave.solve_wave(*args).assigned)
+    monkeypatch.setattr(wave, "PROF_SPARSE_MIN", 0)
+    args2, _ = solve_args_from_store(build())
+    sparse = np.asarray(wave.solve_wave(*args2).assigned)
+    assert np.array_equal(dense, sparse)
+    assert (sparse >= 0).sum() == 5  # the 3 aff + 2 anti pending pods

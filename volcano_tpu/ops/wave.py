@@ -98,6 +98,9 @@ DEFAULT_WAVE = _env_int("VOLCANO_TPU_WAVE", 2048)
 # cnt0 tables above this element count ship as sparse entries and are
 # scattered on device (tests lower it to force the sparse path).
 CNT0_SPARSE_MIN = 4_000_000
+# Same for each profile-term table ([U, Ep]): past this element count
+# the four tables ship as one sparse entry list.
+PROF_SPARSE_MIN = _env_int("VOLCANO_TPU_PROF_SPARSE_MIN", 1_000_000)
 # diversification breadth: k-th contender takes its k-th best node
 TOPK = _env_int("VOLCANO_TPU_TOPK", 256)
 # in-attempt re-walk rounds for conflict losers
@@ -1015,6 +1018,21 @@ def _scatter_cnt0(rows, cols, vals, e, d):
     return jnp.zeros((e, d), jnp.int32).at[rows, cols].add(vals)
 
 
+@partial(jax.jit, static_argnames=("u", "e"))
+def _scatter_profile_tables(rows, cols, flags, soft, u, e):
+    """Rebuild the dense [U, E] profile-term tables from their sparse
+    entries on device (see solve_wave: shipping ~tens of MB of mostly-
+    zero bool/f32 tables through a remote-TPU tunnel costs seconds;
+    the entries are tiny).  Padded entries carry flags/soft of 0 at
+    (0, 0) — add is a no-op there; real (u, e) pairs are unique."""
+    zb = jnp.zeros((u, e), jnp.int8)
+    aff = zb.at[rows, cols].add(flags & 1) > 0
+    anti = zb.at[rows, cols].add((flags >> 1) & 1) > 0
+    match = zb.at[rows, cols].add((flags >> 2) & 1) > 0
+    soft_t = jnp.zeros((u, e), jnp.float32).at[rows, cols].add(soft)
+    return aff, anti, match, soft_t
+
+
 def _np(a):
     # ascontiguousarray: no-op for the usual numpy inputs; jax arrays
     # fetched from a sharded placement can materialize non-contiguous,
@@ -1242,7 +1260,9 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
     wave_terms = np.full((n_waves, EW), E, np.int32)  # pad = dummy row
     for w, terms in enumerate(term_lists):
         wave_terms[w, :len(terms)] = terms
-    return profiles, aff, wave_terms, int(EW)
+    # iom's dummy column is all-zero; callers reuse it as the nonzero
+    # union of the four tables (the sparse-shipping path).
+    return profiles, aff, wave_terms, int(EW), iom
 
 
 def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
@@ -1435,9 +1455,56 @@ def solve_wave(
         extra_ok is not None,
         extra_score is not None,
     )
-    profiles, aff, wave_terms, ew = _term_windows(
+    profiles, aff, wave_terms, ew, prof_iom = _term_windows(
         profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse
     )
+    # Profile-term tables ([U, Ep] bool x3 + f32) reach ~75 MB at the
+    # north-star affinity shape but are overwhelmingly zero (a profile
+    # references only its own job's terms).  Past the threshold, ship
+    # the sparse entries and rebuild dense on device — measured ~2 s of
+    # per-cycle upload through the remote-TPU tunnel otherwise.
+    t_aff_h = _np(profiles.t_req_aff)
+    if t_aff_h.size > PROF_SPARSE_MIN:
+        t_anti_h = _np(profiles.t_req_anti)
+        t_mat_h = _np(profiles.t_matches)
+        t_soft_h = _np(profiles.t_soft)
+        # prof_iom covers the pre-dummy columns; the dummy column is
+        # all-zero, so padding it reproduces the full union.
+        ur, ec = np.nonzero(
+            np.concatenate(
+                [prof_iom,
+                 np.zeros((prof_iom.shape[0], 1), bool)], axis=1,
+            )
+        )
+        flags = (
+            t_aff_h[ur, ec].astype(np.int8)
+            | (t_anti_h[ur, ec].astype(np.int8) << 1)
+            | (t_mat_h[ur, ec].astype(np.int8) << 2)
+        )
+        soft_vals = t_soft_h[ur, ec].astype(np.float32)
+        k = bucket_pow2(len(ur), floor=16)
+        ppad = k - len(ur)
+        if ppad:
+            ur = np.concatenate([ur, np.zeros(ppad, np.int64)])
+            ec = np.concatenate([ec, np.zeros(ppad, np.int64)])
+            flags = np.concatenate([flags, np.zeros(ppad, np.int8)])
+            soft_vals = np.concatenate(
+                [soft_vals, np.zeros(ppad, np.float32)]
+            )
+        d_aff, d_anti, d_mat, d_soft = _scatter_profile_tables(
+            ur.astype(np.int32), ec.astype(np.int32), flags, soft_vals,
+            t_aff_h.shape[0], t_aff_h.shape[1],
+        )
+        in_sh = getattr(cnt0_in, "sharding", None)
+        if in_sh is not None and not isinstance(cnt0_in, np.ndarray):
+            d_aff, d_anti, d_mat, d_soft = (
+                jax.device_put(x, in_sh)
+                for x in (d_aff, d_anti, d_mat, d_soft)
+            )
+        profiles = profiles._replace(
+            t_req_aff=d_aff, t_req_anti=d_anti, t_matches=d_mat,
+            t_soft=d_soft,
+        )
     if cnt0_sparse:
         # Hyperscale [Ep, D] count tables reach hundreds of MB; ship the
         # sparse resident entries (typically none on a fresh cycle) and
