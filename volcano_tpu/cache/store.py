@@ -88,6 +88,12 @@ class ClusterStore:
         self.services: Dict[str, Dict[str, object]] = {}  # ns/name -> spec
         # ns/name -> ingress-isolation spec (NetworkPolicy analog).
         self.network_policies: Dict[str, Dict[str, object]] = {}
+        # Count of live pods carrying volume claims: the fast path's
+        # commit gate is O(bound pods) when any exist, so claim-free
+        # clusters must skip on an O(1) check that cannot miss a
+        # volume-carrying pod (unlike gating on the claim registry,
+        # which a custom volume binder need not use).
+        self.n_volume_pods = 0
         # ns/name -> persistent-volume-claim record
         # {"spec", "phase" Pending|Bound, "node", "owner_job"} — the PVC
         # store the job controller creates into (initiateJob PVCs,
@@ -398,6 +404,8 @@ class ClusterStore:
         the podgroup controller wraps them."""
         with self._lock:
             self.pods[pod.uid] = pod
+            if pod.volumes:
+                self.n_volume_pods += 1
             self._add_task(pod)
             self.mirror.upsert_pod(pod, self.mirror.job_row)
             self._notify("Pod", "add", pod)
@@ -407,7 +415,11 @@ class ClusterStore:
             old = self.pods.get(pod.uid)
             if old is not None:
                 self._remove_task(old)
+                if old.volumes:
+                    self.n_volume_pods -= 1
             self.pods[pod.uid] = pod
+            if pod.volumes:
+                self.n_volume_pods += 1
             self._add_task(pod)
             self.mirror.upsert_pod(pod, self.mirror.job_row)
             self._notify("Pod", "update", pod)
@@ -417,6 +429,8 @@ class ClusterStore:
             old = self.pods.pop(pod.uid, None)
             if old is not None:
                 self._remove_task(old)
+                if old.volumes:
+                    self.n_volume_pods -= 1
             if self.bind_backoff:
                 # Deleted pods must not pin backoff entries forever.
                 self.bind_backoff.pop(

@@ -1804,9 +1804,11 @@ class FastCycle:
         # Volume gate (statement.go allocate->AllocateVolumes, commit->
         # BindVolumes): pods carrying claims go through the volume binder
         # BEFORE their bind dispatches; a claim failure reverts exactly
-        # that pod to Pending.  Pods without volumes pay one truthiness
-        # check — at north-star scale the loop is claim-free.
-        if any(pod.volumes for pod in bound_pods):
+        # that pod to Pending.  Volume-free clusters skip on the store's
+        # exact O(1) counter (the 100k-pod truthiness scan is not free,
+        # and gating on store.pvcs would bypass custom volume binders).
+        if store.n_volume_pods and any(
+                pod.volumes for pod in bound_pods):
             vb = store.volume_binder
             vol_failed = []
             for pod, hostname, key in zip(bound_pods, hosts, keys):

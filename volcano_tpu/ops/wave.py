@@ -1205,7 +1205,7 @@ def _pad_profiles_rows(profiles: SolveProfiles) -> SolveProfiles:
 
 def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
                   pid: np.ndarray, wave_prof: np.ndarray, n_waves: int,
-                  skip_cnt0: bool = False):
+                  skip_cnt0: bool = False, skip_prof: bool = False):
     """Per-wave lists of the affinity terms the wave's profiles reference.
 
     Every [*, E] tensor in the kernel is gathered down to the wave's term
@@ -1213,7 +1213,12 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
     total terms.  One dummy scratch row is appended to the term axis and
     used as list padding, so the windowed count write-back scatters to
     unique real rows (duplicates only hit the dummy).
-    Returns (profiles, aff, wave_terms [NW, EW], EW).
+    Returns (profiles, aff, wave_terms [NW, EW], EW, iom) — iom being
+    the [U, E] nonzero union of the four profile-term tables (pre-dummy
+    columns; the sparse-shipping path reuses it).  ``skip_prof``: leave
+    the profile tables without the dummy column (the caller rebuilds
+    them on device at the dummy-extended width — skips four ~dense host
+    copies).
     """
     t_req_aff = _np(profiles.t_req_aff)
     E = t_req_aff.shape[1]
@@ -1228,12 +1233,13 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
             [a, np.zeros((*a.shape[:-1], 1), a.dtype)], axis=-1
         )
 
-    profiles = profiles._replace(
-        t_req_aff=zc(profiles.t_req_aff),
-        t_req_anti=zc(profiles.t_req_anti),
-        t_matches=zc(profiles.t_matches),
-        t_soft=zc(profiles.t_soft),
-    )
+    if not skip_prof:
+        profiles = profiles._replace(
+            t_req_aff=zc(profiles.t_req_aff),
+            t_req_anti=zc(profiles.t_req_anti),
+            t_matches=zc(profiles.t_matches),
+            t_soft=zc(profiles.t_soft),
+        )
     repl = {
         "term_key": np.concatenate(
             [_np(aff.term_key), np.zeros(1, np.int32)]
@@ -1455,27 +1461,28 @@ def solve_wave(
         extra_ok is not None,
         extra_score is not None,
     )
+    prof_sparse = (
+        _np(profiles.t_req_aff).size > PROF_SPARSE_MIN
+    )
     profiles, aff, wave_terms, ew, prof_iom = _term_windows(
-        profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse
+        profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse,
+        skip_prof=prof_sparse,
     )
     # Profile-term tables ([U, Ep] bool x3 + f32) reach ~75 MB at the
     # north-star affinity shape but are overwhelmingly zero (a profile
     # references only its own job's terms).  Past the threshold, ship
     # the sparse entries and rebuild dense on device — measured ~2 s of
     # per-cycle upload through the remote-TPU tunnel otherwise.
-    t_aff_h = _np(profiles.t_req_aff)
-    if t_aff_h.size > PROF_SPARSE_MIN:
+    if prof_sparse:
+        # The tables stayed at the pre-dummy width (skip_prof): gather
+        # flags at prof_iom's nonzeros and rebuild on device at the
+        # dummy-extended width — the dummy column is all-zero, so the
+        # entry set is identical.
+        t_aff_h = _np(profiles.t_req_aff)
         t_anti_h = _np(profiles.t_req_anti)
         t_mat_h = _np(profiles.t_matches)
         t_soft_h = _np(profiles.t_soft)
-        # prof_iom covers the pre-dummy columns; the dummy column is
-        # all-zero, so padding it reproduces the full union.
-        ur, ec = np.nonzero(
-            np.concatenate(
-                [prof_iom,
-                 np.zeros((prof_iom.shape[0], 1), bool)], axis=1,
-            )
-        )
+        ur, ec = np.nonzero(prof_iom)
         flags = (
             t_aff_h[ur, ec].astype(np.int8)
             | (t_anti_h[ur, ec].astype(np.int8) << 1)
@@ -1493,7 +1500,7 @@ def solve_wave(
             )
         d_aff, d_anti, d_mat, d_soft = _scatter_profile_tables(
             ur.astype(np.int32), ec.astype(np.int32), flags, soft_vals,
-            t_aff_h.shape[0], t_aff_h.shape[1],
+            t_aff_h.shape[0], t_aff_h.shape[1] + 1,
         )
         in_sh = getattr(cnt0_in, "sharding", None)
         if in_sh is not None and not isinstance(cnt0_in, np.ndarray):
