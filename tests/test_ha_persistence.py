@@ -180,3 +180,41 @@ def test_checkpoint_restore_preserves_topology_and_affinity(tmp_path):
     assert len(b.binder.binds) == 4
     # All in one slice (the affinity term resolved over restored topology).
     assert len({int(n[1]) // 2 for n in b.binder.binds.values()}) == 1
+
+
+def test_checkpoint_roundtrips_claims_and_policies(tmp_path):
+    """PVC records (incl. Bound state + node pins), network policies,
+    and the volume-pod counter survive checkpoint/restore — a restored
+    cluster must not wedge volume jobs or lose claim placements."""
+    from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.persistence import load_store, save_store
+    from volcano_tpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    store.add_node(Node(name="n0", allocatable={"cpu": "8",
+                                                "memory": "16Gi"}))
+    store.put_pvc("default", "user-data", {"storage": "5Gi"})
+    store.put_network_policy("default", "job-a",
+                             {"pod_selector": {"k": "v"},
+                              "ingress_from": [{"k": "v"}],
+                              "policy_types": ["Ingress"]})
+    store.add_pod_group(PodGroup(name="g", min_member=1))
+    store.add_pod(Pod(
+        name="p0",
+        containers=[{"cpu": "1", "memory": "1Gi"}],
+        annotations={GROUP_NAME_ANNOTATION: "g"},
+        volumes=[("user-data", "/data")],
+    ))
+    Scheduler(store).run_once()
+    assert store.pvcs["default/user-data"]["phase"] == "Bound"
+    assert store.n_volume_pods == 1
+
+    path = str(tmp_path / "state.ckpt")
+    save_store(store, path)
+    restored = load_store(path)
+    assert restored.pvcs["default/user-data"]["phase"] == "Bound"
+    assert restored.pvcs["default/user-data"]["node"] == "n0"
+    assert restored.network_policies["default/job-a"][
+        "policy_types"] == ["Ingress"]
+    assert restored.n_volume_pods == 1

@@ -8,10 +8,14 @@ store is its own system of record, so durability = serializing the spec
 objects and replaying them through the event API on load — the informer
 resync, replayed from a file instead of a watch stream.
 
-Only *spec* objects are persisted (pods, pod groups, queues, nodes,
-priority classes, namespace weights, batch jobs, commands, config maps,
-secrets, services); every derived structure (JobInfo/NodeInfo, the array
-mirror, controller caches) rebuilds through the normal mutation path.
+Spec objects are persisted (pods, pod groups, queues, nodes, priority
+classes, namespace weights, batch jobs, commands, config maps, secrets,
+services, network policies) plus PVC claim records — the one entry with
+durable STATUS (phase + provisioned node): claims bind durably in the
+reference too (PV controller state in etcd), and replay cannot rebuild
+a placement the scheduler chose.  Every derived structure
+(JobInfo/NodeInfo, the array mirror, controller caches, the
+volume-carrying-pod counter) rebuilds through the normal mutation path.
 """
 
 from __future__ import annotations
@@ -60,6 +64,8 @@ def save_store(store: ClusterStore, path: str) -> None:
             "config_maps": dict(store.config_maps),
             "secrets": dict(store.secrets),
             "services": dict(store.services),
+            "network_policies": dict(store.network_policies),
+            "pvcs": dict(store.pvcs),
         }
         # Serialize while still holding the lock: the payload holds live
         # object references that scheduler/controller threads mutate.
@@ -109,4 +115,7 @@ def load_store(path: str, store: Optional[ClusterStore] = None) -> ClusterStore:
         store.config_maps.update(payload["config_maps"])
         store.secrets.update(payload["secrets"])
         store.services.update(payload["services"])
+        # Added after the initial format; absent in older checkpoints.
+        store.network_policies.update(payload.get("network_policies", {}))
+        store.pvcs.update(payload.get("pvcs", {}))
     return store
