@@ -18,6 +18,7 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include "vcsnap.h"
 #include <queue>
 #include <vector>
 #include <cstring>

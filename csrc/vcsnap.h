@@ -1,0 +1,68 @@
+// Public C ABI of the native library (snapshot serializer kernels +
+// the reclaim engine).  Included by BOTH vcsnap.cc and the smoke test
+// so signature drift is a compile error instead of runtime UB.
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+int vcsnap_version();
+void vcsnap_pack_bits(const int32_t* indices, const int64_t* offsets,
+                      int64_t rows, int32_t words, uint32_t* out);
+void vcsnap_scatter_f32(const int32_t* slots, const float* values,
+                        const int64_t* offsets, int64_t rows,
+                        int32_t width, float* out);
+void vcsnap_gather_rows_f32(const float* src, const int32_t* order,
+                            int64_t rows, int32_t width, float* out);
+void vcsnap_less_equal(const float* l, const float* rhs, const float* eps,
+                       const uint8_t* scalar_slot, int64_t rows,
+                       int32_t r, uint8_t* out);
+
+void* vcreclaim_ctx_new(
+    const long long* node_ptr, const long long* node_rows,
+    int16_t* p_status, const int32_t* p_job,
+    const float* req, const uint8_t* req_empty, const uint8_t* critical,
+    const int32_t* j_minav, int32_t* j_ready_base,
+    int32_t* j_cnt_alloc, int32_t* j_cnt_run, int32_t* j_cnt_releasing,
+    float* j_alloc_res, const int32_t* q_of_job,
+    const uint8_t* q_reclaimable, float* q_alloc,
+    const float* q_deserved, const uint8_t* q_has_deserved,
+    float* fi, float* n_releasing,
+    const int32_t* tiers, long long tiers_len,
+    const float* eps, const uint8_t* scalar_slot,
+    const uint8_t* alive, const float* init_req_base,
+    long long Nn, long long R,
+    long long st_running, long long st_releasing,
+    float* n_pipelined, int32_t* n_ntasks, const int32_t* n_maxtasks,
+    long long* pipe_node, int32_t* j_cnt_pending, long long* j_waiting,
+    long long* j_version, long long* q_version, long long Qn,
+    const int32_t* j_prio, const int32_t* j_rank,
+    const int32_t* p_node,
+    const float* total_res, const int32_t* job_order,
+    long long job_order_len, long long reclaim_gated);
+void vcreclaim_ctx_free(void* ctx);
+long long vcreclaim_step(
+    void* ctx_p, long long prow, long long qid,
+    long long* cursor,
+    const uint8_t* anym, const uint8_t* feas, const uint8_t* stat,
+    const uint8_t* slots,
+    long long* out_evicted, long long* out_n_evicted,
+    long long max_evicted);
+long long vcreclaim_drive(
+    void* ctx_p, long long qid, long long has_pred,
+    const long long* job_ids, long long n_jobs,
+    const long long* task_ptr, const long long* task_rows,
+    long long* task_cursor, const int32_t* row_maskidx,
+    long long n_masks,
+    unsigned long long* anym_ptrs, unsigned long long* feas_ptrs,
+    unsigned long long* stat_ptrs, unsigned long long* slots_ptrs,
+    unsigned long long* initreq_ptrs,
+    long long* mask_cursors,
+    long long* out_evicted, long long* out_n_evicted, long long max_ev,
+    long long* out_pipe_rows, long long* out_pipe_nodes,
+    long long* out_n_pipe,
+    long long* out_touched, long long* out_n_touched,
+    long long max_touched,
+    long long* out_yield_job, uint8_t* out_job_dropped);
+
+}  // extern "C"

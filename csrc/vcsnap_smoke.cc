@@ -9,44 +9,55 @@
 //
 // Build+run:  make test   (links vcsnap.cc directly, ASAN flags)
 
+#undef NDEBUG
 #include <cassert>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <vector>
 
-extern "C" {
-int vcsnap_version();
-void* vcreclaim_ctx_new(
-    const long long*, const long long*, int16_t*, const int32_t*,
-    const float*, const uint8_t*, const uint8_t*, const int32_t*,
-    int32_t*, int32_t*, int32_t*, int32_t*, float*, const int32_t*,
-    const uint8_t*, float*, const float*, const uint8_t*, float*, float*,
-    const int32_t*, long long, const float*, const uint8_t*,
-    const uint8_t*, const float*, long long, long long, long long,
-    long long,
-    float*, int32_t*, const int32_t*, long long*, int32_t*, long long*,
-    long long*, long long*, long long, const int32_t*, const int32_t*,
-    const int32_t*, const float*, const int32_t*, long long, long long);
-void vcreclaim_ctx_free(void*);
-long long vcreclaim_step(
-    void*, long long, long long, long long*, const uint8_t*,
-    const uint8_t*, const uint8_t*, const uint8_t*, long long*,
-    long long*, long long);
-long long vcreclaim_drive(
-    void*, long long, long long, const long long*, long long,
-    const long long*, const long long*, long long*, const int32_t*,
-    long long, unsigned long long*, unsigned long long*,
-    unsigned long long*, unsigned long long*, unsigned long long*,
-    long long*, long long*, long long*, long long, long long*,
-    long long*, long long*, long long*, long long*, long long,
-    long long*, uint8_t*);
-}
+#include "vcsnap.h"
 
 enum { ST_PENDING = 1 << 0, ST_RUNNING = 1 << 5, ST_RELEASING = 1 << 7 };
 
+static void smoke_serializer() {
+  // CSR bit pack: rows {0:[1,33]}, {1:[2]}.
+  std::vector<int32_t> idx = {1, 33, 2};
+  std::vector<int64_t> off = {0, 2, 3};
+  std::vector<uint32_t> bits(2 * 2, 0);
+  vcsnap_pack_bits(idx.data(), off.data(), 2, 2, bits.data());
+  assert(bits[0] == (1u << 1) && bits[1] == (1u << 1));
+  assert(bits[2] == (1u << 2) && bits[3] == 0);
+  // CSR scatter: row 0 slot 1 = 7.5.
+  std::vector<int32_t> slots = {1};
+  std::vector<float> vals = {7.5f};
+  std::vector<int64_t> soff = {0, 1};
+  std::vector<float> dense(1 * 3, 0.0f);
+  vcsnap_scatter_f32(slots.data(), vals.data(), soff.data(), 1, 3,
+                     dense.data());
+  assert(dense[1] == 7.5f && dense[0] == 0.0f);
+  // Row gather; -1 rows are skipped (the Python wrapper provides a
+  // zeroed out-buffer, so skipped == zero row).
+  std::vector<float> srcm = {1, 2, 3, 4};
+  std::vector<int32_t> order = {1, -1};
+  std::vector<float> gout(2 * 2, 0.0f);
+  vcsnap_gather_rows_f32(srcm.data(), order.data(), 2, 2, gout.data());
+  assert(gout[0] == 3 && gout[1] == 4 && gout[2] == 0 && gout[3] == 0);
+  // Epsilon LessEqual rows.
+  std::vector<float> l = {1000, 500, 2000, 500};
+  std::vector<float> rhs = {1500, 600};
+  std::vector<float> eps = {10, 10};
+  std::vector<uint8_t> ss = {0, 0};
+  std::vector<uint8_t> ok(2, 2);
+  vcsnap_less_equal(l.data(), rhs.data(), eps.data(), ss.data(), 2, 2,
+                    ok.data());
+  assert(ok[0] == 1 && ok[1] == 0);
+  std::printf("serializer kernels OK\n");
+}
+
 int main() {
   std::printf("vcsnap_version=%d\n", vcsnap_version());
+  smoke_serializer();
 
   // Cluster: 4 nodes x 2 slots; queue 0 = "victim" (reclaimable),
   // queue 1 = "premium".  Rows 0-7: running victims (job per row, queue
@@ -119,13 +130,14 @@ int main() {
 
   // ---- single step: reclaimer row 8 should evict a victim on node 0
   // and pipeline there.
-  std::vector<uint8_t> anym(N, 1), feas(N, 1), ones(N, 1);
+  std::vector<uint8_t> anym(N, 1), feas(N, 1), ones(N, 1),
+      slots_mask(N, 1);
   long long cursor = 0;
   std::vector<long long> evicted(P);
   long long n_ev = 0;
   long long node = vcreclaim_step(
       ctx, 8, 1, &cursor, anym.data(), feas.data(), ones.data(),
-      ones.data(), evicted.data(), &n_ev, P);
+      slots_mask.data(), evicted.data(), &n_ev, P);
   std::printf("step: node=%lld evicted=%lld\n", node, n_ev);
   assert(node == 0);
   assert(n_ev == 1);
@@ -144,7 +156,8 @@ int main() {
   unsigned long long anym_p[1] = {(unsigned long long)anym.data()};
   unsigned long long feas_p[1] = {(unsigned long long)feas.data()};
   unsigned long long stat_p[1] = {(unsigned long long)ones.data()};
-  unsigned long long slot_p[1] = {(unsigned long long)ones.data()};
+  unsigned long long slot_p[1] = {
+      (unsigned long long)slots_mask.data()};
   std::vector<float> ireq8 = {4000.0f, 1.0e9f};
   unsigned long long ireq_p[1] = {(unsigned long long)ireq8.data()};
   long long mask_cur[1] = {0};
