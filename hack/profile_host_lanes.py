@@ -1,0 +1,79 @@
+"""Profile the host lanes (derive/order/encode/commit/close/enqueue) of one
+north-star cycle (10k nodes x 100k pods, plain) under cProfile.
+
+The device lane dominates wall-clock but is excluded from analysis; the
+point is the per-function split of the ~350 ms of host work VERDICT r3
+flagged.  Run on the real chip (default platform) so chunking and shapes
+match the bench exactly:
+
+    python hack/profile_host_lanes.py [n_nodes n_pods]
+
+Env: PROF_SORT=cumulative|tottime (default tottime), PROF_LINES=40.
+"""
+
+import cProfile
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from volcano_tpu.scheduler import Scheduler  # noqa: E402
+from volcano_tpu.synth import synthetic_cluster  # noqa: E402
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def main():
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 100000
+    mk = lambda seed: synthetic_cluster(
+        n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16, seed=seed
+    )
+    # Warm-up: compile + populate jit caches.
+    store = mk(0)
+    store.async_bind = True
+    t0 = time.perf_counter()
+    Scheduler(store, conf_str=CONF).run_once()
+    print(f"warm cycle {time.perf_counter() - t0:.2f}s", file=sys.stderr)
+    store.flush_binds()
+    store.close()
+
+    store = mk(1)
+    store.async_bind = True
+    sched = Scheduler(store, conf_str=CONF)
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    sched.run_once()
+    prof.disable()
+    dt = time.perf_counter() - t0
+    lanes = getattr(store, "last_cycle_lanes", None) or {}
+    lane_s = " ".join(
+        f"{k}={v * 1e3:.0f}ms"
+        for k, v in sorted(lanes.items(), key=lambda kv: -kv[1])
+    )
+    print(f"profiled cycle {dt * 1e3:.0f}ms  lanes[{lane_s}]", file=sys.stderr)
+    store.flush_binds()
+    store.close()
+
+    st = pstats.Stats(prof)
+    st.sort_stats(os.environ.get("PROF_SORT", "tottime"))
+    st.print_stats(int(os.environ.get("PROF_LINES", 40)))
+
+
+if __name__ == "__main__":
+    main()

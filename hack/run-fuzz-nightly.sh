@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Nightly-depth fuzz: the same eviction-parity families CI runs at 8
+# seeds, widened to 150 (or $1) seeds per family.  One CI-runnable
+# target so the documented seed count is executable, not aspirational.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SEEDS="${1:-150}"
+export VOLCANO_TPU_FUZZ_SEEDS="$SEEDS"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python -m pytest tests/test_evict_oracle.py tests/test_mirror_fuzz.py \
+  -q --no-header "${@:2}"

@@ -11,6 +11,8 @@ pure-NumPy victim-selection oracles (``oracle.oracle_victims``,
 statement-rollback exactness property (statement.go:324-367).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -30,6 +32,11 @@ from volcano_tpu.oracle import (
     oracle_victims,
 )
 from volcano_tpu.scheduler import Scheduler
+
+# Fuzz breadth is env-scalable (the durable CI default is 8 seeds per
+# family; `hack/run-fuzz-nightly.sh` runs the same families at 150).
+FUZZ_SEEDS = int(os.environ.get("VOLCANO_TPU_FUZZ_SEEDS", "8"))
+FUZZ_SEEDS_SMALL = max(4, FUZZ_SEEDS // 2)
 
 EVICT_CONF = """
 actions: "enqueue, allocate, preempt, reclaim, backfill"
@@ -67,6 +74,9 @@ def oversubscribed_store(seed: int) -> ClusterStore:
             name=f"node-{i:03d}",
             allocatable={"cpu": str(node_cpu),
                          "memory": f"{node_cpu * 4}Gi", "pods": 64},
+            # Topology labels ride the eviction/placement machinery
+            # (zone-keyed domains exist even when no pod selects them).
+            topology={"topology.kubernetes.io/zone": f"zone-{i % 3}"},
         ))
     # Fill nodes with running gangs from the victim queue.
     g = 0
@@ -88,6 +98,14 @@ def oversubscribed_store(seed: int) -> ClusterStore:
                           queue="victim")
             store.add_pod_group(pg)
             for k in range(size):
+                # ~10% of victims hold a claim: eviction of volume-
+                # carrying pods must not disturb the claim registry or
+                # diverge the victim sets.
+                volumes = []
+                if rng.random() < 0.1:
+                    claim = f"claim-fill-{g:04d}-{k}"
+                    store.put_pvc("default", claim, {"storage": "1Gi"})
+                    volumes = [(claim, "/data")]
                 store.add_pod(Pod(
                     name=f"fill-{g:04d}-{k}",
                     annotations={GROUP_NAME_ANNOTATION: pg.name},
@@ -95,6 +113,7 @@ def oversubscribed_store(seed: int) -> ClusterStore:
                                  "memory": f"{cpu * 2}Gi"}],
                     phase=PodPhase.Running,
                     node_name=f"node-{i:03d}",
+                    volumes=volumes,
                     priority_class=(
                         "system-node-critical" if critical else prio_name
                     ),
@@ -111,11 +130,19 @@ def oversubscribed_store(seed: int) -> ClusterStore:
                       queue="premium")
         store.add_pod_group(pg)
         for k in range(size):
+            # ~20% of preemptors carry a claim; any that allocate in the
+            # same cycle exercise the commit-path volume gate.
+            volumes = []
+            if rng.random() < 0.2:
+                claim = f"claim-hi-{j:03d}-{k}"
+                store.put_pvc("default", claim, {"storage": "1Gi"})
+                volumes = [(claim, "/data")]
             store.add_pod(Pod(
                 name=f"hi-{j:03d}-{k}",
                 annotations={GROUP_NAME_ANNOTATION: pg.name},
                 containers=[{"cpu": str(int(rng.choice([8, 12]))),
                              "memory": "8Gi"}],
+                volumes=volumes,
                 priority_class="high",
                 priority=10000,
             ))
@@ -132,7 +159,7 @@ def evicted_keys(store: ClusterStore) -> set:
     return set(getattr(store.evictor, "evicts", []))
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS))
 def test_fast_vs_object_victim_sets_identical(seed, monkeypatch):
     fast_store = oversubscribed_store(seed)
     obj_store = oversubscribed_store(seed)
@@ -145,7 +172,7 @@ def test_fast_vs_object_victim_sets_identical(seed, monkeypatch):
     )
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS))
 def test_gang_protection_property(seed, monkeypatch):
     """gang.go:74-98: an eviction never takes a running job below its
     MinAvailable (unless MinAvailable == 1)."""
@@ -174,7 +201,7 @@ def test_gang_protection_property(seed, monkeypatch):
         )
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS))
 def test_conformance_property(seed, monkeypatch):
     """conformance.go:44-66: critical pods are never victims."""
     store = oversubscribed_store(seed)
@@ -357,7 +384,7 @@ def test_oracle_backfill_parity_with_fast_cycle(monkeypatch):
     assert f"node-{got[0]}" or True  # index 1 == n1 by construction
 
 
-@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS_SMALL))
 def test_fast_vs_object_victims_with_scalar_resources(seed, monkeypatch):
     """Extended scalar resources ride the reclaim proportion walk
     (Resource dict-entry semantics — zeroed entries persist, subtrahend
@@ -465,7 +492,7 @@ def test_fast_vs_object_victims_with_scalar_resources(seed, monkeypatch):
             == evicted_keys(stores["object"]))
 
 
-@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS_SMALL))
 def test_drive_yield_path_parity(seed, monkeypatch):
     """The C reclaim driver yields tasks it cannot handle exactly
     (host ports here) back to a Python turn; fast and object paths must

@@ -1,11 +1,14 @@
-"""Binds crossing a real process boundary (PARITY deviation 5 proof).
+"""Side effects crossing a real process boundary (PARITY deviation 5
+proof).
 
-The reference scheduler's binds are RPCs to the API server
-(cache.go:492-554) with errTasks backoff on failure (:627-649).  These
-tests run a RemoteBindService in a SECOND PROCESS and drive the store's
-async BindDispatcher through the HttpBinder drop-in: success lands the
-bind table server-side; injected failures exercise BindFailure ->
-Pending revert -> backoff -> retry end to end across the boundary.
+The reference scheduler's binds, evictions, and status updates are RPCs
+to the API server (cache.go:492-554 Bind, :439-491 Evict, :556-599
+status) with errTasks backoff on bind failure (:627-649).  These tests
+run a RemoteBindService in a SECOND PROCESS and drive the store's three
+side-effect interfaces through the Http* drop-ins: success lands
+server-side; injected failures exercise BindFailure -> Pending revert ->
+backoff -> retry and EvictFailure -> Running revert -> retry end to end
+across the boundary.
 """
 
 import subprocess
@@ -15,10 +18,39 @@ import urllib.request
 
 import pytest
 
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    PriorityClass,
+    Queue,
+)
 from volcano_tpu.cache import ClusterStore
-from volcano_tpu.cache.remote import HttpBinder, RemoteBindService
+from volcano_tpu.cache.remote import (
+    HttpBinder,
+    HttpEvictor,
+    HttpStatusUpdater,
+    RemoteBindService,
+)
 from volcano_tpu.scheduler import Scheduler
 from volcano_tpu.synth import synthetic_cluster
+
+EVICT_CONF = """
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
 
 
 @pytest.fixture()
@@ -94,6 +126,119 @@ def test_remote_failure_exercises_backoff(remote_binder_process,
     assert store.flush_binds(timeout=30)
     assert len(client.binds()) == 16
     assert all(p.node_name for p in store.pods.values())
+    store.close()
+
+
+def _oversubscribed_store() -> ClusterStore:
+    """One full node of low-priority victims + a pending high-priority
+    gang that only fits by evicting (the config-4 shape, miniature)."""
+    store = ClusterStore()
+    store.add_priority_class(PriorityClass(name="low", value=100))
+    store.add_priority_class(PriorityClass(name="high", value=10000))
+    store.add_queue(Queue(name="victim", weight=1))
+    store.add_queue(Queue(name="premium", weight=9))
+    store.add_node(Node(name="n0",
+                        allocatable={"cpu": "16", "memory": "32Gi"}))
+    for k in range(2):
+        pg = PodGroup(name=f"fill-{k}", min_member=1, queue="victim")
+        store.add_pod_group(pg)
+        store.add_pod(Pod(
+            name=f"fill-{k}-0",
+            annotations={GROUP_NAME_ANNOTATION: pg.name},
+            containers=[{"cpu": "8", "memory": "16Gi"}],
+            phase=PodPhase.Running, node_name="n0",
+            priority_class="low", priority=100,
+        ))
+    store.add_pod_group(PodGroup(name="hi", min_member=1,
+                                 queue="premium"))
+    store.add_pod(Pod(
+        name="hi-0",
+        annotations={GROUP_NAME_ANNOTATION: "hi"},
+        containers=[{"cpu": "12", "memory": "8Gi"}],
+        priority_class="high", priority=10000,
+    ))
+    return store
+
+
+def test_evictions_cross_process_boundary(remote_binder_process):
+    """A preempt/reclaim cycle whose evictions land in a second OS
+    process (cache.go:439-491 as a real RPC)."""
+    url = remote_binder_process
+    store = _oversubscribed_store()
+    store.evictor = HttpEvictor(url)
+    Scheduler(store, conf_str=EVICT_CONF).run_once()
+    remote_evicts = HttpEvictor(url).evicts()
+    assert remote_evicts, "no evictions crossed the boundary"
+    # Remote channel agrees with local terminating pods.
+    deleting = {f"{p.namespace}/{p.name}"
+                for p in store.pods.values() if p.deleting}
+    assert set(remote_evicts) == deleting
+    store.close()
+
+
+def test_remote_evict_failure_reverts_and_retries(remote_binder_process):
+    """EvictFailure -> victims revert to Running (not terminating) ->
+    the next cycle re-selects and the evictions land remotely."""
+    url = remote_binder_process
+    store = _oversubscribed_store()
+    client = HttpEvictor(url)
+    store.evictor = client
+    client.chaos_fail_next(1)  # the next evict batch fails wholesale
+
+    sched = Scheduler(store, conf_str=EVICT_CONF)
+    sched.run_once()
+    assert not client.evicts()  # nothing landed remotely
+    assert not any(p.deleting for p in store.pods.values())
+    # The failure is user-visible on the victims' event trails.
+    assert any(
+        ev["reason"] == "EvictFailed"
+        for p in store.pods.values()
+        for ev in store.events_for(f"Pod/{p.namespace}/{p.name}")
+    )
+
+    sched.run_once()  # retry cycle: chaos exhausted
+    remote_evicts = client.evicts()
+    assert remote_evicts
+    deleting = {f"{p.namespace}/{p.name}"
+                for p in store.pods.values() if p.deleting}
+    assert set(remote_evicts) == deleting
+    store.close()
+
+
+def test_object_path_remote_evict_failure_reverts(remote_binder_process,
+                                                  monkeypatch):
+    """The object session's per-pod evict takes the same revert path
+    (store.evict catches EvictFailure)."""
+    monkeypatch.setenv("VOLCANO_TPU_FASTPATH", "0")
+    url = remote_binder_process
+    store = _oversubscribed_store()
+    client = HttpEvictor(url)
+    store.evictor = client
+    client.chaos_fail_next(10)  # per-pod requests: fail several batches
+    Scheduler(store, conf_str=EVICT_CONF).run_once()
+    assert not client.evicts()
+    assert not any(p.deleting for p in store.pods.values())
+    running = [p for p in store.pods.values()
+               if p.phase == PodPhase.Running and not p.deleting]
+    assert len(running) == 2
+    store.close()
+
+
+def test_podgroup_status_crosses_process_boundary(remote_binder_process):
+    """Session-close PodGroup status write-back lands in the second
+    process (cache.go:556-599 as a real RPC)."""
+    url = remote_binder_process
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=4)
+    store.status_updater = HttpStatusUpdater(url)
+    Scheduler(store).run_once()
+    remote = HttpStatusUpdater(url).pod_groups()
+    assert remote, "no PodGroup status crossed the boundary"
+    for uid, g in remote.items():
+        pg = store.pod_groups[uid]
+        assert g["phase"] == pg.status.phase
+        assert g["running"] == pg.status.running
+    # Every live PodGroup's latest status is what the remote holds.
+    assert set(remote) == set(store.pod_groups)
     store.close()
 
 

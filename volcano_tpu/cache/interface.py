@@ -47,6 +47,20 @@ class VolumeBindFailure(Exception):
     retries next cycle."""
 
 
+class EvictFailure(Exception):
+    """Raised by an evictor when some evictions could not be dispatched.
+
+    ``failed`` holds the "ns/name" keys that did NOT evict.  Both evict
+    paths revert exactly those pods to Running (deleting flag cleared,
+    mirror status restored) so the next preempt/reclaim cycle re-selects
+    them — the reference's Evict-RPC error path resyncs the task from
+    the API server the same way (cache.go:439-491 resyncTask)."""
+
+    def __init__(self, failed):
+        super().__init__(f"{len(failed)} evictions failed")
+        self.failed = list(failed)
+
+
 class BindFailure(Exception):
     """Raised by a binder when some binds could not be dispatched.
 
@@ -120,6 +134,14 @@ class FakeStatusUpdater:
 
     def update_pod_group(self, pg: PodGroup) -> None:
         self.pod_groups.append(pg)
+
+    def update_pod_groups(self, pgs) -> None:
+        """Batched write-back (one call per session close).  Delegates
+        per group so instance-level overrides of ``update_pod_group``
+        (a common test seam) still observe every write; true batch
+        transports (HttpStatusUpdater) override this wholesale."""
+        for pg in pgs:
+            self.update_pod_group(pg)
 
 
 class FakeVolumeBinder:

@@ -206,6 +206,10 @@ class StoreMirror:
         # fast path's bulk commit reach 100k pod objects by list indexing
         # instead of 100k string-keyed dict lookups.
         self.p_pod: List[Optional[Pod]] = []
+        # Count of None entries in p_pod (tombstoned rows): lets the
+        # commit path skip its defensive 100k-element None scan when no
+        # pod has ever been removed (the common bench/steady case).
+        self.p_pod_nones = 0
         self.p_feat: List[Optional[_PodFeat]] = []
         self.p_row: Dict[str, int] = {}
         self.p_status = np.zeros(cap, np.int16)
@@ -578,6 +582,8 @@ class StoreMirror:
             return
         self.p_alive[row] = False
         self.p_uid[row] = None
+        if self.p_pod[row] is not None:
+            self.p_pod_nones += 1
         self.p_pod[row] = None
         self.n_dead += 1
 

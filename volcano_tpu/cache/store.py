@@ -162,18 +162,54 @@ class ClusterStore:
 
         now = _time.time()
         with self._events_lock:
-            if (key not in self._events
-                    and len(self._events) >= self.MAX_EVENT_OBJECTS):
-                self._events.pop(next(iter(self._events)))
-            trail = self._events.setdefault(key, [])
-            for ev in trail:
-                if ev[0] == reason and ev[1] == message:
-                    ev[2] += 1
-                    ev[4] = now
-                    return
-            trail.append([reason, message, 1, now, now])
-            if len(trail) > self.EVENTS_PER_OBJECT:
-                del trail[0]
+            self._record_event_locked(key, reason, message, now)
+
+    def _record_event_locked(self, key, reason, message, now) -> None:
+        if (key not in self._events
+                and len(self._events) >= self.MAX_EVENT_OBJECTS):
+            self._events.pop(next(iter(self._events)))
+        trail = self._events.setdefault(key, [])
+        for ev in trail:
+            if ev[0] == reason and ev[1] == message:
+                ev[2] += 1
+                ev[4] = now
+                return
+        trail.append([reason, message, 1, now, now])
+        if len(trail) > self.EVENTS_PER_OBJECT:
+            del trail[0]
+
+    def record_events(self, items) -> None:
+        """Batched ``record_event``: one lock acquisition and one clock
+        read for a whole commit's worth of (key, reason, message) tuples.
+        The reference's event recorder is likewise an async batcher the
+        bind goroutines feed (cache.go:540); at 100k binds/cycle the
+        per-call lock + clock overhead is what the batch amortizes."""
+        import time as _time
+
+        now = _time.time()
+        items = items if isinstance(items, list) else list(items)
+        if len(items) >= self.MAX_EVENT_OBJECTS:
+            # Bulk fast path (100k bind Scheduled events): inserting N >>
+            # cap distinct keys one at a time evicts every pre-existing
+            # trail AND the first N-cap batch entries — identical end
+            # state to clearing and keeping the batch tail.  Only taken
+            # when the batch alone overflows the cap with distinct keys.
+            tail: Dict[str, List[list]] = {}
+            for key, reason, message in reversed(items):
+                if key not in tail:
+                    tail[key] = [[reason, message, 1, now, now]]
+                    if len(tail) >= self.MAX_EVENT_OBJECTS:
+                        break
+            if len(tail) >= self.MAX_EVENT_OBJECTS:
+                with self._events_lock:
+                    self._events.clear()
+                    # reversed() above collected newest-first; restore
+                    # insertion order oldest-first for FIFO eviction.
+                    self._events.update(reversed(tail.items()))
+                return
+        with self._events_lock:
+            for key, reason, message in items:
+                self._record_event_locked(key, reason, message, now)
 
     def events_for(self, key: str) -> List[dict]:
         with self._events_lock:
@@ -224,9 +260,13 @@ class ClusterStore:
         if self.bind_backoff:
             with self._bind_fail_lock:
                 self._succeeded_bind_keys.extend(keys)
-        for key, host in zip(keys, hosts):
-            self.record_event(f"Pod/{key}", "Scheduled",
-                              f"bound to {host}")
+        # One lock for the whole batch: this runs on the dispatcher
+        # thread concurrently with the next scheduling cycle, and per-pod
+        # lock churn at 100k binds starves the cycle thread of the GIL.
+        self.record_events(
+            (f"Pod/{key}", "Scheduled", f"bound to {host}")
+            for key, host in zip(keys, hosts)
+        )
 
     def drain_bind_failures(self) -> int:
         """Apply queued bind failures: the task re-enters Pending with an
@@ -722,7 +762,25 @@ class ClusterStore:
             self.pods[pod.uid] = pod
             self._add_task(pod)
             self.mirror.upsert_pod(pod, self.mirror.job_row)
-            self.evictor.evict(pod)
+            try:
+                self.evictor.evict(pod)
+            except Exception:
+                # Evict dispatch failed (EvictFailure or a transport
+                # error): the pod is NOT terminating.  Revert the record
+                # (cache.go:461-466 resyncTask) and let the next cycle
+                # re-select victims.
+                self._remove_task(pod)
+                pod = copy.copy(pod)
+                pod.deleting = False
+                self.pods[pod.uid] = pod
+                self._add_task(pod)
+                self.mirror.upsert_pod(pod, self.mirror.job_row)
+                self.record_event(
+                    f"Pod/{pod.namespace}/{pod.name}", "EvictFailed",
+                    "evict dispatch failed; will retry",
+                )
+                self._notify("Pod", "update", pod)
+                return
             self.record_event(
                 f"Pod/{pod.namespace}/{pod.name}", "Evict",
                 reason or "evicted by scheduler",

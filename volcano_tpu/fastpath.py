@@ -80,6 +80,22 @@ ST_UNKNOWN = int(TaskStatus.Unknown)
 
 _ALLOCATED_STATUSES = (ST_BOUND, ST_BINDING, ST_RUNNING, ST_ALLOCATED)
 
+# PodGroup phase coding for the cycle's j_phase array (5 = any other
+# phase; 0 = no PodGroup).  _close writes back phases only through
+# _PHASE_BY_CODE, so code 5 is never produced as a NEW phase.
+_PHASE_CODE = {
+    PodGroupPhase.Pending.value: 1,
+    PodGroupPhase.Inqueue.value: 2,
+    PodGroupPhase.Running.value: 3,
+    PodGroupPhase.Unknown.value: 4,
+}
+_PHASE_BY_CODE = {
+    1: PodGroupPhase.Pending.value,
+    2: PodGroupPhase.Inqueue.value,
+    3: PodGroupPhase.Running.value,
+    4: PodGroupPhase.Unknown.value,
+}
+
 
 def _pow2(n: int, minimum: int = 8) -> int:
     b = minimum
@@ -319,21 +335,35 @@ class FastCycle:
         ]
         # One pass over the podgroup dict serves every later consumer
         # (_enqueue / _schedulable_rows / _close previously each paid a
-        # 12k+-element dict-lookup loop).  j_phase codes: 0 = missing,
-        # 1 = Pending, 2 = other.
+        # 12k+-element dict-lookup loop).  j_phase codes (_PHASE_CODE):
+        # 0 = missing, 1 = Pending, 2 = Inqueue, 3 = Running,
+        # 4 = Unknown, 5 = other — the full coding lets _close compute
+        # its jobStatus write-back vectorized instead of re-reading
+        # 12k PodGroup objects.  The j_st_* arrays snapshot the
+        # last-written status counters for the same change detection.
         pgs = self.store.pod_groups
         j_pgs: List[Optional[object]] = [None] * Jn
         j_phase = np.zeros(Jn, np.int8)
-        pending_phase = PodGroupPhase.Pending.value
+        j_st_run = np.zeros(Jn, I)
+        j_st_fail = np.zeros(Jn, I)
+        j_st_succ = np.zeros(Jn, I)
+        phase_code = _PHASE_CODE
         j_uid = m.j_uid
         for row in self.session_jobs:
             pg = pgs.get(j_uid[row])
             if pg is None:
                 continue
             j_pgs[row] = pg
-            j_phase[row] = 1 if pg.status.phase == pending_phase else 2
+            st = pg.status
+            j_phase[row] = phase_code.get(st.phase, 5)
+            j_st_run[row] = st.running
+            j_st_fail[row] = st.failed
+            j_st_succ[row] = st.succeeded
         self.j_pgs = j_pgs
         self.j_phase = j_phase
+        self.j_st_run = j_st_run
+        self.j_st_fail = j_st_fail
+        self.j_st_succ = j_st_succ
 
     # ---------------------------------------------------------- resources
 
@@ -572,18 +602,24 @@ class FastCycle:
             for opt in self._tier_opts("enabled_namespace_order")
         )):
             return {}
-        ns_alloc: Dict[str, np.ndarray] = {}
-        for row in self.session_jobs:
-            ns = self.m.j_ns[row]
-            ns_alloc.setdefault(ns, np.zeros(self.R, F))
-            ns_alloc[ns] += self.j_alloc_res[row]
+        m = self.m
+        srows = np.asarray(self.session_jobs, np.int64)
+        if not len(srows):
+            return {}
+        # One scatter-add over namespace codes replaces the per-job
+        # vector accumulation loop.
+        nsc = m.j_ns_code[srows]
+        agg = np.zeros((int(nsc.max()) + 1, self.R), F)
+        np.add.at(agg, nsc, self.j_alloc_res[srows])
         total = self.total_res
         out = {}
-        for ns, al in ns_alloc.items():
+        for c in np.unique(nsc).tolist():
+            al = agg[c]
             with np.errstate(divide="ignore", invalid="ignore"):
                 ratio = np.where(total > 0, al / np.where(total > 0, total, 1.0),
                                  np.where(al > 0, 1.0, 0.0))
             s = float(ratio.max()) if len(ratio) else 0.0
+            ns = m.ns_names.items[c]
             w = self.store.namespace_weights.get(ns, 1)
             out[ns] = s / float(max(w, 1))
         return out
@@ -616,37 +652,50 @@ class FastCycle:
         self.lanes["derive"] = time.perf_counter() - t0
         self.new_conditions: Dict[int, PodGroupCondition] = {}
         self._evictor = None
+        # Async bind batches commit collects; dispatched at cycle end so
+        # the dispatcher thread's drain (binder RPCs, Scheduled events)
+        # does not contend the GIL with commit/close — in the reference
+        # that work runs in the API-server process, not the scheduler's.
+        self._bind_batches: List[tuple] = []
         try:
-            for name in self.action_names:
-                t0 = time.perf_counter()
-                with metrics.action_timer(name):
-                    if name == "enqueue":
-                        self._enqueue()
-                    elif name == "allocate":
-                        self._allocate()
-                    elif name == "backfill":
-                        self._backfill()
-                    elif name == "preempt":
-                        self._evict_machinery().preempt()
-                    elif name == "reclaim":
-                        self._evict_machinery().reclaim()
-                if name in ("preempt", "reclaim", "enqueue", "backfill"):
-                    self.lanes[name] = (
-                        self.lanes.get(name, 0.0)
-                        + time.perf_counter() - t0
-                    )
-        except BaseException:
-            # A failed cycle may leave uncommitted status mutations in the
-            # mirror (evictions mid-statement); re-derive dynamic state
-            # from the pod records before the caller falls back.
-            self.m.resync_status(self.store.pods)
-            raise
-        if self._evictor is not None:
-            self._evictor.st.flush()
-        t0 = time.perf_counter()
-        self._close()
-        self.lanes["close"] = time.perf_counter() - t0
-        store.last_cycle_lanes = dict(self.lanes)
+            try:
+                for name in self.action_names:
+                    t0 = time.perf_counter()
+                    with metrics.action_timer(name):
+                        if name == "enqueue":
+                            self._enqueue()
+                        elif name == "allocate":
+                            self._allocate()
+                        elif name == "backfill":
+                            self._backfill()
+                        elif name == "preempt":
+                            self._evict_machinery().preempt()
+                        elif name == "reclaim":
+                            self._evict_machinery().reclaim()
+                    if name in ("preempt", "reclaim", "enqueue",
+                                "backfill"):
+                        self.lanes[name] = (
+                            self.lanes.get(name, 0.0)
+                            + time.perf_counter() - t0
+                        )
+            except BaseException:
+                # A failed cycle may leave uncommitted status mutations
+                # in the mirror (evictions mid-statement); re-derive
+                # dynamic state from the pod records before the caller
+                # falls back.
+                self.m.resync_status(self.store.pods)
+                raise
+            if self._evictor is not None:
+                self._evictor.st.flush()
+            t0 = time.perf_counter()
+            self._close()
+            self.lanes["close"] = time.perf_counter() - t0
+            store.last_cycle_lanes = dict(self.lanes)
+        finally:
+            # Committed binds dispatch even when close fails: binds are
+            # idempotent and the commit bookkeeping already happened.
+            for keys, hosts, pods in self._bind_batches:
+                store.dispatch_binds(keys, hosts, pods)
 
     def _evict_machinery(self):
         self._flush_aggr()
@@ -696,33 +745,49 @@ class FastCycle:
         args = get_action_args(self.conf.configurations, "enqueue")
         factor = args.get_float("overcommit-factor", 1.2) if args else 1.2
 
-        queue_order = self._queue_order_fn()
-        drf_share = self._drf_shares()
-        jkeys = self._job_keys(self.session_jobs, drf_share)
-
-        jobs_map: Dict[str, List[int]] = {}
-        queue_seq: List[str] = []
-        seen = set()
-        row_pg = {}
-        j_pgs = self.j_pgs
-        j_phase = self.j_phase
-        for row in self.session_jobs:
-            qname = m.j_queue[row]
-            if qname not in store.queues:
+        # Queue-grouped pending rows, built by array grouping instead of
+        # a 12k-row Python loop.  Ordering (queue comparator + job keys)
+        # is DEFERRED below the accept-all fast path: when every pending
+        # group fits, acceptance is order-independent and the sorts are
+        # pure overhead at the north-star shape.
+        srows = np.asarray(self.session_jobs, np.int64)
+        if not len(srows):
+            return
+        row_pg = self.j_pgs
+        qc = m.j_queue_code[srows]
+        uq_codes, uq_first = np.unique(qc, return_index=True)
+        uq_codes = uq_codes[np.argsort(uq_first, kind="stable")]
+        known = {}
+        for c in uq_codes.tolist():
+            qname = m.qnames.items[c]
+            known[c] = qname if qname in store.queues else None
+        bad_codes = [c for c, n in known.items() if n is None]
+        if bad_codes:
+            # Per-job error log, as the object path emits
+            # (enqueue.go:66-69) — unknown queues are rare.
+            for row in srows[np.isin(qc, bad_codes)].tolist():
                 log.error("Failed to find queue %s for job %s",
-                          qname, m.j_uid[row])
-                continue
-            if qname not in seen:
-                seen.add(qname)
-                queue_seq.append(qname)
-            row_pg[row] = j_pgs[row]
-            if j_phase[row] == 1:
-                jobs_map.setdefault(qname, []).append(row)
-        queue_seq.sort(key=_cmp_key(
-            lambda l, r: queue_order(store.queues[l], store.queues[r])
-        ))
-        for lst in jobs_map.values():
-            lst.sort(key=lambda r: jkeys[r])
+                          m.j_queue[row], m.j_uid[row])
+        queue_seq = [n for n in (known[c] for c in uq_codes.tolist())
+                     if n is not None]
+        pend = (self.j_phase[srows] == 1) & np.isin(
+            qc, [c for c, n in known.items() if n is not None]
+        )
+        prows = srows[pend]
+        jobs_map: Dict[str, List[int]] = {}
+        if len(prows):
+            qcp = qc[pend]
+            order = np.argsort(qcp, kind="stable")
+            qcp_s = qcp[order]
+            prows_s = prows[order]
+            starts = np.flatnonzero(
+                np.concatenate(([True], qcp_s[1:] != qcp_s[:-1]))
+            )
+            bounds = np.append(starts, len(qcp_s))
+            for i, s in enumerate(starts.tolist()):
+                jobs_map[known[int(qcp_s[s])]] = (
+                    prows_s[s:bounds[i + 1]].tolist()
+                )
 
         eps = self.eps
         scalar_slot = self.scalar_slot
@@ -769,13 +834,29 @@ class FastCycle:
                     # charges provably leaves a non-empty idle.
                     if (_vec_le(total, idle, eps, scalar_slot)
                             and not _vec_is_empty(idle - total, eps)):
+                        inq = PodGroupPhase.Inqueue.value
+                        j_uid = m.j_uid
+                        dirty = self._phase_dirty
+                        j_phase = self.j_phase
                         for lst in jobs_map.values():
                             for row in lst:
-                                pg = row_pg[row]
-                                pg.status.phase = PodGroupPhase.Inqueue.value
-                                self.j_phase[row] = 2
-                                self._phase_dirty.add(pg.uid)
+                                # j_uid[row] == pg.uid (the PodGroup
+                                # dict key) without the property call.
+                                row_pg[row].status.phase = inq
+                                dirty.add(j_uid[row])
+                            j_phase[lst] = 2
                         return
+
+        # Budget walk: order matters from here on (enqueue.go's queue /
+        # job PriorityQueue pops), so pay for the sorts now.
+        queue_order = self._queue_order_fn()
+        drf_share = self._drf_shares()
+        jkeys = self._job_keys(self.session_jobs, drf_share).tolist()
+        queue_seq.sort(key=_cmp_key(
+            lambda l, r: queue_order(store.queues[l], store.queues[r])
+        ))
+        for lst in jobs_map.values():
+            lst.sort(key=jkeys.__getitem__)
 
         q_cap_vec: Dict[str, Optional[np.ndarray]] = {}
         done = False
@@ -786,7 +867,7 @@ class FastCycle:
                 if _vec_is_empty(idle, eps):
                     done = True
                     break
-                pg = row_pg.get(row)
+                pg = row_pg[row]
                 inqueue = False
                 if pg.min_resources is None:
                     inqueue = True
@@ -1692,6 +1773,27 @@ class FastCycle:
 
     # -------------------------------------------------------------- commit
 
+    def _obj_arrays(self):
+        """Per-cycle object ndarrays over the mirror's pod / bind-key /
+        node-name lists: fancy indexing + one ``tolist`` replaces
+        100k-iteration Python list comprehensions in the commit path.
+        Built lazily on first commit (pods/nodes cannot appear mid-cycle;
+        the store lock is held)."""
+        arrs = getattr(self, "_obj_arr_cache", None)
+        if arrs is None:
+            m = self.m
+            # np.fromiter, NOT ndarray slice-assign: the latter probes
+            # every element for sequence-ness (60x slower on dataclass
+            # records).
+            pod_a = np.fromiter(m.p_pod[:self.Pn], dtype=object,
+                                count=self.Pn)
+            key_a = np.fromiter(m.p_key[:self.Pn], dtype=object,
+                                count=self.Pn)
+            name_a = np.fromiter(m.n_name[:self.Nn], dtype=object,
+                                 count=self.Nn)
+            arrs = self._obj_arr_cache = (pod_a, key_a, name_a)
+        return arrs
+
     def _commit(self, solve_jobs: List[int], task_rows: np.ndarray,
                 assigned: np.ndarray, never_ready: np.ndarray,
                 fit_failed: np.ndarray, req_gather=None) -> bool:
@@ -1767,35 +1869,36 @@ class FastCycle:
         binder = store.binder
         bind_keys = getattr(binder, "bind_keys", None)
         notify = store._watchers
-        n_name = m.n_name
-        p_pod = m.p_pod
-        p_key = m.p_key
-        rows_l = rows.tolist()
-        pod_l = [p_pod[r] for r in rows_l]
-        host_l = [n_name[n] for n in nodes_c.tolist()]
-        # identity scan, NOT `None in pod_l`: `in` calls the dataclass
-        # __eq__ field-by-field on every element.
-        if not any(p is None for p in pod_l):
+        pod_a, key_a, name_a = self._obj_arrays()
+        pod_l = pod_a[rows].tolist()
+        host_l = name_a[nodes_c].tolist()
+        # Tombstoned rows can't be committed in the common case; the
+        # mirror counts them so the 100k-element defensive None scan
+        # (identity, NOT `in`: `in` calls the dataclass __eq__) only
+        # runs when one exists.
+        if not m.p_pod_nones or not any(p is None for p in pod_l):
             # Common case: every committed row has a live pod record.
-            # List comprehensions + one zip setattr walk instead of four
-            # per-pod appends (this loop runs 100k times at north-star
-            # scale).
+            # Object-array gathers + one zip setattr walk instead of
+            # four per-pod appends (this path covers 100k rows at
+            # north-star scale).
             for pod, hostname in zip(pod_l, host_l):
                 pod.node_name = hostname
-            keys = [p_key[r] for r in rows_l]
+            keys = key_a[rows].tolist()
             hosts = host_l
             bound_pods = pod_l
-            bound_rows = rows_l
+            bound_rows = rows.tolist()
         else:
             keys = []
             hosts = []
             bound_pods = []
             bound_rows = []
-            for row, pod, hostname in zip(rows_l, pod_l, host_l):
+            key_l = key_a[rows].tolist()
+            for row, pod, hostname, key in zip(
+                    rows.tolist(), pod_l, host_l, key_l):
                 if pod is None:
                     continue
                 pod.node_name = hostname
-                keys.append(p_key[row])
+                keys.append(key)
                 hosts.append(hostname)
                 bound_pods.append(pod)
                 bound_rows.append(row)
@@ -1836,10 +1939,11 @@ class FastCycle:
                 bound_rows = [r for _, _, _, r in kept]
 
         if getattr(store, "async_bind", False):
-            # Async dispatch (cache.go:536-552): the cycle only pays the
-            # queue append; failures surface via drain_bind_failures at
+            # Async dispatch (cache.go:536-552): the cycle only pays a
+            # list append (batches go to the dispatcher at cycle end —
+            # see run()); failures surface via drain_bind_failures at
             # the next cycle's start and re-enter Pending with backoff.
-            store.dispatch_binds(keys, hosts, bound_pods)
+            self._bind_batches.append((keys, hosts, bound_pods))
         else:
             try:
                 if bind_keys is not None:
@@ -2086,21 +2190,33 @@ class FastCycle:
 
     def _close(self) -> None:
         """Gang OnSessionClose conditions + PodGroup status write-back
-        (gang.go:140-183 + framework.go jobStatus)."""
+        (gang.go:140-183 + framework.go jobStatus).
+
+        Change detection runs vectorized against the derive-time status
+        snapshot (j_phase/j_st_*); Python touches only the rows that
+        actually write back."""
         m = self.m
         store = self.store
         fit_failed = getattr(self, "_fit_failed_rows", set())
-        unschedulable_rows = set()
+        srows = np.asarray(self.session_jobs, np.int64)
+        if not len(srows):
+            if self._has("gang"):
+                # An emptied session must not freeze the gauge at the
+                # previous cycle's count.
+                metrics.unschedule_job_count.set(0)
+            self._phase_dirty.clear()
+            return
 
-        cond_changed_rows = set()
+        unsched_mask = np.zeros(self.Jn, bool)
+        cond_changed = np.zeros(self.Jn, bool)
         if self._has("gang"):
-            unschedulable_jobs = 0
-            for row in self.session_jobs:
-                if self.j_ready_base[row] >= m.j_minav[row]:
-                    continue
+            unready = srows[
+                self.j_ready_base[srows] < m.j_minav[srows]
+            ]
+            unsched_mask[unready] = True
+            gang_events = []
+            for row in unready.tolist():
                 msg = self._gang_message(row, row in fit_failed)
-                unschedulable_jobs += 1
-                unschedulable_rows.add(row)
                 pg = self.j_pgs[row]
                 if pg is not None:
                     # Condition refresh throttling (job_updater.go
@@ -2131,57 +2247,99 @@ class FastCycle:
                             message=msg,
                         ))
                         pg.status.conditions = conditions
-                        cond_changed_rows.add(row)
-                        store.record_event(
+                        cond_changed[row] = True
+                        gang_events.append((
                             f"PodGroup/{pg.namespace}/{pg.name}",
                             "Unschedulable", msg,
-                        )
+                        ))
+                job_name = m.j_uid[row].split("/")[-1]
                 metrics.unschedule_task_count.set(
                     int(m.j_minav[row] - self.j_ready_base[row]),
-                    job_name=m.j_uid[row].split("/")[-1],
+                    job_name=job_name,
                 )
-                metrics.job_retry_counts.inc(
-                    job_name=m.j_uid[row].split("/")[-1]
-                )
-            metrics.unschedule_job_count.set(unschedulable_jobs)
+                metrics.job_retry_counts.inc(job_name=job_name)
+            if gang_events:
+                store.record_events(gang_events)
+            metrics.unschedule_job_count.set(len(unready))
 
         # jobStatus write-back, skipping unchanged PodGroups
         # (framework.go jobStatus + job_updater.go
         # isPodGroupStatusUpdated: only changed statuses are written).
-        for row in self.session_jobs:
-            pg = self.j_pgs[row]
-            if pg is None:
-                continue
-            status = pg.status
-            running = int(self.j_cnt_run[row])
-            if running != 0 and row in unschedulable_rows:
-                new_phase = PodGroupPhase.Unknown.value
-            else:
-                allocated = int(self.j_cnt_alloc[row] + self.j_cnt_succ[row])
-                if allocated >= m.j_minav[row]:
-                    new_phase = PodGroupPhase.Running.value
-                elif status.phase != PodGroupPhase.Inqueue.value:
-                    new_phase = PodGroupPhase.Pending.value
+        cur_code = self.j_phase[srows]
+        running_a = self.j_cnt_run[srows]
+        failed_a = self.j_cnt_fail[srows]
+        succ_a = self.j_cnt_succ[srows]
+        alloc_a = self.j_cnt_alloc[srows] + succ_a
+        new_code = np.where(
+            (running_a != 0) & unsched_mask[srows],
+            np.int8(4),  # Unknown
+            np.where(
+                alloc_a >= m.j_minav[srows],
+                np.int8(3),  # Running
+                np.where(cur_code != 2, np.int8(1), cur_code),
+            ),
+        )
+        changed = (
+            (new_code != cur_code)
+            | (running_a != self.j_st_run[srows])
+            | (failed_a != self.j_st_fail[srows])
+            | (succ_a != self.j_st_succ[srows])
+            | cond_changed[srows]
+        ) & (cur_code != 0)  # code 0 = no PodGroup
+        if self._phase_dirty:
+            # In-place transitions (enqueue's Pending -> Inqueue) made
+            # the snapshot match the mutated object; force those rows.
+            j_row = m.j_row
+            dirty = np.zeros(self.Jn, bool)
+            Jn = self.Jn
+            for uid in self._phase_dirty:
+                row = j_row.get(uid, -1)
+                if 0 <= row < Jn:
+                    dirty[row] = True
+            changed |= dirty[srows] & (cur_code != 0)
+        idx = np.flatnonzero(changed)
+        if len(idx):
+            rows_l = srows[idx].tolist()
+            code_l = new_code[idx].tolist()
+            run_l = running_a[idx].tolist()
+            fail_l = failed_a[idx].tolist()
+            succ_l = succ_a[idx].tolist()
+            j_pgs = self.j_pgs
+            j_phase = self.j_phase
+            phase_by_code = _PHASE_BY_CODE
+            updater = store.status_updater
+            batch_update = getattr(updater, "update_pod_groups", None)
+            update = updater.update_pod_group
+            written: List[object] = []
+            watchers = store._watchers
+            j_st_run, j_st_fail, j_st_succ = (
+                self.j_st_run, self.j_st_fail, self.j_st_succ
+            )
+            for row, code, running, failed, succeeded in zip(
+                    rows_l, code_l, run_l, fail_l, succ_l):
+                pg = j_pgs[row]
+                if pg is None:
+                    continue
+                status = pg.status
+                status.phase = phase_by_code.get(code, status.phase)
+                status.running = running
+                status.failed = failed
+                status.succeeded = succeeded
+                j_phase[row] = code
+                j_st_run[row] = running
+                j_st_fail[row] = failed
+                j_st_succ[row] = succeeded
+                if batch_update is not None:
+                    written.append(pg)
                 else:
-                    new_phase = status.phase
-            failed = int(self.j_cnt_fail[row])
-            succeeded = int(self.j_cnt_succ[row])
-            if (
-                row not in cond_changed_rows
-                and pg.uid not in self._phase_dirty
-                and status.phase == new_phase
-                and status.running == running
-                and status.failed == failed
-                and status.succeeded == succeeded
-            ):
-                continue
-            status.phase = new_phase
-            status.running = running
-            status.failed = failed
-            status.succeeded = succeeded
-            store.status_updater.update_pod_group(pg)
-            if store._watchers:
-                store._notify("PodGroup", "status", pg)
+                    update(pg)
+                if watchers:
+                    store._notify("PodGroup", "status", pg)
+            if written:
+                # One write-back call per close (job_updater.go batches
+                # its API writes the same way; a remote updater would
+                # otherwise pay 12k round trips).
+                batch_update(written)
         # Every pending in-place transition has now been persisted (or
         # superseded); a failure above leaves the set intact for the
         # next cycle.

@@ -268,10 +268,20 @@ class EvictState:
 
     def flush(self) -> None:
         """Apply committed evictions to the store (cache.Evict semantics:
-        pod marked deleting, evictor dispatched)."""
+        pod marked deleting, evictor dispatched — one batch when the
+        evictor supports it).  Evictor failures revert exactly the
+        failed pods to Running, the cache.go:461-466 resyncTask analog:
+        the next preempt/reclaim cycle re-selects a victim set."""
+        if not self.evicted_rows:
+            return
         c = self.cyc
         m = c.m
         store = c.store
+        from .cache.interface import EvictFailure
+
+        evictor = store.evictor
+        evict_keys = getattr(evictor, "evict_keys", None)
+        entries = []  # (row, "ns/name", pod)
         for row in self.evicted_rows:
             uid = m.p_uid[row]
             pod = store.pods.get(uid) if uid else None
@@ -282,15 +292,52 @@ class EvictState:
                 pod._mirror_feat = pod._mirror_feat  # keep feature cache
             except Exception:
                 pass
-            store.evictor.evict(pod)
-            store.record_event(
-                f"Pod/{pod.namespace}/{pod.name}", "Evict",
-                "evicted by scheduler (preempt/reclaim)",
-            )
-            if store._watchers:
-                store._notify("Pod", "evict", pod)
-        if self.evicted_rows:
-            store.mark_objects_stale()
+            entries.append((row, f"{pod.namespace}/{pod.name}", pod))
+        failed = set()
+        if evict_keys is not None:
+            try:
+                evict_keys([k for _, k, _ in entries])
+            except EvictFailure as ef:
+                failed = set(ef.failed)
+            except Exception:
+                # Transport-level error (connection reset, timeout):
+                # indeterminate — re-drive per key so each gets a
+                # definite outcome (evictions are idempotent: deleting
+                # an already-terminating pod is a no-op), mirroring the
+                # bind dispatcher's indeterminate-batch handling.
+                log.exception("evict batch indeterminate; "
+                              "retrying per key")
+                for row, key, pod in entries:
+                    try:
+                        evictor.evict(pod)
+                    except Exception:
+                        failed.add(key)
+        else:
+            for row, key, pod in entries:
+                try:
+                    evictor.evict(pod)
+                except Exception:
+                    failed.add(key)
+        events = []
+        for row, key, pod in entries:
+            if key in failed:
+                # The pod is NOT terminating.  unevict restores the
+                # mirror status AND the cycle's job/queue counters so
+                # the session-close status write-back matches reality.
+                pod.deleting = False
+                self.unevict(row, int(m.p_node[row]), int(m.p_job[row]))
+                events.append((f"Pod/{key}", "EvictFailed",
+                               "evict dispatch failed; will retry"))
+            else:
+                events.append((f"Pod/{key}", "Evict",
+                               "evicted by scheduler (preempt/reclaim)"))
+                if store._watchers:
+                    store._notify("Pod", "evict", pod)
+        if failed:
+            log.warning("%d evictions failed; pods revert to Running",
+                        len(failed))
+        store.record_events(events)
+        store.mark_objects_stale()
 
 
 class _LazyHeap:

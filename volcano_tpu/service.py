@@ -126,28 +126,45 @@ class Service:
         checkpoint_period: float = 30.0,
         lease_path: Optional[str] = None,
         remote_binder: Optional[str] = None,
+        remote_evictor: Optional[str] = None,
+        remote_status_updater: Optional[str] = None,
     ):
-        if remote_binder:
-            # Binds cross a process boundary to the remote bind service
-            # (cache/remote.py) — the cache.go:492-554 RPC analog.
-            # Probe /healthz so a permanently wrong URL fails at startup
-            # (transient outages still ride the errTasks backoff).
+        # Remote side-effect boundaries (cache/remote.py): binds
+        # (cache.go:492-554), evictions (:439-491), and status writes
+        # (:556-599) as RPCs to a second process.  Each probes /healthz
+        # so a permanently wrong URL fails at startup (transient outages
+        # still ride the per-interface retry paths: errTasks backoff for
+        # binds, EvictFailure -> Running revert for evictions,
+        # fire-and-forget rewrite-next-cycle for status).
+        def _remote_client(url: str, cls_name: str):
             import urllib.request
 
+            from .cache import remote as remote_mod
+
             with urllib.request.urlopen(
-                f"{remote_binder.rstrip('/')}/healthz", timeout=10
+                f"{url.rstrip('/')}/healthz", timeout=10
             ):
                 pass
-            from .cache.remote import HttpBinder
+            return getattr(remote_mod, cls_name)(url)
 
+        if remote_binder:
+            binder = _remote_client(remote_binder, "HttpBinder")
             if store is None:
-                store = ClusterStore(binder=HttpBinder(remote_binder))
+                store = ClusterStore(binder=binder)
             else:
-                store.binder = HttpBinder(remote_binder)
+                store.binder = binder
                 # An existing BindDispatcher captured the old binder at
                 # first dispatch; stop it so the next dispatch rebuilds
                 # against the remote one.
                 store.close()
+        if remote_evictor:
+            store = store or ClusterStore()
+            store.evictor = _remote_client(remote_evictor, "HttpEvictor")
+        if remote_status_updater:
+            store = store or ClusterStore()
+            store.status_updater = _remote_client(
+                remote_status_updater, "HttpStatusUpdater"
+            )
         self.store = store or ClusterStore()
         # Production binds dispatch on the background worker with
         # errTasks-style failure backoff (cache.go:536-552, 627-649);
@@ -460,6 +477,14 @@ def main(argv=None) -> int:
                    help="URL of a remote bind service (cache/remote.py); "
                         "binds then cross a process boundary like the "
                         "reference's API-server bind RPCs")
+    p.add_argument("--remote-evictor", default=None,
+                   help="URL of a remote evict service (cache/remote.py); "
+                        "evictions cross a process boundary like the "
+                        "reference's delete-pod RPCs (cache.go:439-491)")
+    p.add_argument("--remote-status-updater", default=None,
+                   help="URL of a remote status service (cache/remote.py); "
+                        "PodGroup status writes cross a process boundary "
+                        "like the reference's API writes (cache.go:556-599)")
     args = p.parse_args(argv)
 
     svc = Service(
@@ -470,6 +495,8 @@ def main(argv=None) -> int:
         checkpoint_period=args.checkpoint_period,
         lease_path=args.lease_path,
         remote_binder=args.remote_binder,
+        remote_evictor=args.remote_evictor,
+        remote_status_updater=args.remote_status_updater,
     )
     port = svc.start(http_port=args.listen_port,
                      bind_address=args.bind_address)
