@@ -1,8 +1,11 @@
 """Benchmark suite: the five BASELINE.md configurations.
 
-Select with BENCH_CONFIG=1..5 (default 2, the 1k-node x 10k-pod binpack
-config the driver tracks).  Each config prints ONE JSON line
-{"metric", "value", "unit", "vs_baseline"} on stdout; details go to stderr.
+Select with BENCH_CONFIG=1..5, or the default "north" — the NORTH-STAR
+shape itself (10k nodes x 100k pending pods, plain binpack+predicates,
+gang 8): the driver-recorded number is the headline metric, lane split
+included in the stderr comment.  Each config prints ONE JSON line
+{"metric", "value", "unit", "vs_baseline"} on stdout; details go to
+stderr.
 
 Configs (BASELINE.json.configs):
   1. 3-replica gang Job end-to-end through the full service (admission ->
@@ -267,11 +270,37 @@ def config_5(repeats):
     )
 
 
+def config_north(repeats):
+    """The north-star shape, plain: 10k nodes x 100k pods, gang 8."""
+    from volcano_tpu.synth import synthetic_cluster
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 10000))
+    n_pods = int(os.environ.get("BENCH_PODS", 100000))
+    mk = lambda r: synthetic_cluster(
+        n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16, seed=r,
+    )
+    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(
+        mk, CONF_BASE, repeats)
+    _emit(
+        f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending "
+        f"pods (north star, plain)",
+        e2e_ms, n_pods,
+        f"warmup={warm_s:.2f}s bound={bound} "
+        f"pods/s={bound / (e2e_ms / 1e3):.0f} "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
+    )
+
+
 def main():
-    config = int(os.environ.get("BENCH_CONFIG", 2))
+    raw = os.environ.get("BENCH_CONFIG", "north")
     # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
     # between runs, and the minimum is the stable estimator.
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    if raw == "north":
+        config_north(repeats)
+        return
+    config = int(raw)
     if config == 1:
         config_1()
     elif config == 2:

@@ -1,0 +1,119 @@
+"""Mid-solve TPU-crash recovery (the hyperscale-affinity failure mode).
+
+BASELINE.md documents an intermittent remote-TPU-worker crash at
+50k x 500k with inter-pod affinity.  The cycle must not be lost to it:
+the allocate action catches runtime-crash errors, halves the affinity
+chunk budget, re-probes the device, and resumes the cycle with the
+remaining pending work — completing degraded instead of failing.  These
+tests inject the crash through a fake solver wrapper (the fake-backend
+injection VERDICT r3 #4 prescribes).
+"""
+
+import numpy as np
+import pytest
+
+import volcano_tpu.ops.wave as wave_mod
+from volcano_tpu.fastpath import FastCycle
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+
+def crashing_once(real_fn, crashes, message="TPU worker process crashed"):
+    """Wrap the solver: the first ``crashes`` calls raise a runtime
+    crash; later calls delegate."""
+    state = {"left": crashes, "calls": 0}
+
+    def fn(*args, **kw):
+        state["calls"] += 1
+        if state["left"] > 0:
+            state["left"] -= 1
+            raise RuntimeError(message)
+        return real_fn(*args, **kw)
+
+    return fn, state
+
+
+def affinity_store(seed=0):
+    return synthetic_cluster(
+        n_nodes=48, n_pods=192, gang_size=4, zones=4,
+        affinity_fraction=0.2, anti_affinity_fraction=0.1,
+        spread_fraction=0.1, seed=seed,
+    )
+
+
+def test_cycle_completes_after_injected_crash(monkeypatch):
+    store = affinity_store()
+    real = wave_mod.solve_wave
+    fake, state = crashing_once(real, crashes=1)
+    monkeypatch.setattr(wave_mod, "solve_wave", fake)
+    Scheduler(store).run_once()
+    assert state["calls"] >= 2  # crashed once, then resumed
+    bound = [p for p in store.pods.values() if p.node_name]
+    assert len(bound) == len(store.pods)  # cycle completed degraded
+    # Budget degraded and the recovery is user-visible.
+    assert store._aff_budget_scale == 0.5
+    evs = store.events_for("Scheduler/device")
+    assert any(e["reason"] == "DeviceCrashRecovered" for e in evs)
+
+
+def test_repeated_crashes_eventually_propagate(monkeypatch):
+    """More than 3 crashes in one cycle give up (health machinery takes
+    over) instead of looping forever."""
+    store = affinity_store()
+    real = wave_mod.solve_wave
+    fake, state = crashing_once(real, crashes=99)
+    monkeypatch.setattr(wave_mod, "solve_wave", fake)
+    monkeypatch.setenv("VOLCANO_TPU_FALLBACK", "never")
+    with pytest.raises(RuntimeError, match="TPU worker"):
+        Scheduler(store).run_once()
+    assert store._aff_budget_scale <= 0.25
+
+
+def test_programming_errors_are_not_swallowed(monkeypatch):
+    """Only runtime-crash signatures trigger recovery; a genuine bug
+    propagates immediately (no silent degradation)."""
+    store = affinity_store()
+    real = wave_mod.solve_wave
+    fake, state = crashing_once(real, crashes=1,
+                                message="name 'x' is not defined")
+    monkeypatch.setattr(wave_mod, "solve_wave", fake)
+    monkeypatch.setenv("VOLCANO_TPU_FALLBACK", "never")
+    with pytest.raises(RuntimeError, match="not defined"):
+        Scheduler(store).run_once()
+    assert getattr(store, "_aff_budget_scale", 1.0) == 1.0
+
+
+def test_budget_scale_recovers_after_clean_cycles(monkeypatch):
+    from volcano_tpu.api import GROUP_NAME_ANNOTATION, Pod, PodGroup
+
+    store = affinity_store()
+    real = wave_mod.solve_wave
+    fake, state = crashing_once(real, crashes=1)
+    monkeypatch.setattr(wave_mod, "solve_wave", fake)
+    sched = Scheduler(store)
+    sched.run_once()
+    assert store._aff_budget_scale == 0.5
+    # Fresh pending AFFINITY work each cycle: only affinity-bearing
+    # solves count toward walking the degraded budget back up.
+    for i in range(FastCycle._SCALE_RECOVER_AFTER):
+        pg = PodGroup(name=f"late-{i}", min_member=1)
+        store.add_pod_group(pg)
+        store.add_pod(Pod(
+            name=f"late-{i}-0",
+            annotations={GROUP_NAME_ANNOTATION: pg.name},
+            containers=[{"cpu": "1", "memory": "1Gi"}],
+            topology_spread=[("zone", 10)],
+        ))
+        sched.run_once()
+    # The degraded budget walked back up after the clean streak.
+    assert store._aff_budget_scale == 1.0
+
+
+def test_crash_marker_classification():
+    assert FastCycle._is_device_crash(
+        RuntimeError("DATA_LOSS: TPU worker process crashed"))
+    assert FastCycle._is_device_crash(
+        RuntimeError("UNAVAILABLE: Socket closed"))
+    assert not FastCycle._is_device_crash(RuntimeError("divide by zero"))
+    assert not FastCycle._is_device_crash(
+        KeyboardInterrupt("UNAVAILABLE"))

@@ -1,0 +1,380 @@
+"""Store event-handler semantics, per event type.
+
+The analog of the reference's scheduler-cache handler tests
+(``pkg/scheduler/cache/event_handlers_test.go``): each informer event
+type (AddPod/UpdatePod/DeletePod, Add/Update/DeletePodGroup,
+Add/Update/DeleteQueue, Add/Update/DeleteNode) has defined effects on
+the cache's accounting — node usage, job task sets, mirror rows — and
+on the watcher fan-out.  The mirror-churn fuzz (test_mirror_fuzz.py)
+covers random interleavings; these tests pin the per-event semantics
+the fuzz can only exercise implicitly.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    PriorityClass,
+    Queue,
+    TaskStatus,
+)
+from volcano_tpu.cache import ClusterStore
+
+
+def store_with_node(cpu="8", mem="16Gi") -> ClusterStore:
+    s = ClusterStore()
+    s.add_node(Node(name="n0", allocatable={"cpu": cpu, "memory": mem,
+                                            "pods": 110}))
+    return s
+
+
+def running_pod(name="p0", node="n0", cpu="2", group="g") -> Pod:
+    return Pod(
+        name=name,
+        annotations={GROUP_NAME_ANNOTATION: group},
+        containers=[{"cpu": cpu, "memory": "1Gi"}],
+        phase=PodPhase.Running,
+        node_name=node,
+    )
+
+
+def watched(store):
+    seen = []
+    store.watch(lambda kind, event, obj: seen.append((kind, event)))
+    return seen
+
+
+# ------------------------------------------------------------- pod events
+
+
+def test_add_pod_charges_node():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    s.add_pod(running_pod(cpu="2"))
+    assert s.nodes["n0"].used.milli_cpu == 2000
+    assert s.nodes["n0"].idle.milli_cpu == 6000
+    job = s.jobs["default/g"]
+    assert len(job.tasks) == 1
+
+
+def test_add_pending_pod_charges_nothing():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = Pod(name="p0", annotations={GROUP_NAME_ANNOTATION: "g"},
+              containers=[{"cpu": "2", "memory": "1Gi"}])
+    s.add_pod(pod)
+    assert s.nodes["n0"].used.milli_cpu == 0
+    m = s.mirror
+    row = m.p_row[pod.uid]
+    assert m.p_status[row] == int(TaskStatus.Pending)
+    assert m.p_node[row] == -1
+
+
+def test_update_pod_phase_transition_updates_status_only():
+    """updateTask analog: same spec, new phase -> the mirror row is
+    REUSED (no tombstone) and only dynamic state changes."""
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod()
+    s.add_pod(pod)
+    row = s.mirror.p_row[pod.uid]
+    upd = copy.copy(pod)
+    upd.phase = PodPhase.Succeeded
+    s.update_pod(upd)
+    assert s.mirror.p_row[pod.uid] == row  # row reused
+    assert s.mirror.p_status[row] == int(TaskStatus.Succeeded)
+    # Succeeded pods release node usage (terminated resources free).
+    assert s.nodes["n0"].used.milli_cpu == 0
+
+
+def test_update_pod_spec_change_tombstones_and_readds():
+    """A spec (resource) change is a delete+add in the cache: the old
+    row is tombstoned, a fresh row carries the new request."""
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod(cpu="2")
+    s.add_pod(pod)
+    old_row = s.mirror.p_row[pod.uid]
+    # A fresh object (no cached feature blob), as an informer update
+    # carrying a changed spec would arrive — copy.copy would carry the
+    # bind/evict copy-on-write feature cache and take the same-spec path.
+    upd = Pod(name=pod.name, uid=pod.uid,
+              annotations=dict(pod.annotations),
+              containers=[{"cpu": "4", "memory": "1Gi"}],
+              phase=pod.phase, node_name=pod.node_name)
+    s.update_pod(upd)
+    new_row = s.mirror.p_row[pod.uid]
+    assert new_row != old_row
+    assert s.mirror.p_pod[old_row] is None
+    assert s.mirror.p_pod_nones >= 1
+    assert s.nodes["n0"].used.milli_cpu == 4000
+
+
+def test_update_pod_node_move_recharges():
+    s = store_with_node()
+    s.add_node(Node(name="n1", allocatable={"cpu": "8", "memory": "16Gi"}))
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod(cpu="2", node="n0")
+    s.add_pod(pod)
+    moved = copy.copy(pod)
+    moved.node_name = "n1"
+    s.update_pod(moved)
+    assert s.nodes["n0"].used.milli_cpu == 0
+    assert s.nodes["n1"].used.milli_cpu == 2000
+
+
+def test_delete_pod_releases_everything():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod(cpu="2")
+    s.add_pod(pod)
+    row = s.mirror.p_row[pod.uid]
+    s.delete_pod(pod)
+    assert s.nodes["n0"].used.milli_cpu == 0
+    assert pod.uid not in s.pods
+    assert not s.mirror.p_alive[row]
+    assert s.mirror.p_pod[row] is None
+    # Job drops once taskless AND podgroup-less; with the PG it stays.
+    assert "default/g" in s.jobs
+    assert len(s.jobs["default/g"].tasks) == 0
+
+
+def test_delete_unknown_pod_is_noop():
+    s = store_with_node()
+    s.delete_pod(running_pod(name="ghost"))
+    assert len(s.pods) == 0
+
+
+def test_pod_added_before_node_adopts_on_node_arrival():
+    """Orphan adoption (event_handlers addTask placeholder-node path):
+    a running pod naming a node the cache hasn't seen charges it
+    retroactively when the node arrives."""
+    s = ClusterStore()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod(node="late-node", cpu="2")
+    s.add_pod(pod)
+    s.add_node(Node(name="late-node",
+                    allocatable={"cpu": "8", "memory": "16Gi"}))
+    assert s.nodes["late-node"].used.milli_cpu == 2000
+    m = s.mirror
+    row = m.p_row[pod.uid]
+    assert m.n_name[m.p_node[row]] == "late-node"
+
+
+# -------------------------------------------------------- podgroup events
+
+
+def test_add_pod_group_links_job_and_priority():
+    s = store_with_node()
+    s.add_priority_class(PriorityClass(name="high", value=5000))
+    s.add_pod_group(PodGroup(name="g", min_member=3,
+                             priority_class="high"))
+    job = s.jobs["default/g"]
+    assert job.pod_group is not None
+    assert job.priority == 5000
+    row = s.mirror.j_row["default/g"]
+    assert s.mirror.j_minav[row] == 3
+    assert s.mirror.j_prio[row] == 5000
+
+
+def test_update_pod_group_changes_min_member_live():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pg = s.pod_groups["default/g"]
+    upd = copy.copy(pg)
+    upd.min_member = 4
+    s.update_pod_group(upd)
+    assert s.mirror.j_minav[s.mirror.j_row["default/g"]] == 4
+    assert s.jobs["default/g"].pod_group.min_member == 4
+
+
+def test_update_pod_group_preserves_status_phase():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pg = s.pod_groups["default/g"]
+    pg.status.phase = "Inqueue"
+    s.update_pod_group(pg)
+    assert s.pod_groups["default/g"].status.phase == "Inqueue"
+
+
+def test_delete_pod_group_keeps_job_while_tasks_remain():
+    """DeletePodGroup with live tasks: the JobInfo survives (tasks still
+    need accounting); without tasks it drops entirely."""
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod()
+    s.add_pod(pod)
+    s.delete_pod_group("default/g")
+    assert "default/g" in s.jobs  # tasks pin it
+    assert s.jobs["default/g"].pod_group is None
+    s.delete_pod(s.pods[pod.uid])
+    s.delete_pod_group("default/g")
+    assert "default/g" not in s.jobs
+
+
+def test_delete_pod_group_removes_mirror_row():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    assert "default/g" in s.mirror.j_row
+    s.delete_pod_group("default/g")
+    assert not s.mirror.j_alive[s.mirror.j_row.get("default/g", 0)] or \
+        "default/g" not in s.mirror.j_row
+
+
+# ----------------------------------------------------------- queue events
+
+
+def test_add_queue_visible_in_snapshot():
+    s = store_with_node()
+    s.add_queue(Queue(name="q1", weight=4))
+    snap = s.snapshot()
+    assert "q1" in snap.queues
+    assert snap.queues["q1"].weight == 4
+
+
+def test_update_queue_weight_applies():
+    s = store_with_node()
+    s.add_queue(Queue(name="q1", weight=1))
+    s.update_queue(Queue(name="q1", weight=8))
+    assert s.queues["q1"].weight == 8
+
+
+def test_delete_queue_removes_it():
+    s = store_with_node()
+    s.add_queue(Queue(name="q1", weight=1))
+    s.delete_queue("q1")
+    assert "q1" not in s.queues
+    # Default queue always survives.
+    assert "default" in s.queues
+
+
+# ------------------------------------------------------------ node events
+
+
+def test_update_node_allocatable_reflects_in_idle():
+    s = store_with_node(cpu="8")
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    s.add_pod(running_pod(cpu="2"))
+    s.update_node(Node(name="n0",
+                       allocatable={"cpu": "16", "memory": "16Gi"}))
+    assert s.nodes["n0"].idle.milli_cpu == 14000
+    assert s.nodes["n0"].used.milli_cpu == 2000
+
+
+def test_delete_node_keeps_pod_records():
+    """Node deletion leaves its pods in the cache (the reference keeps
+    tasks; kubelet/informer deletes them separately)."""
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod(cpu="2")
+    s.add_pod(pod)
+    s.delete_node("n0")
+    assert "n0" not in s.nodes
+    assert pod.uid in s.pods
+
+
+# --------------------------------------------------------------- watchers
+
+
+@pytest.mark.parametrize("op,kind,event", [
+    ("add_pod", "Pod", "add"),
+    ("update_pod", "Pod", "update"),
+    ("delete_pod", "Pod", "delete"),
+    ("add_pod_group", "PodGroup", "add"),
+    ("update_pod_group", "PodGroup", "update"),
+    ("delete_pod_group", "PodGroup", "delete"),
+])
+def test_watcher_fires_per_event_type(op, kind, event):
+    s = store_with_node()
+    pg = PodGroup(name="g", min_member=1)
+    pod = running_pod()
+    if op in ("update_pod", "delete_pod"):
+        s.add_pod_group(pg)
+        s.add_pod(pod)
+    elif op in ("update_pod_group", "delete_pod_group"):
+        s.add_pod_group(pg)
+    seen = watched(s)
+    if op == "add_pod":
+        s.add_pod_group(pg)
+        s.add_pod(pod)
+    elif op == "update_pod":
+        s.update_pod(copy.copy(pod))
+    elif op == "delete_pod":
+        s.delete_pod(pod)
+    elif op == "add_pod_group":
+        s.add_pod_group(pg)
+    elif op == "update_pod_group":
+        s.update_pod_group(pg)
+    elif op == "delete_pod_group":
+        s.delete_pod_group("default/g")
+    assert (kind, event) in seen
+
+
+# ----------------------------------------------------- status transitions
+
+
+@pytest.mark.parametrize("phase,expected_status", [
+    (PodPhase.Pending, TaskStatus.Pending),
+    (PodPhase.Running, TaskStatus.Running),
+    (PodPhase.Succeeded, TaskStatus.Succeeded),
+    (PodPhase.Failed, TaskStatus.Failed),
+])
+def test_phase_to_task_status_mapping(phase, expected_status):
+    """The pod-phase -> TaskStatus table (api/helpers.go getTaskStatus),
+    as observed through the mirror after an update event."""
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = Pod(name="p0", annotations={GROUP_NAME_ANNOTATION: "g"},
+              containers=[{"cpu": "1", "memory": "1Gi"}],
+              phase=phase,
+              node_name="n0" if phase != PodPhase.Pending else None)
+    s.add_pod(pod)
+    row = s.mirror.p_row[pod.uid]
+    assert s.mirror.p_status[row] == int(expected_status)
+
+
+def test_deleting_pod_becomes_releasing():
+    s = store_with_node()
+    s.add_pod_group(PodGroup(name="g", min_member=1))
+    pod = running_pod(cpu="2")
+    s.add_pod(pod)
+    upd = copy.copy(pod)
+    upd.deleting = True
+    s.update_pod(upd)
+    row = s.mirror.p_row[pod.uid]
+    assert s.mirror.p_status[row] == int(TaskStatus.Releasing)
+    node = s.nodes["n0"]
+    # Releasing stays in used (NodeInfo semantics) and in releasing.
+    assert node.used.milli_cpu == 2000
+    assert node.releasing.milli_cpu == 2000
+
+
+def test_event_trails_capped_fifo():
+    """The event-trail cache evicts oldest objects first at the cap and
+    keeps per-object trails bounded."""
+    s = ClusterStore()
+    cap = s.MAX_EVENT_OBJECTS
+    s.record_events([(f"Pod/default/x-{i}", "R", "m")
+                     for i in range(cap + 10)])
+    assert len(s._events) == cap
+    assert not s.events_for("Pod/default/x-0")  # oldest evicted
+    assert s.events_for(f"Pod/default/x-{cap + 9}")
+    for i in range(s.EVENTS_PER_OBJECT + 5):
+        s.record_event("Pod/default/x-5000", "R", f"m{i}")
+    assert len(s.events_for("Pod/default/x-5000")) <= s.EVENTS_PER_OBJECT
+
+
+def test_event_dedupe_increments_count():
+    s = ClusterStore()
+    s.record_event("Pod/default/a", "FailedScheduling", "no fit")
+    s.record_event("Pod/default/a", "FailedScheduling", "no fit")
+    trail = s.events_for("Pod/default/a")
+    assert len(trail) == 1
+    assert trail[0]["count"] == 2

@@ -1,0 +1,195 @@
+"""TTL garbage-collector tables.
+
+The analog of ``pkg/controllers/garbagecollector/garbagecollector_test.go``
+(ProcessTTL / NeedsCleanup / IsJobFinished tables), driven against the
+sweep with an injected clock so expiry is deterministic.
+"""
+
+import pytest
+
+from volcano_tpu.api import Node, PodGroupPhase
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.controllers import Job, JobController, TaskSpec
+from volcano_tpu.controllers.apis import JobPhase, VolumeSpec
+from volcano_tpu.controllers.gc import FINISHED, GarbageCollector
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def finished_job(name="j1", ttl=3, phase=JobPhase.Completed.value,
+                 finish_time=1000.0):
+    job = Job(name=name, min_available=1,
+              tasks=[TaskSpec(name="w", replicas=1,
+                              containers=[{"cpu": "1"}])],
+              ttl_seconds_after_finished=ttl)
+    job.status.state.phase = phase
+    job.status.state.last_transition = finish_time
+    return job
+
+
+# --------------------------------------------------------- phase tables
+
+
+@pytest.mark.parametrize("phase,is_finished", [
+    (JobPhase.Completed.value, True),
+    (JobPhase.Failed.value, True),
+    (JobPhase.Terminated.value, True),
+    (JobPhase.Pending.value, False),
+    (JobPhase.Running.value, False),
+    (JobPhase.Aborted.value, False),
+    (JobPhase.Restarting.value, False),
+])
+def test_is_job_finished_table(phase, is_finished):
+    """IsJobFinished: only Completed/Failed/Terminated count as
+    finished (garbagecollector.go isJobFinished)."""
+    assert (phase in FINISHED) == is_finished
+
+
+@pytest.mark.parametrize("ttl,phase,collected", [
+    # needsCleanup: finished + TTL set -> cleanup candidate.
+    (3, JobPhase.Completed.value, True),
+    (3, JobPhase.Failed.value, True),
+    (3, JobPhase.Terminated.value, True),
+    # Running jobs are never TTL-collected regardless of TTL.
+    (3, JobPhase.Running.value, False),
+    (0, JobPhase.Running.value, False),
+    # TTL unset -> never collected even when finished.
+    (None, JobPhase.Completed.value, False),
+])
+def test_needs_cleanup_table(ttl, phase, collected):
+    store = ClusterStore()
+    clock = Clock(2000.0)
+    gc = GarbageCollector(store, clock=clock)
+    job = finished_job(ttl=ttl, phase=phase, finish_time=1000.0)
+    store.batch_jobs[job.key] = job
+    n = gc.sweep()
+    assert (n == 1) == collected
+    assert (job.key not in store.batch_jobs) == collected
+
+
+# ------------------------------------------------------------ processTTL
+
+
+def test_ttl_not_yet_expired_false_case():
+    """ProcessTTL "False Case": ttl=3 with a fresh finish -> kept."""
+    store = ClusterStore()
+    clock = Clock(1001.0)  # 1s after finish, ttl 3s
+    gc = GarbageCollector(store, clock=clock)
+    job = finished_job(ttl=3, finish_time=1000.0)
+    store.batch_jobs[job.key] = job
+    assert gc.sweep() == 0
+    assert job.key in store.batch_jobs
+
+
+def test_ttl_zero_expires_immediately_true_case():
+    """ProcessTTL "True Case": ttl=0 -> expired the moment it finishes."""
+    store = ClusterStore()
+    clock = Clock(1000.0)
+    gc = GarbageCollector(store, clock=clock)
+    job = finished_job(ttl=0, finish_time=1000.0)
+    store.batch_jobs[job.key] = job
+    assert gc.sweep() == 1
+    assert job.key not in store.batch_jobs
+
+
+def test_ttl_expires_after_clock_advance():
+    store = ClusterStore()
+    clock = Clock(1001.0)
+    gc = GarbageCollector(store, clock=clock)
+    job = finished_job(ttl=3, finish_time=1000.0)
+    store.batch_jobs[job.key] = job
+    assert gc.sweep() == 0
+    clock.t = 1003.5
+    assert gc.sweep() == 1
+
+
+def test_unfinished_job_resets_observed_finish_time():
+    """A job that left the finished phase (restart) must not be
+    collected from a stale finish timestamp when it finishes again."""
+    store = ClusterStore()
+    clock = Clock(1000.0)
+    gc = GarbageCollector(store, clock=clock)
+    job = finished_job(ttl=3, finish_time=999.0)
+    store.batch_jobs[job.key] = job
+    assert gc.sweep() == 0  # records finish at 999; not yet expired
+    # Restart: phase leaves FINISHED; the observed finish time clears.
+    job.status.state.phase = JobPhase.Running.value
+    clock.t = 2000.0
+    assert gc.sweep() == 0
+    # Finishes again at 2000 (no last_transition update -> sweep uses
+    # observation time); ttl counts from the NEW finish.
+    job.status.state.phase = JobPhase.Completed.value
+    job.status.state.last_transition = 2000.0
+    clock.t = 2001.0
+    assert gc.sweep() == 0  # only 1s since the new finish
+    clock.t = 2004.0
+    assert gc.sweep() == 1
+
+
+def test_sweep_collects_multiple_and_skips_ttl_less():
+    store = ClusterStore()
+    clock = Clock(5000.0)
+    gc = GarbageCollector(store, clock=clock)
+    for i, ttl in enumerate((1, 1, None)):
+        job = finished_job(name=f"j{i}", ttl=ttl, finish_time=1000.0)
+        store.batch_jobs[job.key] = job
+    assert gc.sweep() == 2
+    assert list(store.batch_jobs) == ["default/j2"]
+
+
+# -------------------------------------------------- cascading deletion
+
+
+def test_ttl_delete_cascades_pods_podgroup_and_claims():
+    """delete_batch_job through the TTL sweep reaps the job's pods,
+    PodGroup, and controller-owned claims (owner-reference cascade)."""
+    store = ClusterStore()
+    store.add_node(Node(name="n0",
+                        allocatable={"cpu": "8", "memory": "16Gi"}))
+    jc = JobController(store)
+    job = Job(name="j1", min_available=1,
+              tasks=[TaskSpec(name="w", replicas=2,
+                              containers=[{"cpu": "1", "memory": "1Gi"}])],
+              volumes=[VolumeSpec(mount_path="/data",
+                                  volume_claim={"storage": "1Gi"})],
+              ttl_seconds_after_finished=1)
+    store.add_batch_job(job)
+    jc.process_all()
+    pg = store.pod_groups["default/j1"]
+    pg.status.phase = PodGroupPhase.Inqueue.value
+    store.update_pod_group(pg)
+    jc.process_all()
+    jc.sync_job(job, None)
+    assert len([p for p in store.pods.values()
+                if p.owner_job == job.key]) == 2
+    assert len(store.pvcs) == 1
+
+    job.status.state.phase = JobPhase.Completed.value
+    job.status.state.last_transition = 1000.0
+    clock = Clock(5000.0)
+    gc = GarbageCollector(store, clock=clock)
+    assert gc.sweep() == 1
+    jc.process_all()  # the delete event pumps the controller cleanup
+    assert "default/j1" not in store.batch_jobs
+    assert "default/j1" not in store.pod_groups
+    assert all(p.deleting for p in store.pods.values()
+               if p.owner_job == "default/j1")
+    assert not store.pvcs  # owned claim reaped
+
+
+def test_sweep_uses_last_transition_when_present():
+    """The reference counts TTL from the job's LastTransitionTime; the
+    sweep honors it when set instead of its own observation time."""
+    store = ClusterStore()
+    clock = Clock(1010.0)
+    gc = GarbageCollector(store, clock=clock)
+    job = finished_job(ttl=5, finish_time=1000.0)  # finished 10s ago
+    store.batch_jobs[job.key] = job
+    # First sweep already sees it expired (1010 - 1000 >= 5).
+    assert gc.sweep() == 1

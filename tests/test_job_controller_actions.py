@@ -1,0 +1,341 @@
+"""Job-controller action semantics.
+
+The analog of the reference's action tests
+(``pkg/controllers/job/job_controller_actions_test.go``: KillJob,
+SyncJob, CreateJobIOIfNotExist, CreatePVC, CreatePodGroupIfNotExist,
+DeleteJobPod) plus the applyPolicies table
+(``job_controller_util.go:110-184``), driven directly against
+``JobController`` with the store as the observable boundary.
+"""
+
+import pytest
+
+from volcano_tpu.api import Node, PodGroupPhase, PodPhase
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.controllers import Job, JobController, TaskSpec
+from volcano_tpu.controllers.apis import (
+    Action,
+    Event,
+    LifecyclePolicy,
+    Request,
+    VolumeSpec,
+)
+from volcano_tpu.controllers.job_controller import apply_policies
+
+
+def make_store():
+    s = ClusterStore()
+    s.add_node(Node(name="n0", allocatable={"cpu": "16", "memory": "32Gi",
+                                            "pods": 110}))
+    return s
+
+
+def make_job(name="j1", replicas=2, min_available=2, volumes=None,
+             ttl=None):
+    return Job(
+        name=name,
+        min_available=min_available,
+        tasks=[TaskSpec(name="worker", replicas=replicas,
+                        containers=[{"cpu": "1", "memory": "1Gi"}])],
+        volumes=volumes or [],
+        ttl_seconds_after_finished=ttl,
+    )
+
+
+def open_gate(store, job):
+    """Admit the job's PodGroup past Pending (the scheduler's enqueue
+    gate) so sync creates pods."""
+    pg = store.pod_groups[f"{job.namespace}/{job.name}"]
+    pg.status.phase = PodGroupPhase.Inqueue.value
+    store.update_pod_group(pg)
+
+
+def job_pods(store, job):
+    return [p for p in store.pods.values()
+            if p.owner_job == job.key]
+
+
+# ---------------------------------------------------------------- sync_job
+
+
+def test_sync_creates_podgroup_with_min_resources():
+    """CreatePodGroupIfNotExistFunc analog: initiate creates the gang
+    PodGroup with MinResources aggregated from min_available tasks."""
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=3, min_available=2)
+    jc.sync_job(job, None)
+    pg = s.pod_groups["default/j1"]
+    assert pg.min_member == 2
+    assert pg.owner_job == "default/j1"
+    # 2 (min_available) x 1 cpu.
+    assert pg.min_resources["cpu"] == "2000m"
+
+
+def test_sync_gates_pod_creation_on_podgroup_phase():
+    """job_controller_actions.go:227-231: no pods until the PodGroup
+    leaves Pending (the scheduler's enqueue admission)."""
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=2)
+    jc.sync_job(job, None)
+    assert job_pods(s, job) == []
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    assert len(job_pods(s, job)) == 2
+
+
+def test_sync_scale_up_creates_missing_pods_only():
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=2)
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    first = {p.name for p in job_pods(s, job)}
+    job.tasks[0].replicas = 4
+    jc.sync_job(job, None)
+    pods = job_pods(s, job)
+    assert len(pods) == 4
+    assert first <= {p.name for p in pods}  # originals survive
+
+
+def test_sync_scale_down_deletes_excess_pods():
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=4)
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    job.tasks[0].replicas = 2
+    jc.sync_job(job, None)
+    alive = [p for p in job_pods(s, job) if not p.deleting]
+    doomed = [p for p in job_pods(s, job) if p.deleting]
+    assert len(alive) == 2
+    assert len(doomed) == 2
+    assert {p.name for p in alive} == {"j1-worker-0", "j1-worker-1"}
+
+
+def test_sync_classifies_status_counters():
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=3)
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    pods = job_pods(s, job)
+    import copy
+    for pod, phase in zip(pods, (PodPhase.Running, PodPhase.Succeeded,
+                                 PodPhase.Pending)):
+        upd = copy.copy(pod)
+        upd.phase = phase
+        if phase != PodPhase.Pending:
+            upd.node_name = "n0"
+        s.update_pod(upd)
+    jc.sync_job(job, None)
+    assert job.status.running == 1
+    assert job.status.succeeded == 1
+    assert job.status.pending == 1
+
+
+def test_sync_pod_names_are_deterministic_with_task_index():
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=2)
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    names = sorted(p.name for p in job_pods(s, job))
+    assert names == ["j1-worker-0", "j1-worker-1"]
+    by_name = {p.name: p for p in job_pods(s, job)}
+    assert by_name["j1-worker-0"].annotations["volcano-tpu/task-index"] == "0"
+    assert by_name["j1-worker-1"].annotations["volcano-tpu/task-index"] == "1"
+
+
+# ------------------------------------------------------------- job IO/PVC
+
+
+def test_create_job_io_creates_controller_owned_claim():
+    """CreatePVCFunc analog: a volume with a claim SPEC creates the
+    claim with the job as owner."""
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(volumes=[VolumeSpec(mount_path="/data",
+                                       volume_claim={"storage": "10Gi"})])
+    jc.sync_job(job, None)
+    assert len(s.pvcs) == 1
+    key, rec = next(iter(s.pvcs.items()))
+    assert rec["owner_job"] == "default/j1"
+    assert rec["spec"] == {"storage": "10Gi"}
+    # The generated name is persisted on the spec for idempotency.
+    assert job.volumes[0].volume_claim_name
+    assert job.status.controlled_resources
+
+
+def test_create_job_io_missing_named_claim_keeps_job_pending():
+    """CreateJobIOIfNotExistFunc analog: a named claim that does not
+    exist parks the job (no PodGroup, no pods) until it appears."""
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(volumes=[VolumeSpec(mount_path="/data",
+                                       volume_claim_name="pre-existing")])
+    jc.sync_job(job, None)
+    assert "default/j1" not in s.pod_groups
+    evs = s.events_for("Job/default/j1")
+    assert any(e["reason"] == "PVCNotFound" for e in evs)
+    # Claim appears -> next sync proceeds.
+    s.put_pvc("default", "pre-existing", {"storage": "1Gi"})
+    jc.sync_job(job, None)
+    assert "default/j1" in s.pod_groups
+
+
+def test_create_job_io_idempotent_across_syncs():
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(volumes=[VolumeSpec(mount_path="/data",
+                                       volume_claim={"storage": "10Gi"})])
+    jc.sync_job(job, None)
+    jc.sync_job(job, None)
+    assert len(s.pvcs) == 1  # no duplicate claim per sync
+
+
+def test_pods_mount_job_volumes():
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=1,
+                   volumes=[VolumeSpec(mount_path="/data",
+                                       volume_claim={"storage": "1Gi"})])
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    (pod,) = job_pods(s, job)
+    claim = job.volumes[0].volume_claim_name
+    assert (claim, "/data") in pod.volumes
+
+
+# ---------------------------------------------------------------- kill_job
+
+
+def test_kill_deletes_pods_and_podgroup_and_bumps_version():
+    """KillJobFunc analog: pods deleted, PodGroup removed, job version
+    incremented (stale-generation pod events then degrade to sync)."""
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=3)
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    v0 = job.status.version
+    jc.kill_job(job, retain_phases=set(), update_status=None)
+    assert all(p.deleting for p in job_pods(s, job))
+    assert "default/j1" not in s.pod_groups
+    assert job.status.version == v0 + 1
+
+
+def test_kill_retains_requested_phases():
+    """DeleteJobPod analog with retain: Succeeded pods survive a kill
+    that retains them (restart semantics keep completed work)."""
+    s = make_store()
+    jc = JobController(s)
+    job = make_job(replicas=2)
+    jc.sync_job(job, None)
+    open_gate(s, job)
+    jc.sync_job(job, None)
+    pods = job_pods(s, job)
+    import copy
+    done = copy.copy(pods[0])
+    done.phase = PodPhase.Succeeded
+    done.node_name = "n0"
+    s.update_pod(done)
+    jc.kill_job(job, retain_phases={PodPhase.Succeeded}, update_status=None)
+    survivors = [p for p in job_pods(s, job) if not p.deleting]
+    assert len(survivors) == 1
+    assert survivors[0].phase == PodPhase.Succeeded
+
+
+def test_cleanup_job_reaps_owned_claims():
+    """Owner-reference cleanup: controller-created claims die with the
+    job; pre-existing user claims survive."""
+    s = make_store()
+    s.put_pvc("default", "user-claim", {"storage": "1Gi"})
+    jc = JobController(s)
+    job = make_job(volumes=[
+        VolumeSpec(mount_path="/data", volume_claim={"storage": "10Gi"}),
+        VolumeSpec(mount_path="/user", volume_claim_name="user-claim"),
+    ])
+    jc.sync_job(job, None)
+    assert len(s.pvcs) == 2
+    jc._cleanup_job(job)
+    assert list(s.pvcs) == ["default/user-claim"]
+
+
+# ------------------------------------------------------------ applyPolicies
+
+
+def _policy_job(job_policies=None, task_policies=None):
+    return Job(
+        name="p1",
+        min_available=1,
+        tasks=[TaskSpec(name="worker", replicas=1,
+                        containers=[{"cpu": "1"}],
+                        policies=task_policies or [])],
+        policies=job_policies or [],
+    )
+
+
+@pytest.mark.parametrize("req,job_policies,task_policies,expected", [
+    # Explicit action on the request wins outright.
+    (Request(namespace="default", job_name="p1",
+             action=Action.RestartJob.value),
+     [], [], Action.RestartJob.value),
+    # OutOfSync always degrades to SyncJob.
+    (Request(namespace="default", job_name="p1",
+             event=Event.OutOfSync.value),
+     [LifecyclePolicy(event=Event.Any.value,
+                      action=Action.RestartJob.value)],
+     [], Action.SyncJob.value),
+    # Job-level policy matches the event.
+    (Request(namespace="default", job_name="p1",
+             event=Event.PodFailed.value),
+     [LifecyclePolicy(event=Event.PodFailed.value,
+                      action=Action.RestartJob.value)],
+     [], Action.RestartJob.value),
+    # Any-event policy matches every event.
+    (Request(namespace="default", job_name="p1",
+             event=Event.PodEvicted.value),
+     [LifecyclePolicy(event=Event.Any.value,
+                      action=Action.RestartJob.value)],
+     [], Action.RestartJob.value),
+    # Task-level policy wins over job-level for its task.
+    (Request(namespace="default", job_name="p1", task_name="worker",
+             event=Event.PodFailed.value),
+     [LifecyclePolicy(event=Event.PodFailed.value,
+                      action=Action.RestartJob.value)],
+     [LifecyclePolicy(event=Event.PodFailed.value,
+                      action=Action.AbortJob.value)],
+     Action.AbortJob.value),
+    # Exit-code policy match.
+    (Request(namespace="default", job_name="p1",
+             event=Event.PodFailed.value, exit_code=137),
+     [LifecyclePolicy(exit_code=137,
+                      action=Action.TerminateJob.value)],
+     [], Action.TerminateJob.value),
+    # No policy matches -> SyncJob default.
+    (Request(namespace="default", job_name="p1",
+             event=Event.PodEvicted.value),
+     [LifecyclePolicy(event=Event.PodFailed.value,
+                      action=Action.RestartJob.value)],
+     [], Action.SyncJob.value),
+])
+def test_apply_policies_table(req, job_policies, task_policies, expected):
+    job = _policy_job(job_policies, task_policies)
+    assert apply_policies(job, req) == expected
+
+
+def test_apply_policies_stale_version_degrades_to_sync():
+    job = _policy_job([LifecyclePolicy(event=Event.PodFailed.value,
+                                       action=Action.RestartJob.value)])
+    job.status.version = 5
+    req = Request(namespace="default", job_name="p1",
+                  event=Event.PodFailed.value, job_version=3)
+    assert apply_policies(job, req) == Action.SyncJob.value

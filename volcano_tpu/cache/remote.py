@@ -170,14 +170,16 @@ class HttpStatusUpdater(_HttpTransport):
     def update_pod_groups(self, pgs) -> None:
         """Batched write-back: one POST for a whole session close.  The
         fast path's _close prefers this when present — per-group round
-        trips at 12k changed groups would dwarf the cycle budget."""
-        try:
-            self._post("/podgroups", {
-                "groups": [self._group_payload(pg) for pg in pgs],
-            })
-        except (urllib.error.URLError, OSError) as e:
-            log.warning("remote podgroup status batch write failed: %s",
-                        e)
+        trips at 12k changed groups would dwarf the cycle budget.
+
+        Raises on transport failure, unlike the per-group method: the
+        fast path's close has a retry mechanism (it re-marks the batch
+        dirty so the NEXT cycle rewrites it), whereas a swallowed error
+        here would leave the remote permanently stale — close's change
+        detection compares against the already-advanced local status."""
+        self._post("/podgroups", {
+            "groups": [self._group_payload(pg) for pg in pgs],
+        })
 
     def update_pod_condition(self, pod, condition) -> None:
         try:

@@ -937,6 +937,63 @@ class FastCycle:
 
     # ------------------------------------------------------------ allocate
 
+    # Substrings identifying a crashed/unreachable TPU runtime in the
+    # exceptions jax surfaces (vs. a programming error, which must
+    # propagate).  The hyperscale-affinity envelope (BASELINE.md) can
+    # kill the remote worker mid-solve; those cycles recover by halving
+    # the chunk budget and resuming.
+    _DEVICE_CRASH_MARKERS = (
+        "TPU worker process crashed",
+        "worker process crashed",
+        "DATA_LOSS",
+        "DataLoss",
+        "UNAVAILABLE",
+        "Socket closed",
+        "connection terminated",
+        "device or resource busy",
+    )
+    # Lowest budget scale the crash handler degrades to (1/64 of the
+    # configured VOLCANO_TPU_AFF_BUDGET_MB).
+    _MIN_BUDGET_SCALE = 1.0 / 64.0
+    # Clean affinity cycles before the degraded budget doubles back up.
+    _SCALE_RECOVER_AFTER = 8
+
+    @classmethod
+    def _is_device_crash(cls, e: BaseException) -> bool:
+        msg = str(e)
+        return isinstance(e, Exception) and any(
+            m in msg for m in cls._DEVICE_CRASH_MARKERS
+        )
+
+    def _on_device_crash(self, e: Exception) -> None:
+        """Degrade the affinity chunk budget and re-probe the device.
+        Raises the original error when the runtime did not come back —
+        the scheduler's health machinery (UNHEALTHY_AFTER) then takes
+        over."""
+        store = self.store
+        scale = getattr(store, "_aff_budget_scale", 1.0)
+        scale = max(scale / 2.0, self._MIN_BUDGET_SCALE)
+        store._aff_budget_scale = scale
+        store._aff_clean_cycles = 0
+        log.error(
+            "TPU runtime crash mid-solve (%s); halving affinity chunk "
+            "budget to %.3gx and resuming the cycle", e, scale,
+        )
+        store.record_event(
+            "Scheduler/device", "DeviceCrashRecovered",
+            f"solve crashed ({type(e).__name__}); chunk budget now "
+            f"{scale:.3g}x",
+        )
+        metrics.device_crash_recoveries.inc()
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            jax.device_get(jnp.zeros((8,)) + 1)
+        except Exception:
+            log.exception("TPU runtime did not recover after crash")
+            raise e
+
     def _allocate(self) -> None:
         from .ops.allocate import solve
         from .ops.wave import solve_wave
@@ -948,65 +1005,97 @@ class FastCycle:
         solve_fn = solve_wave if solver == "wave" else solve
 
         lanes = self.lanes
+        store = self.store
         retry = False
-        for rnd in range(max_rounds):
-            if rnd >= max(rounds, 1) and not retry:
+        rnd = 0
+        crashes = 0
+        had_aff_chunks = False
+        while rnd < max_rounds + crashes:
+            if rnd >= max(rounds, 1) + crashes and not retry:
                 break
+            rnd += 1
             t_ord = time.perf_counter()
             ordered = self._ordered_jobs()
             prep = self._pending_rows(ordered)
             lanes["order"] = (lanes.get("order", 0.0)
                               + time.perf_counter() - t_ord)
             if prep is None:
-                return
+                break
             solve_jobs, task_rows = prep
             progress_any = False
             never_any = False
-            for cjobs, crows in self._solve_chunks(solve_jobs, task_rows):
-                t_enc = time.perf_counter()
-                inputs, pid, profiles = self._solve_inputs(cjobs, crows)
-                lanes["encode"] = (lanes.get("encode", 0.0)
-                                   + time.perf_counter() - t_enc)
-                t0 = time.perf_counter()
-                if solver == "wave":
-                    result = solve_fn(*inputs, pid=pid, profiles=profiles)
-                else:
-                    result = solve_fn(*inputs)
-                # One batched device->host fetch: through a remote-TPU
-                # tunnel each fetch RPC carries ~100 ms fixed latency, so
-                # three sequential np.asarray() calls triple the cycle's
-                # floor.
-                import jax
+            try:
+                for cjobs, crows in self._solve_chunks(solve_jobs,
+                                                       task_rows):
+                    had_aff_chunks |= self._chunks_had_terms
+                    t_enc = time.perf_counter()
+                    inputs, pid, profiles = self._solve_inputs(cjobs,
+                                                               crows)
+                    lanes["encode"] = (lanes.get("encode", 0.0)
+                                       + time.perf_counter() - t_enc)
+                    t0 = time.perf_counter()
+                    if solver == "wave":
+                        result = solve_fn(*inputs, pid=pid,
+                                          profiles=profiles)
+                    else:
+                        result = solve_fn(*inputs)
+                    # One batched device->host fetch: through a
+                    # remote-TPU tunnel each fetch RPC carries ~100 ms
+                    # fixed latency, so three sequential np.asarray()
+                    # calls triple the cycle's floor.
+                    import jax
 
-                for arr in (result.assigned, result.never_ready,
-                            result.fit_failed):
-                    try:
-                        arr.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                # Commit prep that doesn't need the assignments overlaps
-                # the device solve + transfer wait.
-                req_gather = self.m.c_req.gather(crows)
-                assigned, never_ready, fit_failed = jax.device_get(
-                    (result.assigned, result.never_ready,
-                     result.fit_failed)
-                )
-                assigned = assigned[:len(crows)]
-                dt_dev = time.perf_counter() - t0
-                lanes["device"] = lanes.get("device", 0.0) + dt_dev
-                metrics.device_solve_latency.observe(dt_dev * 1e3)
-                t_cm = time.perf_counter()
-                progress = self._commit(
-                    cjobs, crows, assigned, never_ready, fit_failed,
-                    req_gather,
-                )
-                lanes["commit"] = (lanes.get("commit", 0.0)
-                                   + time.perf_counter() - t_cm)
-                progress_any |= progress
-                never_any |= bool(never_ready.any())
+                    for arr in (result.assigned, result.never_ready,
+                                result.fit_failed):
+                        try:
+                            arr.copy_to_host_async()
+                        except AttributeError:
+                            pass
+                    # Commit prep that doesn't need the assignments
+                    # overlaps the device solve + transfer wait.
+                    req_gather = self.m.c_req.gather(crows)
+                    assigned, never_ready, fit_failed = jax.device_get(
+                        (result.assigned, result.never_ready,
+                         result.fit_failed)
+                    )
+                    assigned = assigned[:len(crows)]
+                    dt_dev = time.perf_counter() - t0
+                    lanes["device"] = lanes.get("device", 0.0) + dt_dev
+                    metrics.device_solve_latency.observe(dt_dev * 1e3)
+                    t_cm = time.perf_counter()
+                    progress = self._commit(
+                        cjobs, crows, assigned, never_ready, fit_failed,
+                        req_gather,
+                    )
+                    lanes["commit"] = (lanes.get("commit", 0.0)
+                                       + time.perf_counter() - t_cm)
+                    progress_any |= progress
+                    never_any |= bool(never_ready.any())
+            except Exception as e:
+                # Mid-solve TPU crash: committed chunks already landed;
+                # the crashed chunk mutated nothing host-side.  Degrade
+                # the chunk budget and re-derive the remaining pending
+                # work (committed tasks are no longer pending).
+                if crashes >= 3 or not self._is_device_crash(e):
+                    raise
+                crashes += 1
+                self._on_device_crash(e)
+                retry = True
+                continue
             retry = never_any and progress_any
             if not progress_any:
-                return
+                break
+        if had_aff_chunks and not crashes:
+            # Gradual budget recovery: after _SCALE_RECOVER_AFTER clean
+            # affinity cycles the degraded budget doubles back toward 1.
+            scale = getattr(store, "_aff_budget_scale", 1.0)
+            if scale < 1.0:
+                clean = getattr(store, "_aff_clean_cycles", 0) + 1
+                if clean >= self._SCALE_RECOVER_AFTER:
+                    store._aff_budget_scale = min(1.0, scale * 2.0)
+                    store._aff_clean_cycles = 0
+                else:
+                    store._aff_clean_cycles = clean
 
     def _solve_chunks(self, solve_jobs: List[int], task_rows: np.ndarray):
         """Split one solve call at job boundaries when the affinity count
@@ -1033,6 +1122,9 @@ class FastCycle:
                     "number; using 1024", raw,
                 )
             budget = 1024e6
+        # Crash-recovery degradation (see _on_device_crash): smaller
+        # chunks bound the device footprint after a TPU-worker crash.
+        budget *= getattr(self.store, "_aff_budget_scale", 1.0)
         # Footprint scales with the terms the PENDING rows actually touch
         # (the solver compacts [E, D] to active terms), not the mirror's
         # full interned term table.
@@ -1044,6 +1136,10 @@ class FastCycle:
         from .ops.wave import bucket_pow2
 
         E = len(np.unique(refs_term)) if len(refs_term) else 0
+        # Crash-recovery bookkeeping: only solves that actually carried
+        # affinity terms count as "clean affinity cycles" for walking
+        # the degraded chunk budget back up.
+        self._chunks_had_terms = E > 0
         # Two int32 [Ep, D] tensors; budget against the solver's actual
         # padded bucket (headroom + pow2 round-up reaches 2.5x raw).
         cost = float(bucket_pow2(E, floor=1)) * D * 8.0 if E else 0.0
@@ -2215,7 +2311,12 @@ class FastCycle:
             ]
             unsched_mask[unready] = True
             gang_events = []
-            for row in unready.tolist():
+            gauge_pairs = []
+            retry_keys = []
+            unready_counts = (
+                m.j_minav[unready] - self.j_ready_base[unready]
+            ).tolist()
+            for row, n_unready in zip(unready.tolist(), unready_counts):
                 msg = self._gang_message(row, row in fit_failed)
                 pg = self.j_pgs[row]
                 if pg is not None:
@@ -2252,14 +2353,13 @@ class FastCycle:
                             f"PodGroup/{pg.namespace}/{pg.name}",
                             "Unschedulable", msg,
                         ))
-                job_name = m.j_uid[row].split("/")[-1]
-                metrics.unschedule_task_count.set(
-                    int(m.j_minav[row] - self.j_ready_base[row]),
-                    job_name=job_name,
-                )
-                metrics.job_retry_counts.inc(job_name=job_name)
+                key = (("job_name", m.j_uid[row].split("/")[-1]),)
+                gauge_pairs.append((key, n_unready))
+                retry_keys.append(key)
             if gang_events:
                 store.record_events(gang_events)
+            metrics.unschedule_task_count.set_many(gauge_pairs)
+            metrics.job_retry_counts.inc_many(retry_keys)
             metrics.unschedule_job_count.set(len(unready))
 
         # jobStatus write-back, skipping unchanged PodGroups
@@ -2298,6 +2398,7 @@ class FastCycle:
                     dirty[row] = True
             changed |= dirty[srows] & (cur_code != 0)
         idx = np.flatnonzero(changed)
+        failed_status_uids = None
         if len(idx):
             rows_l = srows[idx].tolist()
             code_l = new_code[idx].tolist()
@@ -2339,11 +2440,25 @@ class FastCycle:
                 # One write-back call per close (job_updater.go batches
                 # its API writes the same way; a remote updater would
                 # otherwise pay 12k round trips).
-                batch_update(written)
+                try:
+                    batch_update(written)
+                except Exception:
+                    # The local status already advanced, so the change
+                    # detection would skip these rows forever; re-mark
+                    # them dirty (after the clear below) so the next
+                    # cycle rewrites the batch.
+                    log.exception(
+                        "status batch write failed; %d PodGroups "
+                        "re-marked dirty for the next cycle",
+                        len(written),
+                    )
+                    failed_status_uids = [pg.uid for pg in written]
         # Every pending in-place transition has now been persisted (or
         # superseded); a failure above leaves the set intact for the
         # next cycle.
         self._phase_dirty.clear()
+        if failed_status_uids:
+            self._phase_dirty.update(failed_status_uids)
 
     def _gang_message(self, row: int, fit_failed: bool) -> str:
         """Replicates gang.go's unschedulable message via job.fit_error()."""

@@ -1311,17 +1311,17 @@ class FastEvictor:
     def _schedulable_jobs(self) -> List[int]:
         c = self.cyc
         m = c.m
-        out = []
-        for jr in c.session_jobs:
-            pg = c.store.pod_groups.get(m.j_uid[jr])
-            if pg is not None and pg.status.phase == PodGroupPhase.Pending.value:
-                continue
-            if c._has("gang") and c.j_valid[jr] < m.j_minav[jr]:
-                continue
-            if m.j_queue[jr] not in c.store.queues:
-                continue
-            out.append(jr)
-        return out
+        srows = np.asarray(c.session_jobs, np.int64)
+        if not len(srows):
+            return []
+        # Vectorized over the derive-time snapshot: j_phase code 1 =
+        # Pending-with-PodGroup (enqueue's in-place Inqueue transitions
+        # update the same array); q_of_job < 0 <=> queue unknown.
+        keep = c.j_phase[srows] != 1
+        if c._has("gang"):
+            keep &= c.j_valid[srows] >= m.j_minav[srows]
+        keep &= c.q_of_job[srows] >= 0
+        return srows[keep].tolist()
 
     # ------------------------------------------------------------- reclaim
 

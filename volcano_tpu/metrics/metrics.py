@@ -46,6 +46,12 @@ class _Gauge:
     def set(self, value: float, **labels):
         self.data[_labels_key(labels)] = value
 
+    def set_many(self, pairs):
+        """Bulk update from prebuilt (label-key-tuple, value) pairs — the
+        per-job gauges (25k+ unschedulable jobs at scale) skip the
+        per-call kwargs/sort overhead."""
+        self.data.update(pairs)
+
 
 class _Counter:
     def __init__(self, name: str, help_: str):
@@ -56,6 +62,13 @@ class _Counter:
     def inc(self, value: float = 1.0, **labels):
         key = _labels_key(labels)
         self.data[key] = self.data.get(key, 0.0) + value
+
+    def inc_many(self, keys, value: float = 1.0):
+        """Bulk increment from prebuilt label-key tuples."""
+        data = self.data
+        get = data.get
+        for key in keys:
+            data[key] = get(key, 0.0) + value
 
 
 class Metrics:
@@ -155,6 +168,11 @@ class Metrics:
         self.device_solve_latency = _Histogram(
             f"{ns}_device_solve_latency_milliseconds",
             "Device allocate-solver latency in milliseconds",
+        )
+        self.device_crash_recoveries = _Counter(
+            f"{ns}_device_crash_recoveries_total",
+            "Mid-solve TPU runtime crashes recovered by degrading the "
+            "affinity chunk budget",
         )
         self.snapshot_transfer_bytes = _Gauge(
             f"{ns}_snapshot_transfer_bytes",

@@ -112,6 +112,19 @@ SUBROUNDS = _env_int("VOLCANO_TPU_SUBROUNDS", 16)
 # land ~25% faster without it (see BASELINE.md affinity analysis).
 # Re-enable with VOLCANO_TPU_AFF_STEER=1 for term-heavy small clusters.
 AFF_STEER = _env_int("VOLCANO_TPU_AFF_STEER", 0)
+# Attempt-level cache of the inter-pod affinity planes (required/anti
+# feasibility + soft score): recompute only on term-count changes
+# instead of every attempt.  Exact (same values); knob exists for A/B
+# measurement.
+AFF_ACACHE = _env_int("VOLCANO_TPU_AFF_ACACHE", 1)
+# Per-attempt count-window gathers cnt[e, node_dom[n, key(e)]] run as
+# ~10 ns/element serialized gathers on TPU (21 ms per attempt at
+# 10k x 100k); below this [D, N] f32 footprint they run instead as one
+# MXU matmul against a domain-membership one-hot (exact: counts are
+# zero outside a term's own key's domains, so each output element picks
+# up exactly one product, and f32 represents the integer counts
+# exactly).  Above it (hyperscale D ~ 50k) the gather path remains.
+DOM_MM_MAX_MB = _env_int("VOLCANO_TPU_DOM_MM_MB", 1024)
 
 
 class SolveProfiles(NamedTuple):
@@ -173,7 +186,8 @@ def _subset_mm(rows_bits, table_missing_f):
     return viol == 0
 
 
-@partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features"))
+@partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features",
+                                   "terms_disjoint"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -194,6 +208,7 @@ def _solve_wave(
     n_waves: int,
     ew: int,
     features: tuple = (True, True, True, True, True, False, False),
+    terms_disjoint: bool = False,
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
@@ -266,6 +281,25 @@ def _solve_wave(
     )
 
     tril = jnp.tril(jnp.ones((W, W), bool), k=-1)  # strictly-earlier mask
+
+    # Domain-membership one-hot for the count-window matmul (see
+    # DOM_MM_MAX_MB): dom_oh[d, n] = 1 iff node n belongs to global
+    # domain d under SOME topology key.  Counts are zero outside a
+    # term's own key's domains, so cnt @ dom_oh picks up exactly
+    # cnt[e, node_dom[n, key(e)]] — the per-attempt gather as one MXU
+    # pass.  Built once per solve; trace-static size gate.
+    dom_mm = has_aff and (D * N * 4 <= DOM_MM_MAX_MB * 1_000_000)
+    if dom_mm:
+        K_keys = aff.node_dom.shape[1]
+        dom_oh = jnp.zeros((D, N), f32)
+        for k in range(K_keys):
+            nd_k = aff.node_dom[:, k]  # [N] domain id or -1
+            dom_oh = dom_oh.at[
+                jnp.where(nd_k >= 0, nd_k, D), jnp.arange(N)
+            ].max(jnp.where(nd_k >= 0, 1.0, 0.0),
+                  mode="drop")
+    else:
+        dom_oh = None
 
     def run_wave(w, state: GState) -> GState:
         off = w * W
@@ -365,8 +399,19 @@ def _solve_wave(
             # does not hoist out of while_loops).
             p_static_score = p_static_score + score_prof[pids]
 
-        def live_parts(s: GState, cw_a, cw_p):
-            """Per-attempt dynamic feasibility [UM, N] (+ cval for aff)."""
+        def live_parts(s: GState, cw_a, cw_p, aff_ok_c, aff_soft_c,
+                       aff_dirty_a):
+            """Per-attempt dynamic feasibility [UM, N].
+
+            The inter-pod affinity planes (required/anti feasibility +
+            soft-term score) depend ONLY on the wave's term counts, so
+            they are carried across attempts and recomputed solely when
+            a sub-round actually changed a count (aff_dirty_a): the
+            [N, EW] domain gather over cnt[EW, D~N] and the
+            [UM, EW] x [EW, N] matmuls — the dominant per-attempt cost
+            at the affinity-mix north-star shape — run once per count
+            change instead of once per attempt.  Exact: same values,
+            fewer recomputes."""
             if has_future:
                 future_idle = (
                     s.idle + nodes.releasing - nodes.pipelined - s.pip_extra
@@ -390,13 +435,20 @@ def _solve_wave(
                     p_ports.astype(f32), used_port_f.T
                 )
                 p_feasible &= ~p_has_ports[:, None] | (port_clash == 0)
-            cval = None
+            aff_ok, aff_soft = aff_ok_c, aff_soft_c
             if has_aff:
                 def _aff_parts(cnt):
-                    cv = cnt[
-                        term_arange[None, :], jnp.maximum(node_dom_t, 0)
-                    ]
-                    cv = jnp.where(node_dom_t >= 0, cv, 0)  # [N, EW]
+                    if dom_mm:
+                        # One MXU pass replaces the [N, EW] serialized
+                        # gather (21 ms/attempt at 10k x 100k).  f32 is
+                        # exact: integer counts, one product per output.
+                        cv = jnp.matmul(cnt.astype(f32), dom_oh).T
+                    else:
+                        cv = cnt[
+                            term_arange[None, :],
+                            jnp.maximum(node_dom_t, 0)
+                        ]
+                        cv = jnp.where(node_dom_t >= 0, cv, 0)  # [N, EW]
                     total = jnp.sum(cnt, axis=-1)  # [EW]
                     # Required affinity: every required term needs a
                     # resident match in the node's domain (or the
@@ -413,21 +465,23 @@ def _solve_wave(
                     anti_viol = jnp.matmul(
                         p_t_req_anti.astype(bf), (cv > 0).astype(bf).T
                     )
-                    return cv, (aff_viol < 0.5) & (anti_viol < 0.5)
+                    soft = jnp.matmul(p_t_soft, cv.T.astype(f32))
+                    return (aff_viol < 0.5) & (anti_viol < 0.5), soft
 
-                def _aff_skip(cnt):
-                    return (
-                        jnp.zeros((N, EW), cnt.dtype),
-                        jnp.ones((UM, N), bool),
-                    )
-
-                cval, aff_ok = jax.lax.cond(
-                    wave_live, _aff_parts, _aff_skip, cw_a + cw_p
+                # Cache init is (all-true, zeros) and aff_dirty_a starts
+                # at wave_live, so term-free waves never enter the
+                # compute branch (the old _aff_skip case).  With the
+                # cache disabled, every attempt of a live wave
+                # recomputes (the pre-cache behavior).
+                gate = aff_dirty_a if AFF_ACACHE else wave_live
+                aff_ok, aff_soft = jax.lax.cond(
+                    gate, _aff_parts,
+                    lambda cnt: (aff_ok_c, aff_soft_c), cw_a + cw_p
                 )
                 p_feasible &= aff_ok
-            return p_feasible, future_idle, walk_idle, cval
+            return p_feasible, future_idle, walk_idle, aff_ok, aff_soft
 
-        def rank_nodes(s: GState, p_feasible, cval):
+        def rank_nodes(s: GState, p_feasible, aff_soft):
             """Per-profile node ranking by live score ([UM, K] ids).
 
             One argsort per attempt.  Because infeasible nodes rank last
@@ -440,12 +494,9 @@ def _solve_wave(
             )
             p_score = p_score + p_static_score
             if has_aff:
-                p_score = p_score + jax.lax.cond(
-                    wave_live,
-                    lambda cv: jnp.matmul(p_t_soft, cv.T.astype(f32)),
-                    lambda cv: jnp.zeros((UM, N), f32),
-                    cval,
-                )
+                # Soft-term component rides the attempt cache (zeros for
+                # term-free waves).
+                p_score = p_score + aff_soft
             p_score = jnp.where(p_feasible, p_score, NEG)
             # top_k is the partial sort: ties prefer lower node index,
             # matching the stable argsort it replaces.
@@ -456,7 +507,7 @@ def _solve_wave(
 
         def attempt_cond(carry):
             (_s, _cwa, _cwp, done, _al, _ff, skip_l, _ov, _aw, _pw, it,
-             stalled) = carry
+             stalled, _aok, _asoft, _adirty) = carry
             skip_t = (
                 jnp.matmul(onehot_j, skip_l.astype(f32)[:, None])[:, 0] > 0
             )
@@ -473,7 +524,8 @@ def _solve_wave(
 
         def attempt_body(carry):
             (s, cw_a, cw_p, done, alloc_l, fitf_l, skip_l, over_l,
-             assigned_w, pipelined_w, it, _stalled) = carry
+             assigned_w, pipelined_w, it, _stalled,
+             aff_ok_c, aff_soft_c, aff_dirty_a) = carry
             skip_l0 = skip_l
 
             if has_overuse:
@@ -496,10 +548,11 @@ def _solve_wave(
             )
             cand = ~done & ~skip_t
 
-            p_feasible, future_idle, walk_idle, cval = live_parts(
-                s, cw_a, cw_p
+            p_feasible, future_idle, walk_idle, aff_ok_c, aff_soft_c = (
+                live_parts(s, cw_a, cw_p, aff_ok_c, aff_soft_c,
+                           aff_dirty_a)
             )
-            ranked = rank_nodes(s, p_feasible, cval)
+            ranked = rank_nodes(s, p_feasible, aff_soft_c)
 
             p_any = jnp.any(p_feasible, axis=1)
             any_feasible = (
@@ -561,14 +614,15 @@ def _solve_wave(
             # per attempt.
             def sub_cond(sc):
                 (_s, _cwa, _cwp, _fk, _dirty, done_sub, _al, _aw, _pw, si,
-                 progressed) = sc
+                 progressed, _cch) = sc
                 return progressed & (si < SUBROUNDS) & jnp.any(
                     cand & ~done_sub & ~aborted
                 )
 
             def sub_body(sc):
                 (s_, cw_a_, cw_p_, feas_k_c, aff_dirty, done_sub, alloc_l_,
-                 assigned_w_, pipelined_w_, si, _progressed) = sc
+                 assigned_w_, pipelined_w_, si, _progressed,
+                 cnt_changed) = sc
                 cand_s = cand & ~done_sub & ~aborted
 
                 if has_aff:
@@ -721,10 +775,18 @@ def _solve_wave(
                         dw = node_dom_t[choice]  # [W, EW]
                         cnt_live = cwa + cwp  # [EW, D]
                         total_live = jnp.sum(cnt_live, axis=-1)  # [EW]
-                        cval_t = cnt_live[
-                            term_arange[None, :], jnp.maximum(dw, 0)
-                        ]
-                        cval_t = jnp.where(dw >= 0, cval_t, 0)  # [W, EW]
+                        if dom_mm:
+                            # MXU pass + row gather instead of the
+                            # [W, EW] serialized element gather (see
+                            # _aff_parts).
+                            cval_t = jnp.matmul(
+                                cnt_live.astype(f32), dom_oh
+                            ).T[choice]
+                        else:
+                            cval_t = cnt_live[
+                                term_arange[None, :], jnp.maximum(dw, 0)
+                            ]
+                            cval_t = jnp.where(dw >= 0, cval_t, 0)
                         req_aff_t = p_t_req_aff[pid_l]  # [W, EW]
                         selfok_t = (total_live == 0)[None, :] & t_matches_w
                         aff_ok = ~jnp.any(
@@ -791,8 +853,14 @@ def _solve_wave(
                         )
                         return out & ~(conflict_anti | conflict_self)
 
+                    # The filter only modifies bits of tasks that carry
+                    # required terms: with none of them in `clean` it is
+                    # the identity, so the gate checks CLEAN (tasks
+                    # actually placing this sub-round), not candidacy —
+                    # unresolved affinity stragglers stop re-running the
+                    # scatter-min machinery every sub-round.
                     clean = jax.lax.cond(
-                        wave_live & jnp.any(cand_s & involved_any_t),
+                        wave_live & jnp.any(clean & involved_any_t),
                         _aff_filter, lambda op: op[0],
                         (clean, cw_a_, cw_p_),
                     )
@@ -867,13 +935,14 @@ def _solve_wave(
                             cwp = cnt_apply(cwp, acc_pipe)
                         return cwa, cwp
 
+                    did_cnt = wave_live & jnp.any(
+                        (acc_alloc | acc_pipe) & matches_any_t
+                    )
                     cw_a_, cw_p_ = jax.lax.cond(
-                        wave_live & jnp.any(
-                            (acc_alloc | acc_pipe) & matches_any_t
-                        ),
-                        _cnt_update, lambda op: op,
+                        did_cnt, _cnt_update, lambda op: op,
                         (cw_a_, cw_p_),
                     )
+                    cnt_changed = cnt_changed | did_cnt
 
                 alloc_l_ = alloc_l_ + jnp.round(
                     jnp.matmul(
@@ -899,13 +968,17 @@ def _solve_wave(
                     s_, cw_a_, cw_p_, feas_k, dirty_next,
                     done_sub | resolved, alloc_l_,
                     assigned_w_, pipelined_w_, si + 1, jnp.any(resolved),
+                    cnt_changed,
                 )
 
             (s, cw_a, cw_p, _fk, _dirty, done_sub, alloc_l, assigned_w,
-             pipelined_w, subs, _prog) = jax.lax.while_loop(
-                sub_cond, sub_body,
-                (s, cw_a, cw_p, feas_k_att, jnp.bool_(False), done, alloc_l,
-                 assigned_w, pipelined_w, jnp.int32(0), jnp.bool_(True)),
+             pipelined_w, subs, _prog, cnt_changed_out) = (
+                jax.lax.while_loop(
+                    sub_cond, sub_body,
+                    (s, cw_a, cw_p, feas_k_att, jnp.bool_(False), done,
+                     alloc_l, assigned_w, pipelined_w, jnp.int32(0),
+                     jnp.bool_(True), jnp.bool_(False)),
+                )
             )
 
             # Attempt-level job bookkeeping for fit failures.
@@ -926,15 +999,25 @@ def _solve_wave(
             return (
                 s, cw_a, cw_p, done, alloc_l, fitf_l, skip_l, over_l,
                 assigned_w, pipelined_w, it + jnp.maximum(subs, 1), stalled,
+                aff_ok_c, aff_soft_c, cnt_changed_out,
             )
 
         # Per-wave count windows (the wave only touches its own term rows).
         if has_aff:
             cw_a0 = state.cnt_alloc[wterms]
             cw_p0 = state.cnt_pip[wterms]
+            # Affinity attempt-cache init: all-feasible/zero-score with
+            # the dirty flag at wave_live, so live waves compute on the
+            # first attempt and term-free waves never do.
+            aff_ok0 = jnp.ones((UM, N), bool)
+            aff_soft0 = jnp.zeros((UM, N), f32)
+            aff_dirty0 = wave_live
         else:
             cw_a0 = jnp.zeros((1, 1), jnp.int32)
             cw_p0 = jnp.zeros((1, 1), jnp.int32)
+            aff_ok0 = jnp.ones((1, 1), bool)
+            aff_soft0 = jnp.zeros((1, 1), f32)
+            aff_dirty0 = jnp.bool_(False)
 
         init = (
             state,
@@ -949,14 +1032,20 @@ def _solve_wave(
             jnp.full((W,), -1, jnp.int32),
             jnp.int32(0),
             jnp.bool_(False),
+            aff_ok0,
+            aff_soft0,
+            aff_dirty0,
         )
         (s, cw_a, cw_p, _done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
-         pipelined_w, _it, _stalled) = jax.lax.while_loop(
-            attempt_cond, attempt_body, init
+         pipelined_w, _it, _stalled, _aok, _asoft, _adirty) = (
+            jax.lax.while_loop(attempt_cond, attempt_body, init)
         )
-        if has_aff:
+        if has_aff and not terms_disjoint:
             # Real rows are unique in wterms; duplicate writes only hit
-            # the dummy scratch row.
+            # the dummy scratch row.  With wave-disjoint term sets (the
+            # static flag) no later wave reads these counts and the
+            # write-back — a full [E, D]-table rewrite per wave under
+            # XLA's scatter lowering — is skipped.
             s = s._replace(
                 cnt_alloc=s.cnt_alloc.at[wterms].set(cw_a),
                 cnt_pip=s.cnt_pip.at[wterms].set(cw_p),
@@ -1266,9 +1355,22 @@ def _term_windows(profiles: SolveProfiles, aff: AffinityArgs,
     wave_terms = np.full((n_waves, EW), E, np.int32)  # pad = dummy row
     for w, terms in enumerate(term_lists):
         wave_terms[w, :len(terms)] = terms
+    # Term sets are usually wave-disjoint (terms select a job's own app
+    # label and jobs never split across waves): no wave then reads a
+    # count another wave wrote, and the per-wave window write-back into
+    # the global [E, D] tables — a full-table rewrite per wave under
+    # XLA's scatter lowering, ~2 s/cycle at the north-star affinity
+    # shape — can be skipped wholesale.
+    if term_lists:
+        all_terms = np.concatenate(term_lists)
+        terms_disjoint = bool(
+            len(all_terms) == len(np.unique(all_terms))
+        )
+    else:
+        terms_disjoint = True
     # iom's dummy column is all-zero; callers reuse it as the nonzero
     # union of the four tables (the sparse-shipping path).
-    return profiles, aff, wave_terms, int(EW), iom
+    return profiles, aff, wave_terms, int(EW), iom, terms_disjoint
 
 
 def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
@@ -1464,9 +1566,11 @@ def solve_wave(
     prof_sparse = (
         _np(profiles.t_req_aff).size > PROF_SPARSE_MIN
     )
-    profiles, aff, wave_terms, ew, prof_iom = _term_windows(
-        profiles, aff, pid, wave_prof, n_waves, skip_cnt0=cnt0_sparse,
-        skip_prof=prof_sparse,
+    profiles, aff, wave_terms, ew, prof_iom, terms_disjoint = (
+        _term_windows(
+            profiles, aff, pid, wave_prof, n_waves,
+            skip_cnt0=cnt0_sparse, skip_prof=prof_sparse,
+        )
     )
     # Profile-term tables ([U, Ep] bool x3 + f32) reach ~75 MB at the
     # north-star affinity shape but are overwhelmingly zero (a profile
@@ -1547,6 +1651,7 @@ def solve_wave(
             profiles, extra_prof, score_prof, pid, wave_prof, pid_local,
             wave_terms,
             wave=wave, n_waves=n_waves, ew=ew, features=features,
+            terms_disjoint=terms_disjoint,
         )
     if pad:
         res = res._replace(
