@@ -1,0 +1,197 @@
+"""Job-controller event-handler tables.
+
+The analog of ``pkg/controllers/job/job_controller_handler_test.go``:
+store events (pod update/evict/delete, job add/update, PodGroup status,
+node health, commands) must map to the right reconcile Requests —
+correct event type, task attribution, exit code, and job-version
+stamping — and ownerless pods must be ignored.
+"""
+
+import copy
+
+import pytest
+
+from volcano_tpu.api import Node, Pod, PodPhase
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.controllers import Job, JobController, TaskSpec
+from volcano_tpu.controllers.apis import Command, Event
+
+
+def make_store():
+    s = ClusterStore()
+    s.add_node(Node(name="n0", allocatable={"cpu": "16", "memory": "32Gi",
+                                            "pods": 110}))
+    return s
+
+
+def owned_pod(name="j1-worker-0", version=3, **kw):
+    kw.setdefault("containers", [{"cpu": "1", "memory": "1Gi"}])
+    return Pod(
+        name=name,
+        owner_job="default/j1",
+        task_name="worker",
+        annotations={"volcano-tpu/job-version": str(version)},
+        **kw,
+    )
+
+
+def drain(jc):
+    out = list(jc.queue)
+    jc.queue.clear()
+    return out
+
+
+def test_job_add_and_update_enqueue_outofsync():
+    s = make_store()
+    jc = JobController(s)
+    job = Job(name="j1", min_available=1,
+              tasks=[TaskSpec(name="worker", replicas=1,
+                              containers=[{"cpu": "1"}])])
+    s.add_batch_job(job)
+    reqs = drain(jc)
+    assert [r.event for r in reqs] == [Event.OutOfSync.value]
+    s.update_batch_job(job)
+    reqs = drain(jc)
+    assert [r.event for r in reqs] == [Event.OutOfSync.value]
+    assert reqs[0].job_name == "j1"
+
+
+@pytest.mark.parametrize("phase,exit_code,expected_event,has_task", [
+    (PodPhase.Failed, 137, Event.PodFailed.value, True),
+    (PodPhase.Succeeded, 0, Event.TaskCompleted.value, True),
+    (PodPhase.Running, 0, Event.OutOfSync.value, False),
+    (PodPhase.Pending, 0, Event.OutOfSync.value, False),
+])
+def test_pod_update_event_table(phase, exit_code, expected_event,
+                                has_task):
+    """job_controller_handler.go updatePod: terminal phases fire
+    lifecycle events with task attribution + exit code; everything else
+    degrades to sync."""
+    s = make_store()
+    jc = JobController(s)
+    pod = owned_pod(phase=PodPhase.Running, node_name="n0")
+    s.add_pod(pod)
+    drain(jc)
+    upd = copy.copy(pod)
+    upd.phase = phase
+    upd.exit_code = exit_code
+    s.update_pod(upd)
+    reqs = drain(jc)
+    assert len(reqs) == 1
+    r = reqs[0]
+    assert r.event == expected_event
+    assert r.namespace == "default" and r.job_name == "j1"
+    if has_task:
+        assert r.task_name == "worker"
+        assert r.job_version == 3
+    if expected_event == Event.PodFailed.value:
+        assert r.exit_code == 137
+
+
+def test_pod_evict_event_fires_podevicted():
+    s = make_store()
+    jc = JobController(s)
+    pod = owned_pod(phase=PodPhase.Running, node_name="n0")
+    s.add_pod(pod)
+    drain(jc)
+    s._notify("Pod", "evict", pod)
+    reqs = drain(jc)
+    assert [r.event for r in reqs] == [Event.PodEvicted.value]
+    assert reqs[0].task_name == "worker"
+    assert reqs[0].job_version == 3
+
+
+def test_pod_delete_degrades_to_sync():
+    s = make_store()
+    jc = JobController(s)
+    pod = owned_pod(phase=PodPhase.Running, node_name="n0")
+    s.add_pod(pod)
+    drain(jc)
+    s.delete_pod(pod)
+    reqs = drain(jc)
+    assert [r.event for r in reqs] == [Event.OutOfSync.value]
+
+
+def test_ownerless_pod_events_ignored():
+    """Bare pods (no owner job) never reach the job controller's
+    queue — the podgroup controller owns them."""
+    s = make_store()
+    jc = JobController(s)
+    pod = Pod(name="bare-0", containers=[{"cpu": "1", "memory": "1Gi"}],
+              phase=PodPhase.Running, node_name="n0")
+    s.add_pod(pod)
+    upd = copy.copy(pod)
+    upd.phase = PodPhase.Failed
+    s.update_pod(upd)
+    s.delete_pod(upd)
+    assert drain(jc) == []
+
+
+def test_node_notready_raises_deviceunhealthy_per_resident_job():
+    """TPU-native: a node going NotReady fires DeviceUnhealthy for each
+    job with pods resident on it (SURVEY.md 5.3)."""
+    s = make_store()
+    jc = JobController(s)
+    pod = owned_pod(phase=PodPhase.Running, node_name="n0")
+    s.add_pod(pod)
+    drain(jc)
+    down = Node(name="n0", allocatable={"cpu": "16", "memory": "32Gi",
+                                        "pods": 110}, ready=False)
+    s.update_node(down)
+    reqs = drain(jc)
+    assert Event.DeviceUnhealthy.value in [r.event for r in reqs]
+    du = next(r for r in reqs if r.event == Event.DeviceUnhealthy.value)
+    assert du.job_name == "j1"
+    assert du.task_name == "worker"
+
+
+def test_node_ready_update_is_quiet():
+    s = make_store()
+    jc = JobController(s)
+    pod = owned_pod(phase=PodPhase.Running, node_name="n0")
+    s.add_pod(pod)
+    drain(jc)
+    s.update_node(Node(name="n0",
+                       allocatable={"cpu": "32", "memory": "32Gi"}))
+    assert all(r.event != Event.DeviceUnhealthy.value
+               for r in drain(jc))
+
+
+def test_podgroup_status_event_syncs_owner_job():
+    s = make_store()
+    jc = JobController(s)
+    job = Job(name="j1", min_available=1,
+              tasks=[TaskSpec(name="worker", replicas=1,
+                              containers=[{"cpu": "1"}])])
+    s.add_batch_job(job)
+    jc.process_all()
+    pg = s.pod_groups["default/j1"]
+    drain(jc)
+    s._notify("PodGroup", "status", pg)
+    reqs = drain(jc)
+    assert [r.event for r in reqs] == [Event.OutOfSync.value]
+    assert reqs[0].job_name == "j1"
+
+
+def test_command_routes_action_and_is_consumed():
+    """bus API: a Job-targeted Command becomes a CommandIssued request
+    carrying the action, and the command record is deleted (owned by
+    its delivery)."""
+    s = make_store()
+    jc = JobController(s)
+    cmd = Command(action="AbortJob", target_kind="Job",
+                  target_name="j1", name="cmd-1")
+    s.add_command(cmd)
+    reqs = drain(jc)
+    assert [(r.event, r.action) for r in reqs] == [
+        (Event.CommandIssued.value, "AbortJob")
+    ]
+    assert not s.commands  # consumed
+
+
+def test_queue_command_not_routed_to_job_controller():
+    s = make_store()
+    jc = JobController(s)
+    s.add_command(Command(action="CloseQueue", target_kind="Queue",
+                          target_name="q1", name="cmd-q"))
+    assert all(r.event != Event.CommandIssued.value for r in drain(jc))

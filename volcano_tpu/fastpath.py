@@ -1109,7 +1109,6 @@ class FastCycle:
         legitimately see earlier chunks' placements (the same state the
         reference's sequential walk would show them)."""
         m = self.m
-        D = max(1, len(m.domains))
         raw = os.environ.get("VOLCANO_TPU_AFF_BUDGET_MB", "1024")
         try:
             budget = float(raw) * 1e6
@@ -1140,6 +1139,17 @@ class FastCycle:
         # affinity terms count as "clean affinity cycles" for walking
         # the degraded chunk budget back up.
         self._chunks_had_terms = E > 0
+        # Force domain interning BEFORE sizing (only when terms exist —
+        # plain workloads skip the O(N x K) interning walk): the domain
+        # table fills lazily in node_dom() (hostname domains intern per
+        # node row), so a fresh store's first budget decision otherwise
+        # sees D=1, estimates the count tensors at ~0.1 MB, and never
+        # chunks — shipping an [E, D~N] int32 pair (6.5 GB at
+        # 50k x 500k) that intermittently OOM-crashed the TPU worker
+        # (the BASELINE.md hyperscale known limit, root-caused round 4).
+        if E:
+            m.node_dom()
+        D = max(1, len(m.domains))
         # Two int32 [Ep, D] tensors; budget against the solver's actual
         # padded bucket (headroom + pow2 round-up reaches 2.5x raw).
         cost = float(bucket_pow2(E, floor=1)) * D * 8.0 if E else 0.0
@@ -1155,7 +1165,10 @@ class FastCycle:
         order = np.argsort(refs_row, kind="stable")
         refs_row = refs_row[order]
         refs_term = refs_term[order]
-        n_chunks = min(int(np.ceil(cost / budget)), len(solve_jobs))
+        # 2x factor: each chunk's term count re-pads to the next pow2
+        # bucket (worst case ~2x its raw share), so splitting at the
+        # raw cost alone leaves per-chunk tensors over budget.
+        n_chunks = min(int(np.ceil(cost * 2.0 / budget)), len(solve_jobs))
         target = max(1, int(np.ceil(len(task_rows) / n_chunks)))
         jr = self.jobr[task_rows]
         # Job segment boundaries in the job-contiguous task_rows.
