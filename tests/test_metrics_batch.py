@@ -1,0 +1,23 @@
+"""Metrics batch-update parity: set_many/inc_many must land
+identically to per-call set/inc (the gang-close fast path uses the
+batch forms with prebuilt label keys)."""
+
+
+def test_metrics_batch_updates_match_singles():
+    """set_many/inc_many must land identically to per-call set/inc."""
+    from volcano_tpu.metrics.metrics import Metrics
+
+    a, b = Metrics(), Metrics()
+    names = [f"job-{i}" for i in range(40)]
+    for i, n in enumerate(names):
+        a.unschedule_task_count.set(i, job_name=n)
+        a.job_retry_counts.inc(job_name=n)
+        a.job_retry_counts.inc(job_name=n)
+    b.unschedule_task_count.set_many(
+        ((("job_name", n),), i) for i, n in enumerate(names)
+    )
+    keys = [(("job_name", n),) for n in names]
+    b.job_retry_counts.inc_many(keys)
+    b.job_retry_counts.inc_many(keys)
+    assert a.unschedule_task_count.data == b.unschedule_task_count.data
+    assert a.job_retry_counts.data == b.job_retry_counts.data

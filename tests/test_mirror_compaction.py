@@ -1,0 +1,115 @@
+"""Mirror compaction semantics.
+
+``maybe_compact`` rebuilds the pod table without tombstones once dead
+rows dominate (>= 4096 rows, >= half dead).  Everything that indexes by
+row — p_row, bind keys, job links, the p_pod_nones tombstone counter —
+must survive the remap, and subsequent scheduling must behave as if the
+compaction never happened.
+"""
+
+import numpy as np
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, PodPhase
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.scheduler import Scheduler
+
+
+def churned_store(n_keep=64):
+    """Create + delete enough pods to cross the compaction threshold,
+    keeping ``n_keep`` running pods alive."""
+    s = ClusterStore()
+    for i in range(8):
+        s.add_node(Node(name=f"n{i}",
+                        allocatable={"cpu": "64", "memory": "128Gi",
+                                     "pods": 256}))
+    s.add_pod_group(PodGroup(name="keep", min_member=1))
+    keepers = []
+    for k in range(n_keep):
+        pod = Pod(name=f"keep-{k}",
+                  annotations={GROUP_NAME_ANNOTATION: "keep"},
+                  containers=[{"cpu": "1", "memory": "1Gi"}],
+                  phase=PodPhase.Running, node_name=f"n{k % 8}")
+        s.add_pod(pod)
+        keepers.append(pod)
+    s.add_pod_group(PodGroup(name="churn", min_member=1))
+    # Tombstone far more rows than survive.
+    for k in range(4400):
+        pod = Pod(name=f"churn-{k}",
+                  annotations={GROUP_NAME_ANNOTATION: "churn"},
+                  containers=[{"cpu": "1", "memory": "1Gi"}])
+        s.add_pod(pod)
+        s.delete_pod(pod)
+    return s, keepers
+
+
+def test_compaction_triggers_and_remaps():
+    s, keepers = churned_store()
+    m = s.mirror
+    assert len(m.p_uid) < 4096, "compaction did not trigger"
+    # Compaction fires mid-churn; deletes after it leave tombstones, and
+    # the counter must agree with them exactly (it was reset by the
+    # rebuild and re-counted only post-compaction deletes).
+    assert m.p_pod_nones == m.n_dead
+    assert sum(1 for p in m.p_pod if p is None) == m.p_pod_nones
+    # Every survivor is findable at its remapped row with intact state.
+    for pod in keepers:
+        row = m.p_row[pod.uid]
+        assert m.p_uid[row] == pod.uid
+        assert m.p_pod[row] is s.pods[pod.uid]
+        assert m.n_name[m.p_node[row]] == pod.node_name
+    # Node accounting unchanged.
+    used = sum(n.used.milli_cpu for n in s.nodes.values())
+    assert used == len(keepers) * 1000
+
+
+def test_scheduling_after_compaction():
+    """A fresh gang scheduled after compaction binds normally (rows,
+    CSR columns, and job links all remapped coherently)."""
+    s, _ = churned_store()
+    s.add_pod_group(PodGroup(name="late", min_member=4))
+    for k in range(4):
+        s.add_pod(Pod(name=f"late-{k}",
+                      annotations={GROUP_NAME_ANNOTATION: "late"},
+                      containers=[{"cpu": "2", "memory": "2Gi"}]))
+    Scheduler(s).run_once()
+    late = [p for p in s.pods.values()
+            if p.annotations.get(GROUP_NAME_ANNOTATION) == "late"]
+    assert len(late) == 4
+    assert all(p.node_name for p in late)
+
+
+def test_compaction_preserves_affinity_term_members():
+    """Term membership (inter-pod affinity candidates) survives the row
+    remap: an anti-affinity gang placed after churn still spreads."""
+    s = ClusterStore()
+    for i in range(6):
+        s.add_node(Node(name=f"n{i}",
+                        allocatable={"cpu": "32", "memory": "64Gi",
+                                     "pods": 256}))
+    from volcano_tpu.api import AffinityTerm
+
+    # Churn past the threshold first.
+    s.add_pod_group(PodGroup(name="churn", min_member=1))
+    for k in range(4400):
+        pod = Pod(name=f"churn-{k}",
+                  annotations={GROUP_NAME_ANNOTATION: "churn"},
+                  containers=[{"cpu": "1", "memory": "1Gi"}])
+        s.add_pod(pod)
+        s.delete_pod(pod)
+    s.add_pod_group(PodGroup(name="anti", min_member=3))
+    for k in range(3):
+        s.add_pod(Pod(
+            name=f"anti-{k}",
+            labels={"app": "anti"},
+            annotations={GROUP_NAME_ANNOTATION: "anti"},
+            containers=[{"cpu": "1", "memory": "1Gi"}],
+            anti_affinity=[AffinityTerm(
+                match_labels={"app": "anti"},
+                topology_key="kubernetes.io/hostname",
+            )],
+        ))
+    Scheduler(s).run_once()
+    placed = [p.node_name for p in s.pods.values()
+              if p.annotations.get(GROUP_NAME_ANNOTATION) == "anti"]
+    assert all(placed)
+    assert len(set(placed)) == 3, placed

@@ -242,6 +242,66 @@ def test_podgroup_status_crosses_process_boundary(remote_binder_process):
     store.close()
 
 
+def test_remote_evictor_transport_error_reverts(remote_binder_process):
+    """A transport-level failure (server gone mid-flight) is handled
+    like EvictFailure: per-key re-drive, then revert to Running — the
+    indeterminate-batch handling the binder documents, applied to
+    evictions."""
+    url = remote_binder_process
+    store = _oversubscribed_store()
+    client = HttpEvictor(url)
+
+    class Dying(HttpEvictor):
+        def evict_keys(self, keys, reason="preempted"):
+            raise OSError("connection reset by peer")
+
+        def evict(self, pod):
+            raise OSError("connection reset by peer")
+
+    store.evictor = Dying(url)
+    Scheduler(store, conf_str=EVICT_CONF).run_once()
+    assert not client.evicts()
+    assert not any(p.deleting for p in store.pods.values())
+    # Swap in a healthy evictor: next cycle lands the evictions.
+    store.evictor = client
+    Scheduler(store, conf_str=EVICT_CONF).run_once()
+    assert client.evicts()
+    store.close()
+
+
+def test_service_wires_remote_evictor_and_status(remote_binder_process):
+    """--remote-evictor / --remote-status-updater install the drop-ins
+    (with the same fail-fast healthz probe as the binder)."""
+    from volcano_tpu.service import Service
+
+    with pytest.raises(OSError):
+        Service(remote_evictor="http://127.0.0.1:9")
+    with pytest.raises(OSError):
+        Service(remote_status_updater="http://127.0.0.1:9")
+    store = ClusterStore()
+    svc = Service(store=store,
+                  remote_evictor=remote_binder_process,
+                  remote_status_updater=remote_binder_process)
+    assert isinstance(store.evictor, HttpEvictor)
+    assert isinstance(store.status_updater, HttpStatusUpdater)
+    svc.stop()
+
+
+def test_remote_pod_conditions_land(remote_binder_process):
+    """update_pod_condition posts to /podconditions (taskUnschedulable
+    analog, cache.go:556-575)."""
+    from types import SimpleNamespace
+
+    url = remote_binder_process
+    up = HttpStatusUpdater(url)
+    pod = SimpleNamespace(namespace="default", name="p0")
+    cond = SimpleNamespace(type="PodScheduled", status="False")
+    up.update_pod_condition(pod, cond)
+    conds = up.pod_conditions()
+    assert {"key": "default/p0", "type": "PodScheduled",
+            "status": "False"} in conds
+
+
 def test_in_process_service_object_for_unit_use():
     """RemoteBindService is also usable in-process (thread) for tests
     that don't need the boundary."""

@@ -194,6 +194,9 @@ class HttpStatusUpdater(_HttpTransport):
     def pod_groups(self) -> Dict[str, dict]:
         return self._get("/podgroups")
 
+    def pod_conditions(self) -> List[dict]:
+        return self._get("/podconditions")
+
 
 class RemoteBindService:
     """The second process: receives binds, records them, and can inject
@@ -234,6 +237,10 @@ class RemoteBindService:
                 elif self.path == "/podgroups":
                     with service._lock:
                         body = json.dumps(service.pod_groups).encode()
+                    self._reply(200, body)
+                elif self.path == "/podconditions":
+                    with service._lock:
+                        body = json.dumps(service.pod_conditions).encode()
                     self._reply(200, body)
                 else:
                     self._reply(404, b"{}")
