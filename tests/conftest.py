@@ -7,6 +7,14 @@ logic is shared with __graft_entry__.dryrun_multichip via
 volcano_tpu.virtualcpu.
 """
 
+import os
+
 from volcano_tpu.virtualcpu import force_virtual_cpu_platform
 
 force_virtual_cpu_platform(8)
+
+# Fast-path exceptions must FAIL tests, not silently fall back to the
+# object session (a fastpath bug could otherwise hide behind green
+# tests that pass via the fallback).  Tests that exercise the fallback
+# behavior itself override this with monkeypatch.setenv(..., "auto").
+os.environ.setdefault("VOLCANO_TPU_FALLBACK", "never")

@@ -200,6 +200,8 @@ def test_enqueue_transition_survives_failed_cycle(monkeypatch):
         return orig_alloc(self)
 
     monkeypatch.setattr(fp.FastCycle, "_allocate", failing_alloc)
+    # This test exercises the production fallback path by design.
+    monkeypatch.setenv("VOLCANO_TPU_FALLBACK", "auto")
     sched = Scheduler(store)
     sched.run_once()  # fast cycle fails post-enqueue; object path covers
     phases.clear()

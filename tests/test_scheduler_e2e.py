@@ -225,6 +225,9 @@ def test_fastpath_failure_fallback_guard(monkeypatch):
 
     monkeypatch.setattr(fp, "run_cycle_fast", boom)
 
+    # conftest pins FALLBACK=never for the suite; this test exercises
+    # the production default.
+    monkeypatch.setenv("VOLCANO_TPU_FALLBACK", "auto")
     store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
     Scheduler(store).run_once()  # falls back, still binds
     assert len(store.binder.binds) == 8

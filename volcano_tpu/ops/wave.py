@@ -264,6 +264,14 @@ def _solve_wave(
 
     job_seen = jnp.zeros((JP,), bool).at[tjob].max(tasks.real)
 
+    # With wave-disjoint term sets the global count tables are
+    # loop-INVARIANT (no wave reads another wave's writes, so the
+    # write-back is skipped); carrying the 164 MB-at-scale tables
+    # through the fori_loop makes XLA rematerialize them from the
+    # sparse cnt0 entries inside the loop (measured ~0.4 s/cycle).
+    # Keep them out of the carry and gather windows straight from the
+    # input instead.
+    cnt0_i32 = aff.cnt0.astype(jnp.int32)
     state = GState(
         idle=nodes.idle,
         pip_extra=jnp.zeros_like(nodes.idle),
@@ -271,8 +279,10 @@ def _solve_wave(
         pip_ntasks=jnp.zeros_like(nodes.ntasks),
         nport_bits=_unpack_bits(nodes.ports),
         pip_nport_bits=jnp.zeros_like(_unpack_bits(nodes.ports)),
-        cnt_alloc=aff.cnt0.astype(jnp.int32),
-        cnt_pip=jnp.zeros_like(aff.cnt0.astype(jnp.int32)),
+        cnt_alloc=(jnp.zeros((1, 1), jnp.int32) if terms_disjoint
+                   else cnt0_i32),
+        cnt_pip=(jnp.zeros((1, 1), jnp.int32) if terms_disjoint
+                 else jnp.zeros_like(cnt0_i32)),
         q_alloc=queues.allocated,
         q_pip=jnp.zeros_like(queues.allocated),
         alloc_cnt=jnp.zeros((JP,), jnp.int32),
@@ -1008,8 +1018,12 @@ def _solve_wave(
 
         # Per-wave count windows (the wave only touches its own term rows).
         if has_aff:
-            cw_a0 = state.cnt_alloc[wterms]
-            cw_p0 = state.cnt_pip[wterms]
+            if terms_disjoint:
+                cw_a0 = cnt0_i32[wterms]
+                cw_p0 = jnp.zeros_like(cw_a0)
+            else:
+                cw_a0 = state.cnt_alloc[wterms]
+                cw_p0 = state.cnt_pip[wterms]
             # Affinity attempt-cache init: all-feasible/zero-score with
             # the dirty flag at wave_live, so live waves compute on the
             # first attempt and term-free waves never do.
