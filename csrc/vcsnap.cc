@@ -588,16 +588,7 @@ static bool vc_reclaim_possible(const VcReclaimCtx& C, long long qid) {
   return false;
 }
 
-// ---- full single-queue reclaim driver ----------------------------------
-//
-// Runs the ENTIRE reclaim turn loop for the one queue holding pending
-// reclaimers (the common oversubscribed shape): a lazy max-ordered job
-// heap with live keys (fastpath_evict._LazyHeap semantics), per-turn
-// reclaim-possible veto, the cursor node walk per (profile) mask set,
-// and pipeline/evict bookkeeping — everything except the store replay,
-// which Python applies from the output buffers.  Turns involving tasks
-// the C side cannot handle exactly (ports / inter-pod terms / ghost
-// pods) return control to Python with the job identified (rc -3).
+// ---- reclaim driver shared structures ----------------------------------
 
 struct VcKey {
   double v[8];
@@ -623,35 +614,88 @@ struct VcMaskSet {
   long long cursor;
 };
 
-long long vcreclaim_drive(
-    void* ctx_p, long long qid, long long has_pred,
-    // jobs + tasks
+
+// ---- multi-queue reclaim driver ----------------------------------------
+//
+// The full cross-queue round-robin of fastpath_evict._reclaim_loop
+// (reclaim.go:84-130): a lazy min-ordered QUEUE heap with live keys
+// (share when proportion orders queues, then creation time, then uid
+// rank), each turn popping one job from the queue's own lazy job heap
+// and running one task's cursor walk.  Queue drop/re-push semantics
+// mirror the Python loop exactly: overused (memoized at first
+// evaluation, q_overused in/out), empty job heap, or a drained top job
+// drop the queue; a consumed turn re-pushes it.  Yields (-3/-5) hand
+// one job back to Python, which re-enters with dropped queues/jobs
+// filtered out.
+
+struct VcQKey {
+  double v[3];
+  int len;
+  long long slot;  // local queue slot
+  bool operator<(const VcQKey& o) const {
+    // std::priority_queue is a MAX-heap; invert for min-pop.
+    for (int i = 0; i < len; ++i) {
+      if (v[i] < o.v[i]) return false;
+      if (v[i] > o.v[i]) return true;
+    }
+    return false;
+  }
+};
+
+// fastpath_evict._queue_share: max over the deserved Resource's NAMED
+// slots of share(alloc, deserved) with 0/0 -> 0 and x/0 -> 1
+// (api/helpers.go:46-59).  q_named marks the named slots (cpu/memory
+// always; scalars the deserved dict carries, zero-valued included).
+static double vc_queue_share(const VcReclaimCtx& C, const uint8_t* q_named,
+                             long long qi) {
+  if (!C.q_has_deserved[qi]) return 0.0;
+  double s = 0.0;
+  for (long long k = 0; k < C.R; ++k) {
+    if (!q_named[qi * C.R + k]) continue;
+    double a = (double)C.q_alloc[qi * C.R + k];
+    double d = (double)C.q_deserved[qi * C.R + k];
+    double v = (d == 0.0) ? (a == 0.0 ? 0.0 : 1.0) : a / d;
+    if (v > s) s = v;
+  }
+  return s;
+}
+
+long long vcreclaim_drive_mq(
+    void* ctx_p, long long has_pred,
+    // queues (local slots; qs_ids maps to global queue ids)
+    const long long* qs_ids, long long n_queues,
+    const double* q_create, const int32_t* q_uid_rank,
+    const uint8_t* q_named,        // [Qn * R], global-indexed
+    long long qorder_has_prop,
+    int8_t* q_overused,            // [n_queues] memo: -1 unknown / 0 / 1
+    uint8_t* out_q_dropped,        // [n_queues]
+    // jobs + tasks (job-major across all queues)
     const long long* job_ids, long long n_jobs,
-    const long long* task_ptr,   // [n_jobs+1] CSR into task_rows
-    const long long* task_rows,  // all jobs' pending rows, job-major
-    long long* task_cursor,      // [n_jobs] consumed count (in/out)
-    const int32_t* row_maskidx,  // [P] mask-set index per row (-1 = yield)
-    // mask sets (parallel arrays of pointers)
+    const long long* job_qslot,    // [n_jobs] local queue slot per job
+    const long long* task_ptr, const long long* task_rows,
+    long long* task_cursor,
+    const int32_t* row_maskidx,
+    // mask sets (per (queue scope, profile)); mask_qids = the GLOBAL
+    // queue id whose evictable scope each set was built against
     long long n_masks,
     unsigned long long* anym_ptrs, unsigned long long* feas_ptrs,
     unsigned long long* stat_ptrs, unsigned long long* slots_ptrs,
     unsigned long long* initreq_ptrs,
-    long long* mask_cursors,     // [n_masks] in/out
+    const long long* mask_qids,
+    long long* mask_cursors,
     // outputs
     long long* out_evicted, long long* out_n_evicted, long long max_ev,
     long long* out_pipe_rows, long long* out_pipe_nodes,
     long long* out_n_pipe,
     long long* out_touched, long long* out_n_touched,
     long long max_touched,
-    long long* out_yield_job,    // job index to hand back (rc -3)
-    uint8_t* out_job_dropped     // [n_jobs] jobs that left the heap
-) {
+    long long* out_yield_job, uint8_t* out_job_dropped) {
   const VcReclaimCtx& C = *static_cast<VcReclaimCtx*>(ctx_p);
   *out_n_evicted = 0;
   *out_n_pipe = 0;
   *out_n_touched = 0;
   *out_yield_job = -1;
-  if (C.job_order_len + 1 > 8) return -4;  // VcKey/mykey buffer bound
+  if (C.job_order_len + 1 > 8) return -4;  // VcKey buffer bound
   std::vector<VcMaskSet> masks((size_t)n_masks);
   for (long long i = 0; i < n_masks; ++i) {
     masks[i].anym = (uint8_t*)anym_ptrs[i];
@@ -661,41 +705,111 @@ long long vcreclaim_drive(
     masks[i].init_req = (const float*)initreq_ptrs[i];
     masks[i].cursor = mask_cursors[i];
   }
-  auto make_key = [&](long long ji) {
+  auto make_jkey = [&](long long ji) {
     VcKey k;
-    k.len = 0;
     vc_job_key(C, job_ids[ji], k.v);
     k.len = (int)C.job_order_len + 1;
     k.jr = ji;
     return k;
   };
-  std::priority_queue<VcKey> heap;
+  auto make_qkey = [&](long long slot) {
+    VcQKey k;
+    int o = 0;
+    long long qid = qs_ids[slot];
+    if (qorder_has_prop) k.v[o++] = vc_queue_share(C, q_named, qid);
+    k.v[o++] = q_create[slot];
+    k.v[o++] = (double)q_uid_rank[slot];
+    k.len = o;
+    k.slot = slot;
+    return k;
+  };
+  // Per-queue job heaps.
+  std::vector<std::priority_queue<VcKey>> jheaps((size_t)n_queues);
   for (long long ji = 0; ji < n_jobs; ++ji)
-    heap.push(make_key(ji));
+    jheaps[(size_t)job_qslot[ji]].push(make_jkey(ji));
+  std::priority_queue<VcQKey> qheap;
+  for (long long slot = 0; slot < n_queues; ++slot)
+    qheap.push(make_qkey(slot));
+  // Mask refresh at a node for EVERY set, each against its OWN queue's
+  // evictable scope (victims exclude the reclaimer's queue, so one
+  // queue's eviction changes every other queue's sums too).
+  auto refresh_node = [&](long long n_r) {
+    for (long long mset = 0; mset < n_masks; ++mset) {
+      float ev_tmp[8];
+      bool any = vc_scope_ev(C, mask_qids[mset], n_r, ev_tmp);
+      float tot[8];
+      const float* fi_n = C.fi + n_r * C.R;
+      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_tmp[k];
+      masks[mset].anym[n_r] = any ? 1 : 0;
+      masks[mset].feas[n_r] =
+          vc_le(masks[mset].init_req, tot, C.eps, C.scalar_slot, C.R)
+              ? 1 : 0;
+      if (has_pred)
+        masks[mset].slots[n_r] =
+            (C.n_maxtasks[n_r] <= 0
+             || C.n_ntasks[n_r] < C.n_maxtasks[n_r]) ? 1 : 0;
+    }
+    if (*out_n_touched < max_touched)
+      out_touched[(*out_n_touched)++] = n_r;
+  };
   long long rc = 0;
-  while (!heap.empty()) {
-    VcKey top = heap.top();
-    heap.pop();
-    // Lazy re-derivation (the _LazyHeap stale-key re-push).
-    VcKey fresh = make_key(top.jr);
+  while (!qheap.empty()) {
+    VcQKey qtop = qheap.top();
+    qheap.pop();
+    VcQKey qfresh = make_qkey(qtop.slot);
     bool stale = false;
-    for (int i = 0; i < fresh.len; ++i)
-      if (fresh.v[i] != top.v[i]) { stale = true; break; }
-    if (stale) { heap.push(fresh); continue; }
-    long long ji = top.jr;
+    for (int i = 0; i < qfresh.len; ++i)
+      if (qfresh.v[i] != qtop.v[i]) { stale = true; break; }
+    if (stale) { qheap.push(qfresh); continue; }
+    long long slot = qtop.slot;
+    long long qid = qs_ids[slot];
+    // Overused verdict, frozen at first evaluation (the Python
+    // closure's per-pass memo).
+    if (q_overused[slot] < 0) {
+      bool over = C.q_has_deserved[qid] &&
+          !vc_le(C.q_alloc + qid * C.R, C.q_deserved + qid * C.R,
+                 C.eps, C.scalar_slot, C.R);
+      q_overused[slot] = over ? 1 : 0;
+    }
+    if (q_overused[slot]) { out_q_dropped[slot] = 1; continue; }
+    auto& jheap = jheaps[(size_t)slot];
+    // Lazy job pop (stale keys re-push).
+    long long ji = -1;
+    while (!jheap.empty()) {
+      VcKey top = jheap.top();
+      jheap.pop();
+      VcKey fresh = make_jkey(top.jr);
+      bool jstale = false;
+      for (int i = 0; i < fresh.len; ++i)
+        if (fresh.v[i] != top.v[i]) { jstale = true; break; }
+      if (jstale) { jheap.push(fresh); continue; }
+      ji = top.jr;
+      break;
+    }
+    if (ji < 0) { out_q_dropped[slot] = 1; continue; }
     long long base = task_ptr[ji];
     long long ntask = task_ptr[ji + 1] - base;
-    if (task_cursor[ji] >= ntask)
-      break;  // drained top job ends the queue's reclaim for the cycle
-              // (reclaim.go: the empty-tasks `continue` skips the queue
-              // re-push, so the queue drops out — a faithful quirk)
+    if (task_cursor[ji] >= ntask) {
+      // Drained top job kills the queue (the reclaim.go empty-tasks
+      // `continue` skips the queue re-push — a faithful quirk).
+      out_job_dropped[ji] = 1;
+      out_q_dropped[slot] = 1;
+      continue;
+    }
     long long prow = task_rows[base + task_cursor[ji]];
     int32_t mi = row_maskidx[prow];
-    if (mi < 0) { *out_yield_job = ji; rc = -3; break; }
+    if (mi < 0) {
+      // Python turn needed: heap state is reconstructed on re-entry
+      // from the dropped flags + task cursors (keys are live).
+      *out_yield_job = ji;
+      rc = -3;
+      break;
+    }
     task_cursor[ji] += 1;
     if (!vc_reclaim_possible(C, qid)) {
-      // Task consumed without a walk; the job drops from the heap.
+      // Turn consumed; job drops, queue re-enters.
       out_job_dropped[ji] = 1;
+      qheap.push(make_qkey(slot));
       continue;
     }
     VcMaskSet& M = masks[mi];
@@ -704,29 +818,10 @@ long long vcreclaim_drive(
         C, prow, qid, &M.cursor, M.anym, M.feas,
         has_pred ? M.stat : nullptr, M.slots,
         out_evicted, out_n_evicted, max_ev);
-    // anym refresh (+ dirty marks) at evict nodes for EVERY mask set.
-    for (long long i = before_ev; i < *out_n_evicted; ++i) {
-      long long n_r = C.p_node[out_evicted[i]];
-      float ev_tmp[8];
-      bool any = vc_scope_ev(C, qid, n_r, ev_tmp);
-      float tot[8];
-      const float* fi_n = C.fi + n_r * C.R;
-      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_tmp[k];
-      for (long long mset = 0; mset < n_masks; ++mset) {
-        masks[mset].anym[n_r] = any ? 1 : 0;
-        masks[mset].feas[n_r] =
-            vc_le(masks[mset].init_req, tot, C.eps, C.scalar_slot, C.R)
-                ? 1 : 0;
-      }
-      if (*out_n_touched < max_touched)
-        out_touched[(*out_n_touched)++] = n_r;
-    }
+    for (long long i = before_ev; i < *out_n_evicted; ++i)
+      refresh_node(C.p_node[out_evicted[i]]);
     if (node == -2) {
-      // Mid-walk bail: the veto already ran and evictions may have
-      // landed; the task is rewound and must resume WALK-ONLY in
-      // Python (rc -5, vs -3 whose turn starts from the veto).
-      // Unreachable while setup gates max residents <= VC_MAX_CAND,
-      // kept as a defensive exact path.
+      // Mid-walk bail: resume WALK-ONLY in Python (rc -5).
       task_cursor[ji] -= 1;
       *out_yield_job = ji;
       rc = -5;
@@ -747,42 +842,22 @@ long long vcreclaim_drive(
         C.j_cnt_pending[pj] -= 1;
         for (long long k = 0; k < C.R; ++k)
           C.j_alloc_res[pj * C.R + k] += req_r[k];
-        int32_t qi = C.q_of_job[pj];
-        if (qi >= 0) {
+        int32_t qi2 = C.q_of_job[pj];
+        if (qi2 >= 0) {
           for (long long k = 0; k < C.R; ++k)
-            C.q_alloc[qi * C.R + k] += req_r[k];
-          C.q_version[qi] += 1;
+            C.q_alloc[qi2 * C.R + k] += req_r[k];
+          C.q_version[qi2] += 1;
         }
       }
       out_pipe_rows[*out_n_pipe] = prow;
       out_pipe_nodes[*out_n_pipe] = node;
       ++*out_n_pipe;
-      // refresh feas/slots for every mask at the pipeline node
-      float ev_tmp[8];
-      bool any = vc_scope_ev(C, qid, node, ev_tmp);
-      float tot[8];
-      const float* fi_n = C.fi + node * C.R;
-      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_tmp[k];
-      for (long long mset = 0; mset < n_masks; ++mset) {
-        masks[mset].anym[node] = any ? 1 : 0;
-        masks[mset].feas[node] =
-            vc_le(masks[mset].init_req, tot, C.eps, C.scalar_slot, C.R)
-                ? 1 : 0;
-        if (has_pred)
-          masks[mset].slots[node] =
-              (C.n_maxtasks[node] <= 0
-               || C.n_ntasks[node] < C.n_maxtasks[node]) ? 1 : 0;
-      }
-      if (*out_n_touched < max_touched)
-        out_touched[(*out_n_touched)++] = node;
-      // Turn assigned: the job re-enters the heap (fresh key) —
-      // unconditionally, like the Python jobs.push(jr); a drained job
-      // popped later kills the queue (see the break above).
-      heap.push(make_key(ji));
-      continue;
+      refresh_node(node);
+      jheap.push(make_jkey(ji));  // assigned: job re-enters
+    } else {
+      out_job_dropped[ji] = 1;    // walk failed: job drops
     }
-    // Walk failed: assigned False -> the job drops from the heap.
-    out_job_dropped[ji] = 1;
+    qheap.push(make_qkey(slot));  // turn complete: queue re-enters
   }
   for (long long i = 0; i < n_masks; ++i) mask_cursors[i] = masks[i].cursor;
   return rc;

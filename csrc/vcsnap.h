@@ -48,15 +48,21 @@ long long vcreclaim_step(
     const uint8_t* slots,
     long long* out_evicted, long long* out_n_evicted,
     long long max_evicted);
-long long vcreclaim_drive(
-    void* ctx_p, long long qid, long long has_pred,
+long long vcreclaim_drive_mq(
+    void* ctx_p, long long has_pred,
+    const long long* qs_ids, long long n_queues,
+    const double* q_create, const int32_t* q_uid_rank,
+    const uint8_t* q_named, long long qorder_has_prop,
+    int8_t* q_overused, uint8_t* out_q_dropped,
     const long long* job_ids, long long n_jobs,
+    const long long* job_qslot,
     const long long* task_ptr, const long long* task_rows,
     long long* task_cursor, const int32_t* row_maskidx,
     long long n_masks,
     unsigned long long* anym_ptrs, unsigned long long* feas_ptrs,
     unsigned long long* stat_ptrs, unsigned long long* slots_ptrs,
     unsigned long long* initreq_ptrs,
+    const long long* mask_qids,
     long long* mask_cursors,
     long long* out_evicted, long long* out_n_evicted, long long max_ev,
     long long* out_pipe_rows, long long* out_pipe_nodes,

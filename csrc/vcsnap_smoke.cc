@@ -55,6 +55,127 @@ static void smoke_serializer() {
   std::printf("serializer kernels OK\n");
 }
 
+// Multi-queue drive: victims in queue 0; reclaimers split between
+// queues 1 and 2.  The cross-queue round-robin must place all six
+// reclaimers (one eviction each) and drop both queues via the
+// drained-top-job quirk.
+static void smoke_drive_mq() {
+  const long long N = 4, R = 2, P = 14, J = 14, Q = 3;
+  std::vector<long long> node_ptr = {0, 2, 4, 6, 8};
+  std::vector<long long> node_rows = {0, 1, 2, 3, 4, 5, 6, 7};
+  std::vector<int16_t> p_status(P, ST_RUNNING);
+  for (int i = 8; i < 14; ++i) p_status[i] = ST_PENDING;
+  std::vector<int32_t> p_job(P);
+  for (int i = 0; i < 14; ++i) p_job[i] = i;
+  std::vector<float> req(P * R);
+  for (int i = 0; i < 14; ++i) {
+    req[i * R + 0] = 4000.0f;
+    req[i * R + 1] = 1.0e9f;
+  }
+  std::vector<uint8_t> req_empty(P, 0), critical(P, 0);
+  std::vector<int32_t> j_minav(J, 1);
+  std::vector<int32_t> j_ready(J, 0), j_alloc(J, 0), j_run(J, 0),
+      j_rel(J, 0), j_pend(J, 0);
+  for (int i = 0; i < 8; ++i) { j_ready[i] = 1; j_alloc[i] = 1;
+                                j_run[i] = 1; }
+  for (int i = 8; i < 14; ++i) j_pend[i] = 1;
+  std::vector<float> j_alloc_res(J * R, 0.0f);
+  for (int i = 0; i < 8; ++i) {
+    j_alloc_res[i * R] = 4000.0f;
+    j_alloc_res[i * R + 1] = 1.0e9f;
+  }
+  std::vector<int32_t> q_of_job(J, 0);
+  for (int i = 8; i < 11; ++i) q_of_job[i] = 1;
+  for (int i = 11; i < 14; ++i) q_of_job[i] = 2;
+  std::vector<uint8_t> q_rec = {1, 1, 1};
+  std::vector<float> q_alloc = {32000.0f, 8.0e9f, 0.0f, 0.0f,
+                                0.0f, 0.0f};
+  std::vector<float> q_des = {0.0f, 0.0f, 1.0e12f, 1.0e12f,
+                              1.0e12f, 1.0e12f};
+  std::vector<uint8_t> q_has = {1, 1, 1};
+  std::vector<float> fi(N * R, 0.0f), n_rel(N * R, 0.0f);
+  std::vector<int32_t> tiers = {0, 1, -1, 2, -1};
+  std::vector<float> eps = {10.0f, 1.0e7f};
+  std::vector<uint8_t> scalar_slot = {0, 0};
+  std::vector<uint8_t> alive(N, 1);
+  std::vector<float> init_req = req;
+  std::vector<float> n_pip(N * R, 0.0f);
+  std::vector<int32_t> n_ntasks = {2, 2, 2, 2};
+  std::vector<int32_t> n_maxtasks = {0, 0, 0, 0};
+  std::vector<long long> pipe_node(P, -1);
+  std::vector<long long> j_wait(J, 0), j_ver(J, 0), q_ver(Q, 0);
+  std::vector<int32_t> j_prio(J, 100);
+  for (int i = 8; i < 14; ++i) j_prio[i] = 10000;
+  std::vector<int32_t> j_rank(J);
+  for (int i = 0; i < 14; ++i) j_rank[i] = i;
+  std::vector<int32_t> p_node(P, -1);
+  for (int i = 0; i < 8; ++i) p_node[i] = i / 2;
+  std::vector<float> total_res = {32000.0f, 8.0e9f};
+  std::vector<int32_t> job_order = {0, 2};
+
+  void* ctx = vcreclaim_ctx_new(
+      node_ptr.data(), node_rows.data(), p_status.data(), p_job.data(),
+      req.data(), req_empty.data(), critical.data(), j_minav.data(),
+      j_ready.data(), j_alloc.data(), j_run.data(), j_rel.data(),
+      j_alloc_res.data(), q_of_job.data(), q_rec.data(), q_alloc.data(),
+      q_des.data(), q_has.data(), fi.data(), n_rel.data(), tiers.data(),
+      (long long)tiers.size(), eps.data(), scalar_slot.data(),
+      alive.data(), init_req.data(), N, R, ST_RUNNING, ST_RELEASING,
+      n_pip.data(), n_ntasks.data(), n_maxtasks.data(), pipe_node.data(),
+      j_pend.data(), j_wait.data(), j_ver.data(), q_ver.data(), Q,
+      j_prio.data(), j_rank.data(), p_node.data(), total_res.data(),
+      job_order.data(), (long long)job_order.size(), 1);
+  assert(ctx != nullptr);
+
+  std::vector<long long> qs_ids = {1, 2};
+  std::vector<double> q_create = {1.0, 2.0};
+  std::vector<int32_t> q_uid_rank = {0, 1};
+  std::vector<uint8_t> q_named(Q * R, 1);
+  std::vector<int8_t> q_over = {-1, -1};
+  std::vector<uint8_t> q_dropped = {0, 0};
+  std::vector<long long> job_ids = {8, 9, 10, 11, 12, 13};
+  std::vector<long long> job_qslot = {0, 0, 0, 1, 1, 1};
+  std::vector<long long> task_ptr = {0, 1, 2, 3, 4, 5, 6};
+  std::vector<long long> task_rows = {8, 9, 10, 11, 12, 13};
+  std::vector<long long> task_cur(6, 0);
+  std::vector<int32_t> row_maskidx(P, 0);
+  std::vector<uint8_t> anym(N, 1), feas(N, 1), ones(N, 1),
+      slots_mask(N, 1);
+  unsigned long long anym_p[1] = {(unsigned long long)anym.data()};
+  unsigned long long feas_p[1] = {(unsigned long long)feas.data()};
+  unsigned long long stat_p[1] = {(unsigned long long)ones.data()};
+  unsigned long long slot_p[1] = {
+      (unsigned long long)slots_mask.data()};
+  std::vector<float> ireq8 = {4000.0f, 1.0e9f};
+  unsigned long long ireq_p[1] = {(unsigned long long)ireq8.data()};
+  std::vector<long long> mask_qids = {1};
+  long long mask_cur[1] = {0};
+  std::vector<long long> evicted(P), pipe_rows(P), pipe_nodes(P),
+      touched(2 * P);
+  long long n_ev = 0, n_pipe = 0, n_touch = 0, yield_job = -1;
+  std::vector<uint8_t> dropped(6, 0);
+  long long rc = vcreclaim_drive_mq(
+      ctx, 1, qs_ids.data(), 2, q_create.data(), q_uid_rank.data(),
+      q_named.data(), 1, q_over.data(), q_dropped.data(),
+      job_ids.data(), 6, job_qslot.data(),
+      task_ptr.data(), task_rows.data(), task_cur.data(),
+      row_maskidx.data(), 1, anym_p, feas_p, stat_p, slot_p, ireq_p,
+      mask_qids.data(), mask_cur, evicted.data(), &n_ev, P,
+      pipe_rows.data(), pipe_nodes.data(), &n_pipe, touched.data(),
+      &n_touch, 2 * P, &yield_job, dropped.data());
+  std::printf("drive_mq: rc=%lld evicted=%lld pipelined=%lld "
+              "qdrop=%d,%d over=%d,%d\n",
+              rc, n_ev, n_pipe, (int)q_dropped[0], (int)q_dropped[1],
+              (int)q_over[0], (int)q_over[1]);
+  assert(rc == 0);
+  assert(n_pipe == 6);   // every reclaimer placed, across both queues
+  assert(n_ev == 6);     // one victim each
+  assert(q_over[0] == 0 && q_over[1] == 0);
+  assert(q_dropped[0] == 1 && q_dropped[1] == 1);
+  vcreclaim_ctx_free(ctx);
+  std::printf("drive_mq smoke OK\n");
+}
+
 int main() {
   std::printf("vcsnap_version=%d\n", vcsnap_version());
   smoke_serializer();
@@ -147,8 +268,10 @@ int main() {
   fi[node * R + 1] -= req[8 * R + 1];
   j_pend[8] -= 1;
 
-  // ---- drive: the remaining reclaimers 9-11 drain through the C loop.
+  // ---- drive: the remaining reclaimers 9-11 drain through the C
+  // round-robin (single-queue degenerate case of the MQ driver).
   std::vector<long long> job_ids = {9, 10, 11};
+  std::vector<long long> job_qslot = {0, 0, 0};
   std::vector<long long> task_ptr = {0, 1, 2, 3};
   std::vector<long long> task_rows = {9, 10, 11};
   std::vector<long long> task_cur(3, 0);
@@ -160,14 +283,25 @@ int main() {
       (unsigned long long)slots_mask.data()};
   std::vector<float> ireq8 = {4000.0f, 1.0e9f};
   unsigned long long ireq_p[1] = {(unsigned long long)ireq8.data()};
+  std::vector<long long> qs_ids1 = {1};
+  std::vector<double> q_create1 = {1.0};
+  std::vector<int32_t> q_rank1 = {0};
+  std::vector<uint8_t> q_named1(Q * R, 1);
+  std::vector<int8_t> q_over1 = {-1};
+  std::vector<uint8_t> q_drop1 = {0};
+  std::vector<long long> mask_qids1 = {1};
   long long mask_cur[1] = {0};
   long long n_ev2 = 0, n_pipe = 0, n_touch = 0, yield_job = -1;
   std::vector<long long> pipe_rows(P), pipe_nodes(P), touched(2 * P);
   std::vector<uint8_t> dropped(3, 0);
-  long long rc = vcreclaim_drive(
-      ctx, 1, 1, job_ids.data(), 3, task_ptr.data(), task_rows.data(),
+  long long rc = vcreclaim_drive_mq(
+      ctx, 1, qs_ids1.data(), 1, q_create1.data(), q_rank1.data(),
+      q_named1.data(), 1, q_over1.data(), q_drop1.data(),
+      job_ids.data(), 3, job_qslot.data(),
+      task_ptr.data(), task_rows.data(),
       task_cur.data(), row_maskidx.data(), 1, anym_p, feas_p, stat_p,
-      slot_p, ireq_p, mask_cur, evicted.data(), &n_ev2, P,
+      slot_p, ireq_p, mask_qids1.data(), mask_cur,
+      evicted.data(), &n_ev2, P,
       pipe_rows.data(), pipe_nodes.data(), &n_pipe, touched.data(),
       &n_touch, 2 * P, &yield_job, dropped.data());
   std::printf("drive: rc=%lld evicted=%lld pipelined=%lld\n", rc, n_ev2,
@@ -176,6 +310,8 @@ int main() {
   assert(n_pipe == 3);   // all three reclaimers placed
   assert(n_ev2 == 3);    // one victim each
   vcreclaim_ctx_free(ctx);
+
+  smoke_drive_mq();
   std::printf("vcsnap smoke OK\n");
   return 0;
 }

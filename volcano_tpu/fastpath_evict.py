@@ -1397,7 +1397,7 @@ class FastEvictor:
         nat = self._native_reclaim_setup()
         try:
             if nat is None or not self._native_reclaim_drive(
-                    nat, jobs_map, tasks_map, overused):
+                    nat, jobs_map, tasks_map):
                 self._reclaim_loop(queues_pq, jobs_map, tasks_map,
                                    overused, nat)
         finally:
@@ -1733,88 +1733,145 @@ class FastEvictor:
         nat["out_n_addr"] = nat["out_n"].ctypes.data
         return nat
 
-    def _native_reclaim_drive(self, nat, jobs_map, tasks_map,
-                              overused) -> bool:
-        """Run the ENTIRE reclaim turn loop in C when exactly one queue
-        holds pending reclaimers (vcreclaim_drive: lazy job heap with
-        live keys, per-turn proportion veto, cursor node walks, pipeline
-        bookkeeping).  Tasks the C side cannot handle exactly (inter-pod
-        terms / host ports / ghost pods) yield back here, are run through
-        the exact Python turn, and the drive resumes.  Returns False to
-        fall back to the Python loop (multi-queue)."""
+    def _native_reclaim_drive(self, nat, jobs_map, tasks_map) -> bool:
+        """Run the ENTIRE reclaim round-robin in C — any number of
+        pending queues (vcreclaim_drive_mq: a lazy QUEUE heap with live
+        share/create/uid keys over per-queue lazy job heaps, the
+        per-turn proportion veto, overused verdicts frozen at first
+        evaluation, cursor node walks, pipeline bookkeeping).  Tasks the
+        C side cannot handle exactly (inter-pod terms / host ports /
+        ghost pods) yield back here, are run through the exact Python
+        turn, and the drive resumes.  Returns False to fall back to the
+        Python loop."""
         c = self.cyc
         st = self.st
         m = c.m
         live = [(q, h) for q, h in jobs_map.items() if not h.empty()]
-        if len(live) != 1:
-            return False
-        qname, jobs_heap = live[0]
-        qid = c.queue_index.get(qname, -1)
-        if qid < 0:
-            return False
-        if overused(c.store.queues[qname]):
-            return True  # the queue is skipped wholesale
+        if not live:
+            return True
         has_pred = c._has("predicates")
         pods = c.store.pods
-        scope = ("rq", qname)
-        active = [it for (_k, it) in jobs_heap.h]
         lib = nat["lib"]
+        if not hasattr(lib, "vcreclaim_drive_mq"):
+            return False
+        # Queue-key components (the share component is derived live in
+        # C; creation/uid tie-breaks are static per pass).
+        has_prop_order = c._has("proportion") and any(
+            opt.name == "proportion"
+            for opt in c._tier_opts("enabled_queue_order")
+        )
+        # Deserved-NAMED slots per global queue (cpu/memory always;
+        # scalars the deserved dict carries, zero-valued included) —
+        # _queue_share iterates exactly these.
+        q_named = np.zeros((max(c.Qn, 1), c.R), np.uint8)
+        for qi, res in c.q_deserved_res.items():
+            q_named[qi, 0] = q_named[qi, 1] = 1
+            if res.scalars:
+                for name in res.scalars:
+                    idx = m.scalar_slots.index.get(name)
+                    if idx is not None:
+                        q_named[qi, 2 + idx] = 1
+        # Per-queue active job lists + overused memo (persists across
+        # yield re-entries, mirroring the Python closure's per-pass
+        # cache).
+        active_by_q: Dict[str, List[int]] = {
+            q: [it for (_k, it) in h.h] for q, h in live
+        }
+        over_memo: Dict[str, int] = {}
         n_yields = 0
         while True:
+            qnames = [q for q in active_by_q
+                      if active_by_q[q] and c.queue_index.get(q, -1) >= 0]
+            if not qnames:
+                for _q, h in live:
+                    h.h.clear()
+                return True
+            qids = np.asarray(
+                [c.queue_index[q] for q in qnames], np.int64
+            )
+            q_create = np.asarray(
+                [c.store.queues[q].queue.creation_timestamp
+                 for q in qnames], np.float64,
+            )
+            uid_order = sorted(
+                range(len(qnames)),
+                key=lambda i: c.store.queues[qnames[i]].uid,
+            )
+            q_rank = np.empty(len(qnames), np.int32)
+            for rk, i in enumerate(uid_order):
+                q_rank[i] = rk
+            q_over = np.asarray(
+                [over_memo.get(q, -1) for q in qnames], np.int8
+            )
+            q_dropped = np.zeros(len(qnames), np.uint8)
+
             task_ptr = [0]
             flat: List[int] = []
-            for jr in active:
-                flat.extend(tasks_map.get(jr, []))
-                task_ptr.append(len(flat))
+            job_list: List[int] = []
+            job_qslot: List[int] = []
+            for slot, q in enumerate(qnames):
+                for jr in active_by_q[q]:
+                    job_list.append(jr)
+                    job_qslot.append(slot)
+                    flat.extend(tasks_map.get(jr, []))
+                    task_ptr.append(len(flat))
             if not flat:
+                for _q, h in live:
+                    h.h.clear()
                 return True
             if n_yields and n_yields * 4 > len(flat):
                 # Many yielding (port/inter-pod/ghost) reclaimers: each
                 # yield re-registers O(pending) state, so the Python
                 # loop's linear walk is cheaper past this ratio.
                 return False
-            ev = self._evictable_for(scope)
             row_maskidx = np.full(c.Pn, -1, np.int32)
             regs: List[dict] = []
             seen_prof: Dict[tuple, int] = {}
-            for r in flat:
-                feat = m.p_feat[r]
-                if feat.ports or feat.ip_req_aff or feat.ip_req_anti:
-                    continue
-                if has_pred and pods.get(m.p_uid[r]) is None:
-                    continue
-                key = (int(m.p_prof[r]), st.init_req[r].tobytes())
-                mi = seen_prof.get(key)
-                if mi is None:
-                    init_req = st.init_req[r]
-                    self._prefilter(scope, init_req, ev)
-                    static = None
-                    if has_pred:
-                        static = self._profile_static.get(key[0])
-                        if static is None:
-                            static = self._static_mask(feat)
-                            self._profile_static[key[0]] = static
-                    slots = self._slots_mask
-                    if slots is None and has_pred:
-                        slots = self._slots_mask = (
-                            (c.n_maxtasks <= 0)
-                            | (c.n_ntasks < c.n_maxtasks)
-                        )
-                    wkey = (scope, key[1], key[0])
-                    mi = len(regs)
-                    seen_prof[key] = mi
-                    regs.append({
-                        "wkey": wkey,
-                        "anym": self._ev_any[scope],
-                        "feas": self._ev_feas[(scope, key[1])][1],
-                        "static": static if static is not None
-                        else nat["ones"],
-                        "slots": slots if slots is not None
-                        else nat["ones"],
-                        "init_req": np.ascontiguousarray(
-                            init_req, np.float32),
-                    })
-                row_maskidx[r] = mi
+            for slot, q in enumerate(qnames):
+                scope = ("rq", q)
+                ev = self._evictable_for(scope)
+                qid_g = int(qids[slot])
+                for jr in active_by_q[q]:
+                    for r in tasks_map.get(jr, ()):
+                        feat = m.p_feat[r]
+                        if feat.ports or feat.ip_req_aff or feat.ip_req_anti:
+                            continue
+                        if has_pred and pods.get(m.p_uid[r]) is None:
+                            continue
+                        key = (q, int(m.p_prof[r]),
+                               st.init_req[r].tobytes())
+                        mi = seen_prof.get(key)
+                        if mi is None:
+                            init_req = st.init_req[r]
+                            self._prefilter(scope, init_req, ev)
+                            static = None
+                            if has_pred:
+                                static = self._profile_static.get(key[1])
+                                if static is None:
+                                    static = self._static_mask(feat)
+                                    self._profile_static[key[1]] = static
+                            slots = self._slots_mask
+                            if slots is None and has_pred:
+                                slots = self._slots_mask = (
+                                    (c.n_maxtasks <= 0)
+                                    | (c.n_ntasks < c.n_maxtasks)
+                                )
+                            wkey = (scope, key[2], key[1])
+                            mi = len(regs)
+                            seen_prof[key] = mi
+                            regs.append({
+                                "wkey": wkey,
+                                "qid": qid_g,
+                                "anym": self._ev_any[scope],
+                                "feas": self._ev_feas[(scope, key[2])][1],
+                                "static": static if static is not None
+                                else nat["ones"],
+                                "slots": slots if slots is not None
+                                else nat["ones"],
+                                "init_req": np.ascontiguousarray(
+                                    init_req, np.float32),
+                            })
+                        row_maskidx[r] = mi
             M = len(regs)
             d = lambda a: a.ctypes.data
             anym_p = np.asarray([d(g["anym"]) for g in regs], np.uint64)
@@ -1828,19 +1885,26 @@ class FastEvictor:
                 [self._walk_cursor.get(g["wkey"], 0) for g in regs],
                 np.int64,
             )
-            job_arr = np.asarray(active, np.int64)
+            mask_qid = np.asarray([g["qid"] for g in regs], np.int64)
+            job_arr = np.asarray(job_list, np.int64)
+            jq_arr = np.asarray(job_qslot, np.int64)
             ptr_arr = np.asarray(task_ptr, np.int64)
             flat_arr = np.asarray(flat, np.int64)
-            task_cur = np.zeros(len(active), np.int64)
-            j_dropped = np.zeros(max(len(active), 1), np.uint8)
+            task_cur = np.zeros(max(len(job_list), 1), np.int64)
+            j_dropped = np.zeros(max(len(job_list), 1), np.uint8)
             yield_job = np.zeros(1, np.int64)
             out_n_ev = nat["out_n"]
             out_n_ev[0] = 0
             nat["out_n_pipe"][0] = 0
             nat["out_n_touched"][0] = 0
-            rc = lib.vcreclaim_drive(
-                nat["ctx"], qid, 1 if has_pred else 0,
-                job_arr.ctypes.data, len(active),
+            rc = lib.vcreclaim_drive_mq(
+                nat["ctx"], 1 if has_pred else 0,
+                qids.ctypes.data, len(qnames),
+                q_create.ctypes.data, q_rank.ctypes.data,
+                q_named.ctypes.data, 1 if has_prop_order else 0,
+                q_over.ctypes.data, q_dropped.ctypes.data,
+                job_arr.ctypes.data, len(job_list),
+                jq_arr.ctypes.data,
                 ptr_arr.ctypes.data, flat_arr.ctypes.data,
                 task_cur.ctypes.data,
                 row_maskidx.ctypes.data,
@@ -1848,6 +1912,7 @@ class FastEvictor:
                 anym_p.ctypes.data, feas_p.ctypes.data,
                 stat_p.ctypes.data, slot_p.ctypes.data,
                 ireq_p.ctypes.data,
+                mask_qid.ctypes.data,
                 mask_cur.ctypes.data,
                 nat["out_addr"], out_n_ev.ctypes.data,
                 len(nat["out_rows"]),
@@ -1887,16 +1952,25 @@ class FastEvictor:
                     int(x) for x in nat["out_touched"][:n_t].tolist())
             for g, cur in zip(regs, mask_cur.tolist()):
                 self._walk_cursor[g["wkey"]] = int(cur)
-            for i, jr in enumerate(active):
+            for i, jr in enumerate(job_list):
                 k = int(task_cur[i])
                 if k:
                     del tasks_map[jr][:k]
+            # Persist overused verdicts + dropped queues across
+            # re-entries (the Python closure's per-pass memo / the
+            # missing queue re-push).
+            for slot, q in enumerate(qnames):
+                if q_over[slot] >= 0:
+                    over_memo[q] = int(q_over[slot])
+                if q_dropped[slot]:
+                    active_by_q[q] = []
             if rc == -4:
                 # Key buffer bound exceeded (very long job-order config):
                 # nothing was mutated — use the Python loop.
                 return False
             if rc == 0:
-                jobs_heap.h.clear()
+                for _q, h in live:
+                    h.h.clear()
                 return True
             # rc == -3: one exact Python turn for the yielded job.
             # rc == -5: the turn's veto already ran in C and the walk
@@ -1904,18 +1978,21 @@ class FastEvictor:
             # here could diverge after the turn's partial evictions).
             n_yields += 1
             ji = int(yield_job[0])
-            jr_y = active[ji]
-            keep = self._drive_python_turn(jr_y, tasks_map, qname,
+            jr_y = job_list[ji]
+            q_y = qnames[job_qslot[ji]]
+            keep = self._drive_python_turn(jr_y, tasks_map, q_y,
                                            walk_only=(rc == -5))
-            active = [
-                j for j, dr in zip(active, j_dropped[:len(active)])
-                if not dr and j != jr_y
-            ]
+            dropped_set = {
+                jr for jr, dr in zip(job_list, j_dropped[:len(job_list)])
+                if dr
+            }
+            for q in qnames:
+                active_by_q[q] = [
+                    jr for jr in active_by_q[q]
+                    if jr not in dropped_set and jr != jr_y
+                ]
             if keep:
-                active.append(jr_y)
-            if not active:
-                jobs_heap.h.clear()
-                return True
+                active_by_q[q_y].append(jr_y)
 
     def _drive_python_turn(self, jr: int, tasks_map, qname: str,
                            walk_only: bool = False) -> bool:

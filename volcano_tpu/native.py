@@ -73,14 +73,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         vp, vp, vp, vp,  # anym feas stat slots
         vp, vp, ll,  # out_evicted out_n max
     ]
-    lib.vcreclaim_drive.restype = ll
-    lib.vcreclaim_drive.argtypes = [
-        vp, ll, ll,  # ctx qid has_pred
-        vp, ll,  # job_ids n_jobs
+    lib.vcreclaim_drive_mq.restype = ll
+    lib.vcreclaim_drive_mq.argtypes = [
+        vp, ll,  # ctx has_pred
+        vp, ll,  # qs_ids n_queues
+        vp, vp, vp, ll,  # q_create q_uid_rank q_named has_prop
+        vp, vp,  # q_overused out_q_dropped
+        vp, ll, vp,  # job_ids n_jobs job_qslot
         vp, vp, vp,  # task_ptr task_rows task_cursor
         vp,  # row_maskidx
         ll,  # n_masks
         vp, vp, vp, vp, vp,  # anym feas stat slots initreq ptr arrays
+        vp,  # mask_qids
         vp,  # mask_cursors
         vp, vp, ll,  # out_evicted out_n max_ev
         vp, vp, vp,  # out_pipe_rows out_pipe_nodes out_n_pipe
