@@ -732,15 +732,35 @@ long long vcreclaim_drive_mq(
     qheap.push(make_qkey(slot));
   // Mask refresh at a node for EVERY set, each against its OWN queue's
   // evictable scope (victims exclude the reclaimer's queue, so one
-  // queue's eviction changes every other queue's sums too).
+  // queue's eviction changes every other queue's sums too).  The
+  // node-resident scan depends only on the set's queue, so it runs
+  // once per DISTINCT queue, not once per (queue, profile) set.
   auto refresh_node = [&](long long n_r) {
+    std::vector<long long> seen_q;
+    std::vector<float> ev_by_q;
+    std::vector<uint8_t> any_by_q;
+    seen_q.reserve((size_t)n_queues);
+    ev_by_q.reserve((size_t)n_queues * 8);
+    any_by_q.reserve((size_t)n_queues);
+    const float* fi_n = C.fi + n_r * C.R;
     for (long long mset = 0; mset < n_masks; ++mset) {
-      float ev_tmp[8];
-      bool any = vc_scope_ev(C, mask_qids[mset], n_r, ev_tmp);
+      long long qy = mask_qids[mset];
+      long long qslot = -1;
+      for (size_t s = 0; s < seen_q.size(); ++s)
+        if (seen_q[s] == qy) { qslot = (long long)s; break; }
+      if (qslot < 0) {
+        qslot = (long long)seen_q.size();
+        seen_q.push_back(qy);
+        float ev_tmp[8];
+        bool any = vc_scope_ev(C, qy, n_r, ev_tmp);
+        any_by_q.push_back(any ? 1 : 0);
+        for (long long k = 0; k < 8; ++k)
+          ev_by_q.push_back(k < C.R ? ev_tmp[k] : 0.0f);
+      }
+      const float* ev_q = ev_by_q.data() + qslot * 8;
       float tot[8];
-      const float* fi_n = C.fi + n_r * C.R;
-      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_tmp[k];
-      masks[mset].anym[n_r] = any ? 1 : 0;
+      for (long long k = 0; k < C.R; ++k) tot[k] = fi_n[k] + ev_q[k];
+      masks[mset].anym[n_r] = any_by_q[(size_t)qslot];
       masks[mset].feas[n_r] =
           vc_le(masks[mset].init_req, tot, C.eps, C.scalar_slot, C.R)
               ? 1 : 0;

@@ -1398,6 +1398,16 @@ class FastEvictor:
         try:
             if nat is None or not self._native_reclaim_drive(
                     nat, jobs_map, tasks_map):
+                seed = self.__dict__.pop("_reclaim_over_seed", None)
+                if seed:
+                    # Verdicts the C drive already froze stay frozen in
+                    # the fallback (first-evaluation semantics span the
+                    # whole pass).
+                    base_overused = overused
+
+                    def overused(qinfo, _b=base_overused, _s=seed):
+                        v = _s.get(qinfo.name)
+                        return bool(v) if v is not None else _b(qinfo)
                 self._reclaim_loop(queues_pq, jobs_map, tasks_map,
                                    overused, nat)
         finally:
@@ -1752,8 +1762,6 @@ class FastEvictor:
         has_pred = c._has("predicates")
         pods = c.store.pods
         lib = nat["lib"]
-        if not hasattr(lib, "vcreclaim_drive_mq"):
-            return False
         # Queue-key components (the share component is derived live in
         # C; creation/uid tie-breaks are static per pass).
         has_prop_order = c._has("proportion") and any(
@@ -1823,6 +1831,18 @@ class FastEvictor:
                 # Many yielding (port/inter-pod/ghost) reclaimers: each
                 # yield re-registers O(pending) state, so the Python
                 # loop's linear walk is cheaper past this ratio.
+                # Evictions/pipelines already landed, so the fallback
+                # loop must see the drive's CURRENT state: rebuild the
+                # job heaps minus dropped/consumed jobs (an emptied heap
+                # drops the queue on pop, the round-robin's own drop
+                # path) and hand the frozen overused verdicts to the
+                # caller — re-evaluating them at post-eviction state
+                # would diverge from the object path.
+                for q, h in live:
+                    h.h.clear()
+                    for jr in active_by_q.get(q, ()):
+                        h.push(jr)
+                self._reclaim_over_seed = dict(over_memo)
                 return False
             row_maskidx = np.full(c.Pn, -1, np.int32)
             regs: List[dict] = []
