@@ -735,13 +735,18 @@ long long vcreclaim_drive_mq(
   // queue's eviction changes every other queue's sums too).  The
   // node-resident scan depends only on the set's queue, so it runs
   // once per DISTINCT queue, not once per (queue, profile) set.
+  // Scratch hoisted out of the per-node lambda: zero steady-state
+  // allocations in the hot refresh.
+  std::vector<long long> seen_q;
+  std::vector<float> ev_by_q;
+  std::vector<uint8_t> any_by_q;
+  seen_q.reserve((size_t)n_queues);
+  ev_by_q.reserve((size_t)n_queues * 8);
+  any_by_q.reserve((size_t)n_queues);
   auto refresh_node = [&](long long n_r) {
-    std::vector<long long> seen_q;
-    std::vector<float> ev_by_q;
-    std::vector<uint8_t> any_by_q;
-    seen_q.reserve((size_t)n_queues);
-    ev_by_q.reserve((size_t)n_queues * 8);
-    any_by_q.reserve((size_t)n_queues);
+    seen_q.clear();
+    ev_by_q.clear();
+    any_by_q.clear();
     const float* fi_n = C.fi + n_r * C.R;
     for (long long mset = 0; mset < n_masks; ++mset) {
       long long qy = mask_qids[mset];

@@ -1,0 +1,117 @@
+"""Periodic scheduler-loop behaviors.
+
+The cycle itself is covered everywhere; these tests pin the LOOP's
+contracts: GC suspension during cycles with the periodic full collect
+between them, the leadership gate skipping cycles (and clearing stale
+failure counts), and failure counting driving healthz.
+"""
+
+import gc
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+
+def small_store():
+    return synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+
+
+def test_gc_suspended_during_cycle_and_restored_after():
+    seen = {"during": None}
+    store = small_store()
+    sched = Scheduler(store)
+    orig = sched._run_once_inner
+
+    def probe():
+        seen["during"] = gc.isenabled()
+        return orig()
+
+    sched._run_once_inner = probe
+    assert gc.isenabled()
+    sched.run_once()
+    assert seen["during"] is False  # suspended inside the cycle
+    assert gc.isenabled()           # restored after
+
+
+def test_gc_stays_disabled_if_caller_disabled_it():
+    """run_once must not re-enable GC behind a caller that turned it
+    off deliberately (e.g. a benchmark harness)."""
+    store = small_store()
+    sched = Scheduler(store)
+    gc.disable()
+    try:
+        sched.run_once()
+        assert not gc.isenabled()
+    finally:
+        gc.enable()
+
+
+def test_loop_runs_full_collect_every_n_cycles(monkeypatch):
+    collects = {"full": 0}
+    real_collect = gc.collect
+
+    def counting(generation=2):
+        if generation == 2:
+            collects["full"] += 1
+        return real_collect(generation)
+
+    monkeypatch.setattr(gc, "collect", counting)
+    monkeypatch.setattr(Scheduler, "GC_FULL_EVERY", 3)
+    store = small_store()
+    sched = Scheduler(store, schedule_period=0.01)
+    sched.run()
+    try:
+        deadline = time.time() + 5.0
+        while collects["full"] < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        sched.stop()
+    assert collects["full"] >= 2, "periodic full collect never ran"
+
+
+def test_leadership_gate_skips_cycles_and_clears_failures():
+    store = small_store()
+    leading = threading.Event()
+    sched = Scheduler(store, schedule_period=0.01,
+                      gate=leading.is_set)
+    # Simulate prior leader-era failures: standing by must clear them
+    # (a standby's health check must not stay red).
+    sched._consecutive_failures = sched.UNHEALTHY_AFTER
+    assert not sched.healthy()
+    sched.run()
+    try:
+        time.sleep(0.1)
+        assert len(store.binder.binds) == 0  # no cycles while standby
+        assert sched.healthy()               # failures cleared
+        leading.set()
+        deadline = time.time() + 5.0
+        while len(store.binder.binds) < 8 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        sched.stop()
+    assert len(store.binder.binds) == 8
+
+
+def test_repeated_failures_flip_healthz(monkeypatch):
+    store = small_store()
+    sched = Scheduler(store, schedule_period=0.01)
+
+    def boom():
+        raise RuntimeError("cycle exploded")
+
+    sched.run_once = boom
+    assert sched.healthy()
+    sched.run()
+    try:
+        deadline = time.time() + 5.0
+        while sched.healthy() and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        sched.stop()
+    assert not sched.healthy()
