@@ -373,6 +373,10 @@ def _solve_wave(
             p_t_matches = esl(prof.t_matches[pids])
             p_t_soft = esl(prof.t_soft[pids])
             t_matches_w = p_t_matches[pid_l]  # [W, EW]
+            # Terms some wave profile REQUIRES (affinity or anti): the
+            # conflict machinery and the dirty tracking both key off
+            # this set (soft-only spread terms never feed either).
+            term_req_w = jnp.any(p_t_req_aff | p_t_req_anti, axis=0)
 
 
         # ---- static predicate masks, hoisted out of the attempt loop ----
@@ -837,35 +841,114 @@ def _solve_wave(
                         # its per-term min (gt) the earliest giver in any
                         # domain.
                         jidx = jnp.arange(W, dtype=jnp.int32)
-                        gmask = gives & live[:, None]  # [W, EW]
+                        # Only REQUIRED terms' givers feed the conflict
+                        # reads (anti_inv / uses_selfok mask every
+                        # consumer), so soft-only spread terms drop out
+                        # of the scatter key space — exact.
+                        gmask = (gives & live[:, None]
+                                 & term_req_w[None, :])  # [W, EW]
+                        grow = jnp.any(gmask, axis=1)  # [W]
                         keyv = (
                             term_arange[None, :] * D + jnp.maximum(dw, 0)
                         )
                         scratch = EW * D
-                        keys_g = jnp.where(gmask, keyv, scratch)
-                        gm = (
-                            jnp.full((EW * D + 1,), W, jnp.int32)
-                            .at[keys_g.reshape(-1)]
-                            .min(jnp.broadcast_to(
-                                jidx[:, None], (W, EW)
-                            ).reshape(-1))
-                        )
-                        gm_my = gm[keyv]  # [W, EW] earliest in my domain
-                        conflict_anti = jnp.any(
-                            anti_inv & (gm_my < jidx[:, None]), axis=1
+                        GCAP = min(256, W)
+
+                        # TPU scatters serialize per update: the full
+                        # [W, EW] key scatter costs ~2 ms/sub-round at
+                        # the north-star shape.  Giver rows are few, so
+                        # compact to the earliest <=GCAP of them (min
+                        # over a superset of rows with no giver entries
+                        # is unchanged); overflow falls back exactly.
+                        def _gm_full(_):
+                            keys_g = jnp.where(gmask, keyv, scratch)
+                            return (
+                                jnp.full((EW * D + 1,), W, jnp.int32)
+                                .at[keys_g.reshape(-1)]
+                                .min(jnp.broadcast_to(
+                                    jidx[:, None], (W, EW)
+                                ).reshape(-1))
+                            )
+
+                        def _gm_compact(_):
+                            # top_k on the descending-index score picks
+                            # the smallest giver indices first.
+                            score = jnp.where(grow, W - jidx, 0)
+                            sc, gidx = jax.lax.top_k(score, GCAP)
+                            gvalid = sc > 0
+                            keys_c = jnp.where(
+                                gmask[gidx] & gvalid[:, None],
+                                keyv[gidx], scratch,
+                            )
+                            return (
+                                jnp.full((EW * D + 1,), W, jnp.int32)
+                                .at[keys_c.reshape(-1)]
+                                .min(jnp.broadcast_to(
+                                    jidx[gidx][:, None], (GCAP, EW)
+                                ).reshape(-1))
+                            )
+
+                        gm = jax.lax.cond(
+                            jnp.sum(grow) > GCAP, _gm_full, _gm_compact,
+                            None,
                         )
                         gt = gm[:EW * D].reshape(EW, D).min(axis=1)
-                        # Domain-less nodes (dw < 0) have no "my domain":
-                        # a selfok user there conflicts with ANY earlier
-                        # giver (the committed count kills its selfok on
-                        # the next attempt, as the sequential walk would).
-                        gm_my_self = jnp.where(dw >= 0, gm_my, W)
-                        conflict_self = jnp.any(
-                            uses_selfok
-                            & (gt[None, :] < jidx[:, None])
-                            & (gm_my_self > gt[None, :]), axis=1
+
+                        # Conflict reads compacted the same way: only
+                        # rows carrying anti/selfok terms consult gm,
+                        # so gather gm at <=GCAP involved rows instead
+                        # of the full [W, EW] element gather.
+                        inv_rows = jnp.any(anti_inv | uses_selfok,
+                                           axis=1)  # [W]
+
+                        def _conf_full(_):
+                            gm_my = gm[keyv]  # [W, EW]
+                            c_anti = jnp.any(
+                                anti_inv & (gm_my < jidx[:, None]),
+                                axis=1,
+                            )
+                            gm_my_self = jnp.where(dw >= 0, gm_my, W)
+                            c_self = jnp.any(
+                                uses_selfok
+                                & (gt[None, :] < jidx[:, None])
+                                & (gm_my_self > gt[None, :]), axis=1,
+                            )
+                            return c_anti | c_self
+
+                        def _conf_compact(_):
+                            score = jnp.where(inv_rows, W - jidx, 0)
+                            sc, ci = jax.lax.top_k(score, GCAP)
+                            cvalid = sc > 0
+                            gm_my_c = gm[keyv[ci]]  # [GCAP, EW]
+                            ji_c = jidx[ci]
+                            c_anti = jnp.any(
+                                anti_inv[ci]
+                                & (gm_my_c < ji_c[:, None]), axis=1,
+                            )
+                            gm_self_c = jnp.where(dw[ci] >= 0, gm_my_c,
+                                                  W)
+                            c_self = jnp.any(
+                                uses_selfok[ci]
+                                & (gt[None, :] < ji_c[:, None])
+                                & (gm_self_c > gt[None, :]), axis=1,
+                            )
+                            return (
+                                jnp.zeros((W,), bool)
+                                .at[ci]
+                                .set((c_anti | c_self) & cvalid)
+                            )
+
+                        # Domain-less nodes (dw < 0) have no "my
+                        # domain": a selfok user there conflicts with
+                        # ANY earlier giver (the committed count kills
+                        # its selfok on the next attempt, as the
+                        # sequential walk would) — gm_my_self = W keeps
+                        # that rule in both branches.
+                        conflict = jax.lax.cond(
+                            jnp.sum(inv_rows) > GCAP,
+                            _conf_full, _conf_compact, None,
                         )
-                        return out & ~(conflict_anti | conflict_self)
+                        return out & ~conflict
 
                     # The filter only modifies bits of tasks that carry
                     # required terms: with none of them in `clean` it is
@@ -967,11 +1050,8 @@ def _solve_wave(
                 pipelined_w_ = jnp.where(acc_pipe, choice, pipelined_w_)
                 resolved = acc_alloc | acc_pipe
                 if has_aff:
-                    term_required = jnp.any(
-                        p_t_req_aff | p_t_req_anti, axis=0
-                    )  # [EW]
                     giver_rel = jnp.any(
-                        t_matches_w & term_required[None, :], axis=1
+                        t_matches_w & term_req_w[None, :], axis=1
                     )
                     dirty_next = jnp.any(
                         resolved & (involved_any_t | giver_rel)
