@@ -304,16 +304,20 @@ def _solve_wave(
     # pass.  Built once per solve; trace-static size gate.
     dom_mm = has_aff and (D * N * 4 <= DOM_MM_MAX_MB * 1_000_000)
     if dom_mm:
+        # Stored [N, D] (node-major): contractions read it transposed
+        # for free via dot_general, while the sub-round filter can
+        # ROW-gather the choice nodes' membership (contiguous rows)
+        # instead of multiplying against all N columns.
         K_keys = aff.node_dom.shape[1]
-        dom_oh = jnp.zeros((D, N), f32)
+        dom_ohT = jnp.zeros((N, D), f32)
         for k in range(K_keys):
             nd_k = aff.node_dom[:, k]  # [N] domain id or -1
-            dom_oh = dom_oh.at[
-                jnp.where(nd_k >= 0, nd_k, D), jnp.arange(N)
+            dom_ohT = dom_ohT.at[
+                jnp.arange(N), jnp.where(nd_k >= 0, nd_k, D)
             ].max(jnp.where(nd_k >= 0, 1.0, 0.0),
                   mode="drop")
     else:
-        dom_oh = None
+        dom_ohT = None
 
     def run_wave(w, state: GState) -> GState:
         off = w * W
@@ -460,7 +464,10 @@ def _solve_wave(
                         # One MXU pass replaces the [N, EW] serialized
                         # gather (21 ms/attempt at 10k x 100k).  f32 is
                         # exact: integer counts, one product per output.
-                        cv = jnp.matmul(cnt.astype(f32), dom_oh).T
+                        cv = jax.lax.dot_general(
+                            cnt.astype(f32), dom_ohT,
+                            (((1,), (1,)), ((), ())),
+                        ).T
                     else:
                         cv = cnt[
                             term_arange[None, :],
@@ -794,12 +801,14 @@ def _solve_wave(
                         cnt_live = cwa + cwp  # [EW, D]
                         total_live = jnp.sum(cnt_live, axis=-1)  # [EW]
                         if dom_mm:
-                            # MXU pass + row gather instead of the
-                            # [W, EW] serialized element gather (see
-                            # _aff_parts).
-                            cval_t = jnp.matmul(
-                                cnt_live.astype(f32), dom_oh
-                            ).T[choice]
+                            # Row-gather the choice nodes' membership
+                            # (contiguous [W, D] rows), then one small
+                            # MXU pass — 8x fewer FLOPs than
+                            # multiplying against all N columns.
+                            cval_t = jax.lax.dot_general(
+                                cnt_live.astype(f32), dom_ohT[choice],
+                                (((1,), (1,)), ((), ())),
+                            ).T  # [W, EW]
                         else:
                             cval_t = cnt_live[
                                 term_arange[None, :], jnp.maximum(dw, 0)
@@ -854,6 +863,15 @@ def _solve_wave(
                         scratch = EW * D
                         GCAP = min(256, W)
 
+                        def _earliest_rows(mask):
+                            """Indices of the earliest <=GCAP rows in
+                            ``mask`` (+ validity): top_k on the
+                            descending-index score picks the smallest
+                            indices first."""
+                            score = jnp.where(mask, W - jidx, 0)
+                            sc, idx_ = jax.lax.top_k(score, GCAP)
+                            return idx_, sc > 0
+
                         # TPU scatters serialize per update: the full
                         # [W, EW] key scatter costs ~2 ms/sub-round at
                         # the north-star shape.  Giver rows are few, so
@@ -871,11 +889,7 @@ def _solve_wave(
                             )
 
                         def _gm_compact(_):
-                            # top_k on the descending-index score picks
-                            # the smallest giver indices first.
-                            score = jnp.where(grow, W - jidx, 0)
-                            sc, gidx = jax.lax.top_k(score, GCAP)
-                            gvalid = sc > 0
+                            gidx, gvalid = _earliest_rows(grow)
                             keys_c = jnp.where(
                                 gmask[gidx] & gvalid[:, None],
                                 keyv[gidx], scratch,
@@ -892,14 +906,25 @@ def _solve_wave(
                             jnp.sum(grow) > GCAP, _gm_full, _gm_compact,
                             None,
                         )
-                        gt = gm[:EW * D].reshape(EW, D).min(axis=1)
+                        # Earliest giver of each term in ANY domain:
+                        # directly from the giver rows — identical to
+                        # min-reducing gm over the [EW, D] key space,
+                        # without touching the 1.28M-entry buffer.
+                        jb = jnp.broadcast_to(jidx[:, None], (W, EW))
+                        gt = jnp.min(jnp.where(gmask, jb, W), axis=0)
 
                         # Conflict reads compacted the same way: only
                         # rows carrying anti/selfok terms consult gm,
                         # so gather gm at <=GCAP involved rows instead
                         # of the full [W, EW] element gather.
-                        inv_rows = jnp.any(anti_inv | uses_selfok,
-                                           axis=1)  # [W]
+                        # live-masked (like gmask): conflict is only
+                        # consumed as `out & ~conflict` and out is
+                        # already false for non-live rows, so dead
+                        # involved rows must not inflate the count past
+                        # the compaction cap.
+                        inv_rows = live & jnp.any(
+                            anti_inv | uses_selfok, axis=1
+                        )  # [W]
 
                         def _conf_full(_):
                             gm_my = gm[keyv]  # [W, EW]
@@ -916,9 +941,7 @@ def _solve_wave(
                             return c_anti | c_self
 
                         def _conf_compact(_):
-                            score = jnp.where(inv_rows, W - jidx, 0)
-                            sc, ci = jax.lax.top_k(score, GCAP)
-                            cvalid = sc > 0
+                            ci, cvalid = _earliest_rows(inv_rows)
                             gm_my_c = gm[keyv[ci]]  # [GCAP, EW]
                             ji_c = jidx[ci]
                             c_anti = jnp.any(
