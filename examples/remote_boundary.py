@@ -1,0 +1,80 @@
+"""Demo: scheduler side effects crossing a process boundary.
+
+The reference scheduler's binds, evictions, and PodGroup status writes
+are API-server RPCs; this framework keeps that boundary pluggable.
+This demo runs the remote side-effect service (normally
+``python -m volcano_tpu.cache.remote --port 18476`` in its own process
+or pod) and a scheduler wired to it with all three drop-ins — then
+submits a gang job and shows the binds and status landing remotely.
+
+Production equivalent:
+
+    # terminal 1 — the control-plane process
+    python -m volcano_tpu.cache.remote --port 18476
+    # terminal 2 — the scheduler
+    vtpu-service --remote-binder http://127.0.0.1:18476 \
+                 --remote-evictor http://127.0.0.1:18476 \
+                 --remote-status-updater http://127.0.0.1:18476
+
+Failure semantics match the reference: failed bind batches re-enter
+Pending with exponential backoff (errTasks), failed evictions revert
+the victim to Running for the next cycle, and failed status batches
+re-mark their PodGroups dirty so the next close rewrites them.
+
+Run:  python examples/remote_boundary.py
+"""
+
+import threading
+import time
+
+from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.cache.remote import (
+    HttpBinder,
+    HttpEvictor,
+    HttpStatusUpdater,
+    RemoteBindService,
+)
+from volcano_tpu.scheduler import Scheduler
+
+
+def main() -> None:
+    # The "control plane" (its own OS process in production).
+    svc = RemoteBindService(port=0)
+    threading.Thread(target=svc.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{svc.port}"
+    print(f"remote side-effect service on {url}")
+
+    store = ClusterStore()
+    store.binder = HttpBinder(url)
+    store.evictor = HttpEvictor(url)
+    store.status_updater = HttpStatusUpdater(url)
+    store.async_bind = True
+
+    for i in range(3):
+        store.add_node(Node(name=f"n{i}",
+                            allocatable={"cpu": "8", "memory": "16Gi"}))
+    store.add_pod_group(PodGroup(name="demo", min_member=3))
+    for k in range(3):
+        store.add_pod(Pod(
+            name=f"demo-{k}",
+            annotations={GROUP_NAME_ANNOTATION: "demo"},
+            containers=[{"cpu": "2", "memory": "2Gi"}],
+        ))
+
+    Scheduler(store).run_once()
+    store.flush_binds(timeout=10)
+
+    print("remote bind table:", HttpBinder(url).binds())
+    print("remote podgroup status:", HttpStatusUpdater(url).pod_groups())
+    assert len(HttpBinder(url).binds()) == 3
+    assert (HttpStatusUpdater(url).pod_groups()
+            ["default/demo"]["phase"] == "Running")
+    print("ok: gang bound and status written across the boundary")
+    store.close()  # stop the bind-dispatcher thread pinning the store
+    svc.shutdown()
+
+
+if __name__ == "__main__":
+    main()
+    time.sleep(0.05)  # let daemon threads drain before interpreter exit

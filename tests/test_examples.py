@@ -96,3 +96,12 @@ def test_scheduler_confs_parse():
         o for t in conf.tiers for o in t.plugins if o.name == "binpack"
     ][0]
     assert binpack.arguments["binpack.weight"] == "10"
+
+
+def test_remote_boundary_example_runs():
+    """examples/remote_boundary.py is a runnable demo of the three
+    remote side-effect drop-ins; it asserts its own outcomes."""
+    import runpy
+
+    runpy.run_path(str(EXAMPLES / "remote_boundary.py"),
+                   run_name="__main__")
