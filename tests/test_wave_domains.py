@@ -179,3 +179,46 @@ def test_conflict_compaction_overflow_parity(monkeypatch):
     placed = [v for v in res["fast"].values() if v]
     assert len(placed) == 40
     assert len(set(placed)) == len(placed)  # one per node
+
+
+def test_count_update_overflow_parity(monkeypatch):
+    """More than GCAP (256) ACCEPTED matching tasks in one sub-round
+    force the count-update full-scatter fallback (soft spread terms:
+    every pod matches its job's term and places immediately on roomy
+    nodes).  Placements and scores must match the object path."""
+    from volcano_tpu.api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup
+    from volcano_tpu.cache import ClusterStore
+
+    assert wave_mod.DEFAULT_WAVE >= 300, wave_mod.DEFAULT_WAVE
+
+    def build():
+        s = ClusterStore()
+        for i in range(8):
+            s.add_node(Node(
+                name=f"n{i}",
+                allocatable={"cpu": "64", "memory": "128Gi",
+                             "pods": 256},
+                topology={"zone": f"z{i % 4}"},
+            ))
+        # One shared spread job of 300 pods: every pod matches the
+        # job's soft term, capacity accepts all in the first waves.
+        pg = PodGroup(name="spread", min_member=300)
+        s.add_pod_group(pg)
+        for j in range(300):
+            s.add_pod(Pod(
+                name=f"spread-{j:03d}",
+                labels={"app": "spread"},
+                annotations={GROUP_NAME_ANNOTATION: pg.name},
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+                topology_spread=[("zone", 10)],
+            ))
+        return s
+
+    res = {}
+    for mode, env in (("fast", "1"), ("object", "0")):
+        monkeypatch.setenv("VOLCANO_TPU_FASTPATH", env)
+        store = build()
+        Scheduler(store).run_once()
+        res[mode] = placements(store)
+    assert all(v for v in res["fast"].values())
+    assert res["fast"] == res["object"]

@@ -787,6 +787,21 @@ def _solve_wave(
                     port_live = jnp.any(ports_w & used_bits_c, axis=1)
                     clean &= ~port_conf & ~port_live
                 if has_aff:
+                    # Shared row-compaction machinery (TPU scatters and
+                    # gathers serialize per element, so update count is
+                    # the cost; the participants are few).
+                    jidx_w = jnp.arange(W, dtype=jnp.int32)
+                    GCAP = min(256, W)
+
+                    def _earliest_rows(mask):
+                        """Indices of the earliest <=GCAP rows in
+                        ``mask`` (+ validity): top_k on the
+                        descending-index score picks the smallest
+                        indices first."""
+                        score = jnp.where(mask, W - jidx_w, 0)
+                        sc, idx_ = jax.lax.top_k(score, GCAP)
+                        return idx_, sc > 0
+
                     # Live per-task recheck + pair-conflict filter, both
                     # lax.cond-skipped for waves with no real terms (the
                     # scatter-min runs over EW*D keys — millions of
@@ -849,7 +864,7 @@ def _solve_wave(
                         # identifies the earliest giver in each domain;
                         # its per-term min (gt) the earliest giver in any
                         # domain.
-                        jidx = jnp.arange(W, dtype=jnp.int32)
+                        jidx = jidx_w
                         # Only REQUIRED terms' givers feed the conflict
                         # reads (anti_inv / uses_selfok mask every
                         # consumer), so soft-only spread terms drop out
@@ -861,16 +876,6 @@ def _solve_wave(
                             term_arange[None, :] * D + jnp.maximum(dw, 0)
                         )
                         scratch = EW * D
-                        GCAP = min(256, W)
-
-                        def _earliest_rows(mask):
-                            """Indices of the earliest <=GCAP rows in
-                            ``mask`` (+ validity): top_k on the
-                            descending-index score picks the smallest
-                            indices first."""
-                            score = jnp.where(mask, W - jidx, 0)
-                            sc, idx_ = jax.lax.top_k(score, GCAP)
-                            return idx_, sc > 0
 
                         # TPU scatters serialize per update: the full
                         # [W, EW] key scatter costs ~2 ms/sub-round at
@@ -1040,14 +1045,42 @@ def _solve_wave(
                         inc_base = t_matches_w & (dw >= 0)
 
                         def cnt_apply(cw, acc):
-                            return (
-                                cw.reshape(-1)
-                                .at[flat_dom.reshape(-1)]
-                                .add(
-                                    (inc_base & acc[:, None])
-                                    .astype(jnp.int32).reshape(-1)
+                            # Accepted matching tasks are few per
+                            # sub-round: scatter-add from the earliest
+                            # <=GCAP of them (value-0 masking for the
+                            # padding) instead of all W x EW keys —
+                            # exact, with the full scatter as overflow
+                            # fallback.
+                            rows_m = jnp.any(inc_base, axis=1) & acc
+
+                            def _full(_):
+                                return (
+                                    cw.reshape(-1)
+                                    .at[flat_dom.reshape(-1)]
+                                    .add(
+                                        (inc_base & acc[:, None])
+                                        .astype(jnp.int32).reshape(-1)
+                                    )
+                                    .reshape(EW, D)
                                 )
-                                .reshape(EW, D)
+
+                            def _compact(_):
+                                ci, cval = _earliest_rows(rows_m)
+                                vals = (
+                                    inc_base[ci]
+                                    & acc[ci][:, None]
+                                    & cval[:, None]
+                                ).astype(jnp.int32)
+                                return (
+                                    cw.reshape(-1)
+                                    .at[flat_dom[ci].reshape(-1)]
+                                    .add(vals.reshape(-1))
+                                    .reshape(EW, D)
+                                )
+
+                            return jax.lax.cond(
+                                jnp.sum(rows_m) > GCAP, _full, _compact,
+                                None,
                             )
 
                         cwa = cnt_apply(cwa, acc_alloc)
