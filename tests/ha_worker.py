@@ -1,0 +1,86 @@
+"""Worker process for the adversarial HA test (test_ha_persistence.py).
+
+Runs a LeaderElector against a shared lease file; while leading, "binds"
+pods by appending `<identity> <epoch> <pod-id>` lines to a shared
+O_APPEND log — the side-effect channel standing in for cache.Bind, with
+the lease's `acquired` timestamp as a fencing token.  Each cycle resyncs
+from the log first (the informer-rebuild analog: a fresh leader continues
+from the bound set, it does not restart it) and re-validates the lease
+FILE (not a cached flag) immediately before the side effect, so a stalled
+ex-leader that lost the lease cannot emit a stale bind — the same fencing
+the reference gets from resourceVersion-checked updates
+(cmd/scheduler/app/server.go leaderelection).
+
+Usage: python ha_worker.py <lease_path> <log_path> <identity> <n_pods>
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main() -> None:
+    lease_path, log_path, identity, n_pods = sys.argv[1:5]
+    n_pods = int(n_pods)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from volcano_tpu.ha import LeaderElector
+
+    el = LeaderElector(
+        lease_path,
+        identity=identity,
+        lease_duration=2.0,
+        renew_deadline=1.5,
+        retry_period=0.1,
+    )
+    t = threading.Thread(
+        target=el.run, args=(lambda: None, lambda: None), daemon=True
+    )
+    t.start()
+
+    def lease_epoch():
+        """The fencing token: the `acquired` timestamp of the lease iff
+        this process holds it right now, else None."""
+        try:
+            with open(lease_path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if rec.get("holder") != identity:
+            return None
+        if time.time() >= float(rec.get("expiry", 0)):
+            return None
+        return rec.get("acquired")
+
+    fd = os.open(log_path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    while True:
+        if not el.is_leader:
+            time.sleep(0.02)
+            continue
+        # Resync: the bound set is rebuilt from the durable log, exactly
+        # as a fresh reference leader rebuilds from the API server.
+        try:
+            with open(log_path) as f:
+                bound = {
+                    line.split()[2] for line in f if len(line.split()) == 3
+                }
+        except OSError:
+            bound = set()
+        nxt = next(
+            (i for i in range(n_pods) if f"pod-{i}" not in bound), None
+        )
+        if nxt is None:
+            time.sleep(0.05)
+            continue
+        # Mid-cycle work between resync and side effect — the window the
+        # test's SIGKILL lands in.
+        time.sleep(0.03)
+        epoch = lease_epoch()  # fencing re-read just before the bind
+        if epoch is not None:
+            os.write(fd, f"{identity} {epoch} pod-{nxt}\n".encode())
+        time.sleep(0.02)
+
+
+if __name__ == "__main__":
+    main()

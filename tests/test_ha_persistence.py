@@ -218,3 +218,105 @@ def test_checkpoint_roundtrips_claims_and_policies(tmp_path):
     assert restored.network_policies["default/job-a"][
         "policy_types"] == ["Ingress"]
     assert restored.n_volume_pods == 1
+
+
+def test_leader_election_adversarial_two_processes_kill_mid_cycle(tmp_path):
+    """Two REAL scheduler worker processes contend for the file lease;
+    the active leader is SIGKILLed mid-cycle (no release, no cleanup —
+    the lease must expire on its own).  Asserts the reference's HA
+    contract (cmd/scheduler/app/server.go leaderelection):
+
+    - single-writer history: leadership runs are contiguous with
+      strictly increasing lease epochs (the fencing token each bind
+      carries), and the killed identity never reappears after the
+      survivor's first post-kill bind;
+    - no double-bind: every pod id appears exactly once (the standby
+      resynced the bound set before continuing, as a fresh reference
+      leader rebuilds from the API server).
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    lease = str(tmp_path / "lease")
+    log = str(tmp_path / "binds.log")
+    worker = os.path.join(os.path.dirname(__file__), "ha_worker.py")
+    n_pods = 200
+
+    def spawn(ident):
+        return subprocess.Popen(
+            [sys.executable, worker, lease, log, ident, str(n_pods)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def read_log():
+        try:
+            with open(log) as f:
+                return [tuple(l.split()) for l in f if len(l.split()) == 3]
+        except OSError:
+            return []
+
+    pa = spawn("A")
+    pb = spawn("B")
+    try:
+        # Wait until one of them leads and has bound a few pods.
+        deadline = time.time() + 30
+        while time.time() < deadline and len(read_log()) < 5:
+            time.sleep(0.05)
+        recs = read_log()
+        assert len(recs) >= 5, "no leader emerged within 30s"
+        leader = recs[-1][0]
+        kill_marker = len(recs)
+        # SIGKILL the active leader mid-cycle: no release path runs.
+        victim = pa if leader == "A" else pb
+        victim.kill()
+        victim.wait()
+        killed_at = len(read_log())
+        # The survivor must take over after the lease expires and make
+        # progress.
+        survivor = "B" if leader == "A" else "A"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            recs = read_log()
+            if sum(1 for r in recs if r[0] == survivor) >= 5:
+                break
+            time.sleep(0.05)
+        recs = read_log()
+        assert sum(1 for r in recs if r[0] == survivor) >= 5, (
+            f"survivor {survivor} made no progress after leader kill "
+            f"(log: {recs[killed_at:]})"
+        )
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait()
+
+    # Single-writer history: every leadership change carries a strictly
+    # larger lease epoch (the fencing token), and an epoch is owned by
+    # exactly one identity — two simultaneously-active leaders would
+    # interleave records under non-increasing epochs.
+    epochs = [float(r[1]) for r in recs]
+    for i in range(1, len(recs)):
+        if recs[i][0] != recs[i - 1][0]:
+            assert epochs[i] > epochs[i - 1], (
+                f"leadership switch without epoch fence at {i}: {recs}"
+            )
+    by_epoch = {}
+    for ident, ep, _pod in recs:
+        assert by_epoch.setdefault(ep, ident) == ident, (
+            f"epoch {ep} shared by two identities: {recs}"
+        )
+    # The killed identity never reappears after the survivor takes over.
+    post_kill = [r[0] for r in recs[kill_marker:]]
+    if survivor in post_kill:
+        first_surv = kill_marker + post_kill.index(survivor)
+        dead_after = [
+            r for r in recs[first_surv:] if r[0] == leader
+        ]
+        assert not dead_after, f"dead leader wrote after failover: {recs}"
+    # No double-bind.
+    pods = [r[2] for r in recs]
+    dupes = {p for p in pods if pods.count(p) > 1}
+    assert not dupes, f"pods bound twice across failover: {dupes}"

@@ -211,3 +211,174 @@ def test_default_max_retry_applies_when_zero():
     ctrl = RecordingCtrl()
     new_state(ctrl, job).execute(Action.SyncJob.value)
     assert job.status.state.phase == JobPhase.Failed.value
+
+
+# ---------------------------------------------------------------------------
+# Queue 5-state machine (pkg/controllers/queue/state/{factory,open,closed,
+# closing,unknown}.go), table-driven like the job table above.  "" is Open
+# (factory.go NewState: `case "", v1beta1.QueueStateOpen`).
+# ---------------------------------------------------------------------------
+
+def _queue_env(state, n_pgs):
+    from volcano_tpu.api import PodGroup, Queue
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.controllers.queue_controller import QueueController
+
+    store = ClusterStore()
+    qc = QueueController(store)
+    q = Queue(name="q")
+    q.state = state
+    store.add_queue(q)
+    for i in range(n_pgs):
+        store.add_pod_group(PodGroup(name=f"pg-{i}", queue="q"))
+    qc.queue.clear()  # table rows drive _handle_queue directly
+    return store, qc, q
+
+
+# (state, action, n_pgs) -> expected resulting state.  Every cell of the
+# reference machine, including the v0.4 quirk: a plain Sync on Closing or
+# Unknown re-derives to Unknown (closing.go/unknown.go default branch —
+# the recorded state is neither Open nor Closed).
+QUEUE_TABLE = [
+    ("", "OpenQueue", 0, "Open"),
+    ("", "CloseQueue", 0, "Closed"),
+    ("", "CloseQueue", 2, "Closing"),
+    ("", "SyncQueue", 2, "Open"),
+    ("Open", "OpenQueue", 2, "Open"),
+    ("Open", "CloseQueue", 0, "Closed"),
+    ("Open", "CloseQueue", 2, "Closing"),
+    ("Open", "SyncQueue", 2, "Open"),
+    ("Closed", "OpenQueue", 0, "Open"),
+    ("Closed", "CloseQueue", 0, "Closed"),
+    ("Closed", "CloseQueue", 2, "Closed"),  # closed.go: Sync(state=Closed)
+    ("Closed", "SyncQueue", 2, "Closed"),
+    ("Closing", "OpenQueue", 2, "Open"),
+    ("Closing", "CloseQueue", 0, "Closed"),
+    ("Closing", "CloseQueue", 2, "Closing"),
+    ("Closing", "SyncQueue", 2, "Unknown"),
+    ("Unknown", "OpenQueue", 2, "Open"),
+    ("Unknown", "CloseQueue", 0, "Closed"),
+    ("Unknown", "CloseQueue", 2, "Closing"),
+    ("Unknown", "SyncQueue", 2, "Unknown"),
+]
+
+
+@pytest.mark.parametrize("state,action,n_pgs,expected", QUEUE_TABLE)
+def test_queue_state_table(state, action, n_pgs, expected):
+    store, qc, q = _queue_env(state, n_pgs)
+    qc._handle_queue(action, "q")
+    assert q.state == expected, (state, action, n_pgs)
+
+
+def test_queue_open_close_events_on_transition():
+    """openQueue/closeQueue record events only on an actual state change
+    (queue_controller_action.go recorder.Event calls)."""
+    store, qc, q = _queue_env("Open", 1)
+    qc._handle_queue("CloseQueue", "q")
+    evs = store.events_for("Queue/q")
+    assert any(e["reason"] == "CloseQueue"
+               and "Close queue succeed" in e["message"] for e in evs)
+    qc._handle_queue("OpenQueue", "q")
+    evs = store.events_for("Queue/q")
+    assert any(e["reason"] == "OpenQueue"
+               and "Open queue succeed" in e["message"] for e in evs)
+    # Re-opening an Open queue records nothing new (openQueue early
+    # return when the state already matches).
+    before = len(store.events_for("Queue/q"))
+    qc._handle_queue("OpenQueue", "q")
+    assert len(store.events_for("Queue/q")) == before
+
+
+def test_queue_status_phase_counts():
+    """syncQueue tallies PodGroup phases into the status
+    (queue_controller_action.go:34-82)."""
+    from volcano_tpu.api import PodGroupPhase
+
+    store, qc, q = _queue_env("Open", 4)
+    pgs = [store.pod_groups[f"default/pg-{i}"] for i in range(4)]
+    pgs[0].status.phase = PodGroupPhase.Running.value
+    pgs[1].status.phase = PodGroupPhase.Inqueue.value
+    pgs[2].status.phase = PodGroupPhase.Unknown.value
+    qc._handle_queue("SyncQueue", "q")
+    st = qc.status["q"]
+    assert (st.pending, st.running, st.unknown, st.inqueue) == (1, 1, 1, 1)
+    assert st.state == "Open"
+
+
+def test_queue_request_retry_then_drop_records_event(monkeypatch):
+    """A persistently-failing request retries MAX_RETRIES times, then is
+    dropped with a Warning event naming the action
+    (queue_controller.go handleQueueErr -> recordEventsForQueue)."""
+    from volcano_tpu.controllers import queue_controller as qcm
+
+    store, qc, q = _queue_env("Open", 0)
+    calls = {"n": 0}
+
+    def boom(action, name):
+        calls["n"] += 1
+        raise RuntimeError("induced sync failure")
+
+    monkeypatch.setattr(qc, "_handle_queue", boom)
+    qc.queue.append(("SyncQueue", "q"))
+    for _ in range(qcm.MAX_RETRIES + 2):
+        qc.process_all()
+    assert calls["n"] == qcm.MAX_RETRIES + 1  # first try + retries
+    assert not qc.queue
+    evs = store.events_for("Queue/q")
+    assert any("failed" in e["message"] for e in evs)
+
+
+def test_queue_pg_index_incremental():
+    """The queue->PodGroup index updates from watch events, not scans
+    (queue_controller_handler.go addPodGroup/deletePodGroup)."""
+    from volcano_tpu.api import PodGroup
+
+    store, qc, q = _queue_env("Open", 1)
+    assert qc.pod_groups["q"] == {"default/pg-0"}
+    store.add_pod_group(PodGroup(name="pg-x", queue="q"))
+    assert qc.pod_groups["q"] == {"default/pg-0", "default/pg-x"}
+    store.delete_pod_group("default/pg-0")
+    assert qc.pod_groups["q"] == {"default/pg-x"}
+    # Closing drains to Closed via an explicit CloseQueue once empty.
+    store.delete_pod_group("default/pg-x")
+    qc._handle_queue("CloseQueue", "q")
+    assert q.state == "Closed"
+
+
+def test_queue_sync_not_self_driven_by_own_writebacks():
+    """The controller's own update_queue write-backs must not enqueue
+    syncs (updateQueue is a no-op handler in the reference) — otherwise
+    closing a non-empty queue self-drives Closing -> Unknown with no
+    external event."""
+    from volcano_tpu.controllers import Command
+
+    store, qc, q = _queue_env("Open", 2)
+    store.add_command(Command(action="CloseQueue", target_kind="Queue",
+                              target_name="q"))
+    qc.process_all()
+    assert q.state == "Closing"
+    # Further empty process passes leave the state alone: no self-syncs.
+    qc.process_all()
+    qc.process_all()
+    assert q.state == "Closing"
+
+
+def test_queue_pg_move_updates_both_indexes():
+    """A PodGroup that moves queues leaves the old queue's index (the
+    reference's updatePodGroup handles the phase path; the rebuild also
+    covers Spec.Queue moves so the old queue can drain)."""
+    from volcano_tpu.api import PodGroup
+
+    store, qc, q2 = _queue_env("Open", 1)
+    from volcano_tpu.api import Queue
+
+    store.add_queue(Queue(name="q2"))
+    pg = store.pod_groups["default/pg-0"]
+    pg.queue = "q2"
+    store.update_pod_group(pg)
+    assert qc.pod_groups["q"] == set()
+    assert qc.pod_groups["q2"] == {"default/pg-0"}
+    qc.process_all()
+    # The vacated queue can now drain to Closed.
+    qc._handle_queue("CloseQueue", "q")
+    assert store.raw_queues["q"].state == "Closed"
