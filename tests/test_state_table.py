@@ -382,3 +382,48 @@ def test_queue_pg_move_updates_both_indexes():
     # The vacated queue can now drain to Closed.
     qc._handle_queue("CloseQueue", "q")
     assert store.raw_queues["q"].state == "Closed"
+
+
+def test_queue_pg_index_survives_sync_before_queue_exists():
+    """Watch ordering across kinds is not guaranteed: a PodGroup (and its
+    SyncQueue) can arrive before its Queue object.  The NotFound sync must
+    not wipe the incrementally-built index (the reference's handleQueue
+    touches neither podGroups nor queueStatus on NotFound) — otherwise the
+    late-created queue permanently reports zero PodGroups."""
+    from volcano_tpu.api import PodGroup, Queue
+    from volcano_tpu.cache import ClusterStore
+    from volcano_tpu.controllers.queue_controller import QueueController
+
+    store = ClusterStore()
+    qc = QueueController(store)
+    store.add_pod_group(PodGroup(name="pg-0", queue="late"))
+    qc.process_all()  # SyncQueue("late") -> NotFound
+    assert qc.pod_groups.get("late") == {"default/pg-0"}
+    q = Queue(name="late")
+    store.add_queue(q)
+    qc.process_all()
+    assert qc.status["late"].pending == 1
+    # CloseQueue on the non-empty queue drains to Closing, not Closed.
+    qc._handle_queue("CloseQueue", "late")
+    assert q.state == "Closing"
+
+
+def test_queue_spec_only_pg_update_does_not_resync():
+    """updatePodGroup re-enqueues a sync only on a phase change
+    ("oldPG.Status.Phase != newPG.Status.Phase",
+    queue_controller_handler.go).  A spec-only update must not sync — a
+    Sync on a Closing queue derives Unknown (the v0.4 quirk), so a no-op
+    update would corrupt the state."""
+    store, qc, q = _queue_env("Open", 1)
+    qc._handle_queue("CloseQueue", "q")
+    assert q.state == "Closing"
+    pg = store.pod_groups["default/pg-0"]
+    store.update_pod_group(pg)  # same queue, same phase
+    qc.process_all()
+    assert q.state == "Closing"
+    # A real phase change still syncs (and Closing re-derives Unknown).
+    pg.status.phase = "Running"
+    store.update_pod_group(pg)
+    qc.process_all()
+    assert q.state == "Unknown"
+    assert qc.status["q"].running == 1

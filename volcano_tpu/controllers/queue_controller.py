@@ -76,6 +76,11 @@ class QueueController:
         # deleted by uid) is removed from its OLD queue's set.
         self.pod_groups: Dict[str, Set[str]] = {}
         self._pg_queue: Dict[str, str] = {}
+        # Last-seen PodGroup phase, so updates re-enqueue a sync only on
+        # an actual phase change (updatePodGroup's
+        # "oldPG.Status.Phase != newPG.Status.Phase" gate) — the store
+        # passes only the new object, so the old phase is tracked here.
+        self._pg_phase: Dict[str, str] = {}
         self._retries: Dict[tuple, int] = {}
         store.watch(self._on_store_event)
 
@@ -108,6 +113,7 @@ class QueueController:
                 # tombstone — here the reverse map is the tombstone.
                 uid = obj if isinstance(obj, str) else obj.uid
                 old = self._pg_queue.pop(uid, None)
+                self._pg_phase.pop(uid, None)
                 if old is not None:
                     members = self.pod_groups.get(old)
                     if members is not None:
@@ -120,16 +126,27 @@ class QueueController:
                 return
             uid = getattr(pg, "uid", None) or getattr(pg, "name", "")
             old = self._pg_queue.get(uid)
-            if old is not None and old != qname:
+            moved = old is not None and old != qname
+            if moved:
                 # Queue move: drop from the old set so the group is not
                 # double-counted and the old queue can drain.
                 members = self.pod_groups.get(old)
                 if members is not None:
                     members.discard(uid)
                 self._enqueue(Action.SyncQueue.value, old)
+            first_seen = old is None
             self._pg_queue[uid] = qname
             self.pod_groups.setdefault(qname, set()).add(uid)
-            self._enqueue(Action.SyncQueue.value, qname)
+            phase = getattr(getattr(pg, "status", None), "phase", "")
+            phase_changed = self._pg_phase.get(uid) != phase
+            self._pg_phase[uid] = phase
+            # addPodGroup always syncs; updatePodGroup only on a phase
+            # change ("if oldPG.Status.Phase != newPG.Status.Phase",
+            # queue_controller_handler.go) or a queue move — a spec-only
+            # update must NOT re-sync (a Sync on a Closing queue derives
+            # Unknown, so a no-op update would corrupt the state).
+            if event == "add" or first_seen or moved or phase_changed:
+                self._enqueue(Action.SyncQueue.value, qname)
         elif kind == "Command" and event == "add":
             if obj.target_kind == "Queue":
                 # handleCommand: delete the Command, enqueue the request.
@@ -176,8 +193,14 @@ class QueueController:
         queue = self.store.raw_queues.get(name)
         if queue is None:
             # handleQueue: NotFound → "Queue %s has been deleted", done.
+            # The PodGroup index is NOT dropped here (the reference's
+            # handleQueue touches neither podGroups nor queueStatus):
+            # a sync can race ahead of the queue's own add event — e.g.
+            # PodGroup-before-Queue watch ordering — and wiping the
+            # incrementally-built index would leave a late-created
+            # queue permanently reporting zero PodGroups.  Cleanup of
+            # both maps belongs to the Queue delete handler.
             self.status.pop(name, None)
-            self.pod_groups.pop(name, None)
             return
         state = queue.state or _OPEN
         if state not in (_OPEN, _CLOSED, _CLOSING, _UNKNOWN):

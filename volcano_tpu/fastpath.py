@@ -1050,8 +1050,8 @@ class FastCycle:
                                                        task_rows):
                     had_aff_chunks |= self._chunks_had_terms
                     t_enc = time.perf_counter()
-                    inputs, pid, profiles = self._solve_inputs(cjobs,
-                                                               crows)
+                    inputs, pid, profiles = self._solve_inputs(
+                        cjobs, crows, slim=(solver == "wave"))
                     lanes["encode"] = (lanes.get("encode", 0.0)
                                        + time.perf_counter() - t_enc)
                     t0 = time.perf_counter()
@@ -1490,7 +1490,90 @@ class FastCycle:
                 ti.append(k)
         return np.array(er, np.int64), np.array(ti, np.int64)
 
-    def _solve_inputs(self, solve_jobs: List[int], task_rows: np.ndarray):
+    def _task_field_arrays(self, rows: np.ndarray):
+        """Per-task solver feature arrays for the given mirror rows
+        (leading dim = len(rows)): requests, selector/toleration/port
+        bit planes, required/preferred node-affinity alternatives.
+
+        Called with all pending rows on the non-slim (sequential parity)
+        path, and with only the profile first-occurrence rows on the
+        wave path — tasks sharing a store-interned profile id have
+        identical spec-level features, so one row represents them all.
+        """
+        m = self.m
+        P = len(rows)
+        R = self.R
+        LW = _pow2(max(1, (len(m.labels) + 31) // 32), 1)
+        TW = _pow2(max(1, (len(m.taints) + 31) // 32), 1)
+        PW = _pow2(max(1, (len(m.ports) + 31) // 32), 1)
+
+        req = np.zeros((P, R), F)
+        init_req = np.zeros((P, R), F)
+        er, si, v = m.c_req.gather(rows)
+        req[er, si] = v
+        er, si, v = m.c_init_req.gather(rows)
+        init_req[er, si] = v
+        sel_bits = np.zeros((P, LW), np.uint32)
+        er, li = m.c_sel.gather(rows)
+        sel_bits[:P] = _pack_bits(P, LW, er, li)
+        tol_bits = np.zeros((P, TW), np.uint32)
+        er, ti = self._tol_bits_for(rows)
+        if len(er):
+            tol_bits[:P] = _pack_bits(P, TW, er, ti)
+        port_bits = np.zeros((P, PW), np.uint32)
+        er, pi = m.c_ports.gather(rows)
+        if len(er):
+            port_bits[:P] = _pack_bits(P, PW, er, pi)
+
+        # Required node-affinity alternatives.
+        aff_lo = m.p_aff_lo[rows]
+        aff_hi = m.p_aff_hi[rows]
+        n_alts = (aff_hi - aff_lo).astype(np.int64)
+        A = _pow2(max(1, int(n_alts.max()) if P else 1), 1)
+        aff_bits = np.zeros((P, A, LW), np.uint32)
+        aff_terms = np.zeros((P,), I)
+        aff_terms[:P] = n_alts
+        if n_alts.any():
+            alt_rows = np.concatenate([
+                np.arange(lo, hi) for lo, hi in zip(aff_lo, aff_hi) if hi > lo
+            ]).astype(np.int64)
+            task_of_alt = np.repeat(np.arange(P), n_alts)
+            slot_of_alt = np.concatenate([
+                np.arange(h - l) for l, h in zip(aff_lo, aff_hi) if h > l
+            ])
+            er, li = m.c_aff_alt.gather(alt_rows)
+            flat = _pack_bits(len(alt_rows), LW, er, li)
+            aff_bits[task_of_alt, slot_of_alt] = flat
+
+        # Preferred node affinity (normalized to [0,10] per task).
+        pref_lo = m.p_pref_lo[rows]
+        pref_hi = m.p_pref_hi[rows]
+        n_pref = (pref_hi - pref_lo).astype(np.int64)
+        AP = _pow2(max(1, int(n_pref.max()) if P else 1), 1)
+        pref_bits = np.zeros((P, AP, LW), np.uint32)
+        pref_w = np.zeros((P, AP), F)
+        if n_pref.any():
+            pr_rows = np.concatenate([
+                np.arange(lo, hi) for lo, hi in zip(pref_lo, pref_hi) if hi > lo
+            ]).astype(np.int64)
+            task_of_pr = np.repeat(np.arange(P), n_pref)
+            slot_of_pr = np.concatenate([
+                np.arange(h - l) for l, h in zip(pref_lo, pref_hi) if h > l
+            ])
+            er, li = m.c_pref.gather(pr_rows)
+            flat = _pack_bits(len(pr_rows), LW, er, li)
+            pref_bits[task_of_pr, slot_of_pr] = flat
+            w = np.array([m.pref_w[r] for r in pr_rows], F)
+            totals = np.zeros(P, F)
+            np.add.at(totals, task_of_pr, w)
+            wn = np.where(totals[task_of_pr] > 0,
+                          w / totals[task_of_pr] * 10.0, 0.0)
+            pref_w[task_of_pr, slot_of_pr] = wn
+        return (req, init_req, port_bits, sel_bits, aff_bits, aff_terms,
+                tol_bits, pref_bits, pref_w)
+
+    def _solve_inputs(self, solve_jobs: List[int], task_rows: np.ndarray,
+                      slim: bool = False):
         self._flush_aggr()
         m = self.m
         P = len(task_rows)
@@ -1541,11 +1624,21 @@ class FastCycle:
             out[:len(a)] = a
             return out
 
+        # Wave path: pipelined is identically zero at solve start and
+        # releasing is usually all-zero outside eviction cycles; both
+        # broadcast as [1, R] dummies in the kernel (FutureIdle adds /
+        # subtracts them), skipping their [Np, R] upload.
+        releasing_np = self.n_releasing.astype(F)
+        if slim and not releasing_np.any():
+            releasing_in = np.zeros((1, R), F)
+        else:
+            releasing_in = padN(releasing_np)
         nodes = SolveNodes(
             idle=padN(self.n_idle.astype(F)),
             allocatable=padN(self.n_alloc.astype(F)),
-            releasing=padN(self.n_releasing.astype(F)),
-            pipelined=np.zeros((Np, R), F),
+            releasing=releasing_in,
+            pipelined=(np.zeros((1, R), F) if slim
+                       else np.zeros((Np, R), F)),
             ntasks=padN(self.n_ntasks),
             max_tasks=padN(self.n_maxtasks),
             ports=n_ports,
@@ -1555,69 +1648,6 @@ class FastCycle:
         )
 
         # ---- tasks
-        req = np.zeros((Pp, R), F)
-        init_req = np.zeros((Pp, R), F)
-        er, si, v = m.c_req.gather(task_rows)
-        req[er, si] = v
-        er, si, v = m.c_init_req.gather(task_rows)
-        init_req[er, si] = v
-        sel_bits = np.zeros((Pp, LW), np.uint32)
-        er, li = m.c_sel.gather(task_rows)
-        sel_bits[:P] = _pack_bits(P, LW, er, li)
-        tol_bits = np.zeros((Pp, TW), np.uint32)
-        er, ti = self._tol_bits_for(task_rows)
-        if len(er):
-            tol_bits[:P] = _pack_bits(P, TW, er, ti)
-        port_bits = np.zeros((Pp, PW), np.uint32)
-        er, pi = m.c_ports.gather(task_rows)
-        if len(er):
-            port_bits[:P] = _pack_bits(P, PW, er, pi)
-
-        # Required node-affinity alternatives.
-        aff_lo = m.p_aff_lo[task_rows]
-        aff_hi = m.p_aff_hi[task_rows]
-        n_alts = (aff_hi - aff_lo).astype(np.int64)
-        A = _pow2(max(1, int(n_alts.max()) if P else 1), 1)
-        aff_bits = np.zeros((Pp, A, LW), np.uint32)
-        aff_terms = np.zeros((Pp,), I)
-        aff_terms[:P] = n_alts
-        if n_alts.any():
-            alt_rows = np.concatenate([
-                np.arange(lo, hi) for lo, hi in zip(aff_lo, aff_hi) if hi > lo
-            ]).astype(np.int64)
-            task_of_alt = np.repeat(np.arange(P), n_alts)
-            slot_of_alt = np.concatenate([
-                np.arange(h - l) for l, h in zip(aff_lo, aff_hi) if h > l
-            ])
-            er, li = m.c_aff_alt.gather(alt_rows)
-            flat = _pack_bits(len(alt_rows), LW, er, li)
-            aff_bits[task_of_alt, slot_of_alt] = flat
-
-        # Preferred node affinity (normalized to [0,10] per task).
-        pref_lo = m.p_pref_lo[task_rows]
-        pref_hi = m.p_pref_hi[task_rows]
-        n_pref = (pref_hi - pref_lo).astype(np.int64)
-        AP = _pow2(max(1, int(n_pref.max()) if P else 1), 1)
-        pref_bits = np.zeros((Pp, AP, LW), np.uint32)
-        pref_w = np.zeros((Pp, AP), F)
-        if n_pref.any():
-            pr_rows = np.concatenate([
-                np.arange(lo, hi) for lo, hi in zip(pref_lo, pref_hi) if hi > lo
-            ]).astype(np.int64)
-            task_of_pr = np.repeat(np.arange(P), n_pref)
-            slot_of_pr = np.concatenate([
-                np.arange(h - l) for l, h in zip(pref_lo, pref_hi) if h > l
-            ])
-            er, li = m.c_pref.gather(pr_rows)
-            flat = _pack_bits(len(pr_rows), LW, er, li)
-            pref_bits[task_of_pr, slot_of_pr] = flat
-            w = np.array([m.pref_w[r] for r in pr_rows], F)
-            totals = np.zeros(P, F)
-            np.add.at(totals, task_of_pr, w)
-            wn = np.where(totals[task_of_pr] > 0,
-                          w / totals[task_of_pr] * 10.0, 0.0)
-            pref_w[task_of_pr, slot_of_pr] = wn
-
         jrank = np.zeros(self.Jn + 1, I)
         for i, row in enumerate(solve_jobs):
             jrank[row] = i
@@ -1627,19 +1657,44 @@ class FastCycle:
         t_real = np.zeros((Pp,), bool)
         t_real[:P] = True
 
-        tasks = SolveTasks(
-            req=req,
-            init_req=init_req,
-            job=t_job,
-            real=t_real,
-            ports=port_bits,
-            sel_bits=sel_bits,
-            aff_bits=aff_bits,
-            aff_terms=aff_terms,
-            tol_bits=tol_bits,
-            pref_bits=pref_bits,
-            pref_w=pref_w,
-        )
+        if slim:
+            # Wave-solver path: the kernel reads only job/real per-task
+            # (req/init_req and every predicate input come from the
+            # profile rows, ops/wave.py _solve_wave), so the dense
+            # [P, ...] feature arrays are neither built (encode time)
+            # nor shipped (upload time).  Profile rows are gathered
+            # straight from the mirror at the first-occurrence task rows
+            # (_profiles_from_rows).
+            tasks = SolveTasks(
+                req=np.zeros((1, R), F),
+                init_req=np.zeros((1, R), F),
+                job=t_job,
+                real=t_real,
+                ports=np.zeros((1, 1), np.uint32),
+                sel_bits=np.zeros((1, 1), np.uint32),
+                aff_bits=np.zeros((1, 1, 1), np.uint32),
+                aff_terms=np.zeros((1,), I),
+                tol_bits=np.zeros((1, 1), np.uint32),
+                pref_bits=np.zeros((1, 1, 1), np.uint32),
+                pref_w=np.zeros((1, 1), F),
+            )
+        else:
+            (req, init_req, port_bits, sel_bits, aff_bits, aff_terms,
+             tol_bits, pref_bits, pref_w) = self._task_field_arrays(
+                task_rows)
+            tasks = SolveTasks(
+                req=req,
+                init_req=init_req,
+                job=t_job,
+                real=t_real,
+                ports=port_bits,
+                sel_bits=sel_bits,
+                aff_bits=aff_bits,
+                aff_terms=aff_terms,
+                tol_bits=tol_bits,
+                pref_bits=pref_bits,
+                pref_w=pref_w,
+            )
 
         # ---- jobs
         j_min = np.full((Jp,), 1 << 30, I)
@@ -1661,7 +1716,7 @@ class FastCycle:
         queues = SolveQueues(deserved=deserved, allocated=q_alloc)
 
         aff, pid, profiles = self._affinity_and_profiles(
-            task_rows, tasks, Np
+            task_rows, None if slim else tasks, Np
         )
         weights = self._score_weights()
         return (
@@ -1856,8 +1911,26 @@ class FastCycle:
         self._pid_out = pid
         U = len(u)
 
-        def g(a):
-            return np.asarray(a)[u]
+        if tasks is None:
+            # Slim (wave) path: build the U profile feature rows straight
+            # from the mirror at the first-occurrence task rows — the
+            # dense [P, ...] arrays were never built.
+            (p_req, p_init_req, p_ports, p_sel, p_affb, p_afft, p_tol,
+             p_prefb, p_prefw) = self._task_field_arrays(task_rows[u])
+
+            def g(a):
+                return a
+
+            rows_by_field = (p_req, p_init_req, p_ports, p_sel, p_affb,
+                             p_afft, p_tol, p_prefb, p_prefw)
+        else:
+            def g(a):
+                return np.asarray(a)[u]
+
+            rows_by_field = (tasks.req, tasks.init_req, tasks.ports,
+                             tasks.sel_bits, tasks.aff_bits,
+                             tasks.aff_terms, tasks.tol_bits,
+                             tasks.pref_bits, tasks.pref_w)
 
         if term_parts is None:
             Ep = 1
@@ -1896,16 +1969,18 @@ class FastCycle:
             scatter(er_n, ei_n, u_req_anti)
             scatter(er_s, ei_s, u_soft, val=ev_s)
 
+        (f_req, f_init_req, f_ports, f_sel, f_affb, f_afft, f_tol,
+         f_prefb, f_prefw) = rows_by_field
         return SolveProfiles(
-            req=g(tasks.req),
-            init_req=g(tasks.init_req),
-            ports=g(tasks.ports),
-            sel_bits=g(tasks.sel_bits),
-            aff_bits=g(tasks.aff_bits),
-            aff_terms=g(tasks.aff_terms),
-            tol_bits=g(tasks.tol_bits),
-            pref_bits=g(tasks.pref_bits),
-            pref_w=g(tasks.pref_w),
+            req=g(f_req),
+            init_req=g(f_init_req),
+            ports=g(f_ports),
+            sel_bits=g(f_sel),
+            aff_bits=g(f_affb),
+            aff_terms=g(f_afft),
+            tol_bits=g(f_tol),
+            pref_bits=g(f_prefb),
+            pref_w=g(f_prefw),
             t_req_aff=u_req_aff,
             t_req_anti=u_req_anti,
             t_matches=u_matches,
