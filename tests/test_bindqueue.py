@@ -327,3 +327,47 @@ def test_flush_timeout_returns_false_on_wedged_binder():
     release.set()
     assert d.flush(timeout=10) is True
     d.stop()
+
+
+def test_deferred_record_walk_sets_node_name_post_cycle():
+    """Async watcher-free cycles ship the bind batch as object arrays;
+    the dispatcher worker applies the pod.node_name record walk
+    post-cycle (the reference's API-server-side NodeName write,
+    cache.go:536-552).  After flush, every bound pod record must carry
+    its host and the binder must have seen every key."""
+    store = synthetic_cluster(n_nodes=4, n_pods=32, gang_size=4, seed=5)
+    store.async_bind = True
+    Scheduler(store).run_once()
+    assert store.flush_binds(timeout=30)
+    assert len(store.binder.binds) == 32
+    named = [p for p in store.pods.values() if p.node_name]
+    assert len(named) == 32
+    store.close()
+
+
+def test_deferred_record_walk_applies_before_failure_resync():
+    """A cycle that fails after commit must apply the deferred record
+    walk before the mirror resync, or committed pods would read as
+    unbound and double-schedule (fastpath.run() exception path)."""
+    import pytest
+
+    from volcano_tpu.fastpath import FastCycle
+
+    store = synthetic_cluster(n_nodes=4, n_pods=32, gang_size=4, seed=6)
+    store.async_bind = True
+    orig = FastCycle._close
+
+    def boom(self):
+        raise RuntimeError("injected close failure")
+
+    FastCycle._close = boom
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            Scheduler(store).run_once()
+    finally:
+        FastCycle._close = orig
+    # The exception path applied the record walk synchronously.
+    named = [p for p in store.pods.values() if p.node_name]
+    assert len(named) == 32
+    store.flush_binds(timeout=30)
+    store.close()

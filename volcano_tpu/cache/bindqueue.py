@@ -52,9 +52,13 @@ class BindDispatcher:
         self._thread.start()
 
     def dispatch(self, keys: Sequence[str], hosts: Sequence[str],
-                 pods: Sequence[object]) -> None:
+                 pods: Sequence[object],
+                 set_node_name: bool = False) -> None:
+        """``set_node_name`` batches arrive as numpy object arrays; the
+        worker materializes lists and applies the pod.node_name record
+        walk off the scheduling cycle's critical path."""
         with self._cv:
-            self._q.append((keys, hosts, pods))
+            self._q.append((keys, hosts, pods, set_node_name))
             self._inflight += 1
             self._cv.notify()
 
@@ -88,7 +92,16 @@ class BindDispatcher:
                     self._cv.wait()
                 if self._stopped and not self._q:
                     return
-                keys, hosts, pods = self._q.pop(0)
+                keys, hosts, pods, set_node_name = self._q.pop(0)
+            if set_node_name:
+                # Deferred record walk: tolist + setattr over the whole
+                # batch runs here, off the scheduling cycle (idempotent
+                # — the failure path may re-run it after a resync).
+                keys = keys.tolist()
+                hosts = hosts.tolist()
+                pods = pods.tolist()
+                for pod, hostname in zip(pods, hosts):
+                    pod.node_name = hostname
             failed: List[str] = []
             bind_keys = getattr(self._binder, "bind_keys", None)
             batch_ok = False

@@ -230,10 +230,14 @@ class ClusterStore:
 
     # -------------------------------------------------- async bind machinery
 
-    def dispatch_binds(self, keys, hosts, pods) -> None:
+    def dispatch_binds(self, keys, hosts, pods,
+                       set_node_name: bool = False) -> None:
         """Queue a batch of binds on the background dispatcher (the
         goroutine analog); failures surface at the next cycle's
-        ``drain_bind_failures``."""
+        ``drain_bind_failures``.  ``set_node_name`` marks a deferred
+        batch (numpy object arrays): the worker materializes the lists
+        and applies the pod.node_name record walk post-cycle — the
+        reference's API-server-side NodeName write (cache.go:536-552)."""
         if self._bind_dispatcher is None:
             from .bindqueue import BindDispatcher
 
@@ -241,7 +245,8 @@ class ClusterStore:
                 self.binder, self._on_bind_failures,
                 on_success=self._on_bind_success,
             )
-        self._bind_dispatcher.dispatch(keys, hosts, pods)
+        self._bind_dispatcher.dispatch(keys, hosts, pods,
+                                       set_node_name=set_node_name)
 
     def flush_binds(self, timeout: Optional[float] = None) -> bool:
         if self._bind_dispatcher is None:

@@ -341,11 +341,16 @@ class FastCycle:
         self.queue_names = sorted(self.store.queues.keys())
         self.queue_index = {n: i for i, n in enumerate(self.queue_names)}
         self.Qn = len(self.queue_names)
-        self.q_of_job = np.full(Jn, -1, I)
-        for row in range(Jn):
-            qi = self.queue_index.get(m.j_queue[row])
+        # Queue-of-job via the mirror's interned queue codes: one small
+        # code->index LUT instead of a 12k-job dict-lookup loop.
+        lut = np.full(max(len(m.qnames), 1), -1, I)
+        for code, nm in enumerate(m.qnames.items):
+            qi = self.queue_index.get(nm)
             if qi is not None:
-                self.q_of_job[row] = qi
+                lut[code] = qi
+        self.q_of_job = (
+            lut[m.j_queue_code[:Jn]] if Jn else np.full(0, -1, I)
+        )
 
         self.total_res = self.n_alloc[self.n_alive].sum(axis=0) if Nn else np.zeros(R, F)
 
@@ -703,7 +708,11 @@ class FastCycle:
                 # A failed cycle may leave uncommitted status mutations
                 # in the mirror (evictions mid-statement); re-derive
                 # dynamic state from the pod records before the caller
-                # falls back.
+                # falls back.  Deferred bind-record walks (node_name on
+                # committed pods, normally done post-cycle by the bind
+                # dispatcher) must land first or the resync would read
+                # committed pods as unbound and double-schedule them.
+                self._apply_deferred_bind_records()
                 self.m.resync_status(self.store.pods)
                 raise
             if self._evictor is not None:
@@ -712,11 +721,21 @@ class FastCycle:
             self._close()
             self.lanes["close"] = time.perf_counter() - t0
             store.last_cycle_lanes = dict(self.lanes)
+        except BaseException:
+            # Failures AFTER the action loop (evictor flush, close) must
+            # also land the deferred node_name walks before the caller
+            # falls back to the object path — the fallback snapshots pod
+            # RECORDS, and committed-but-unnamed pods would read as
+            # unbound and double-schedule.  Idempotent with the inner
+            # handler's application above.
+            self._apply_deferred_bind_records()
+            raise
         finally:
             # Committed binds dispatch even when close fails: binds are
             # idempotent and the commit bookkeeping already happened.
-            for keys, hosts, pods in self._bind_batches:
-                store.dispatch_binds(keys, hosts, pods)
+            for keys, hosts, pods, set_node_name in self._bind_batches:
+                store.dispatch_binds(keys, hosts, pods,
+                                     set_node_name=set_node_name)
 
     def _evict_machinery(self):
         self._flush_aggr()
@@ -1075,6 +1094,7 @@ class FastCycle:
                     # Commit prep that doesn't need the assignments
                     # overlaps the device solve + transfer wait.
                     req_gather = self.m.c_req.gather(crows)
+                    self._obj_arrays()
                     assigned, never_ready, fit_failed = jax.device_get(
                         (result.assigned, result.never_ready,
                          result.fit_failed)
@@ -1648,9 +1668,9 @@ class FastCycle:
         )
 
         # ---- tasks
+        sj = np.asarray(solve_jobs, np.int64)
         jrank = np.zeros(self.Jn + 1, I)
-        for i, row in enumerate(solve_jobs):
-            jrank[row] = i
+        jrank[sj] = np.arange(J, dtype=I)
         tjob = jrank[self.jobr[task_rows]]
         t_job = np.full((Pp,), -1, I)
         t_job[:P] = tjob
@@ -1700,10 +1720,9 @@ class FastCycle:
         j_min = np.full((Jp,), 1 << 30, I)
         j_queue = np.zeros((Jp,), I)
         j_ready_base = np.zeros((Jp,), I)
-        for i, row in enumerate(solve_jobs):
-            j_min[i] = m.j_minav[row]
-            j_queue[i] = max(self.q_of_job[row], 0)
-            j_ready_base[i] = self.j_ready_base[row]
+        j_min[:J] = m.j_minav[sj]
+        j_queue[:J] = np.maximum(self.q_of_job[sj], 0)
+        j_ready_base[:J] = self.j_ready_base[sj]
         jobs = SolveJobs(
             queue=j_queue, min_available=j_min, ready_base=j_ready_base
         )
@@ -2090,6 +2109,28 @@ class FastCycle:
         bind_keys = getattr(binder, "bind_keys", None)
         notify = store._watchers
         pod_a, key_a, name_a = self._obj_arrays()
+        defer_records = (
+            getattr(store, "async_bind", False)
+            and not notify
+            and not store.n_volume_pods
+            and not m.p_pod_nones
+        )
+        if defer_records:
+            # The reference sets pod.NodeName via the API server on the
+            # async bind, observed later by informers — not inside the
+            # scheduling cycle (cache.go:536-552).  Ship the object
+            # ARRAYS to the bind dispatcher; its worker thread does the
+            # 100k-element tolist + node_name walk post-cycle (~45 ms
+            # off the commit lane at north-star scale).  Cycle-visible
+            # state (mirror arrays) is already updated above; the rare
+            # mid-cycle-failure resync applies the record walk first
+            # (_apply_deferred_bind_records, run()).
+            self._bind_batches.append(
+                (key_a[rows], name_a[nodes_c], pod_a[rows], True)
+            )
+            store.mark_objects_stale()
+            self._record_fit_failures(solve_jobs, fit_failed)
+            return True
         pod_l = pod_a[rows].tolist()
         host_l = name_a[nodes_c].tolist()
         # Tombstoned rows can't be committed in the common case; the
@@ -2163,7 +2204,7 @@ class FastCycle:
             # list append (batches go to the dispatcher at cycle end —
             # see run()); failures surface via drain_bind_failures at
             # the next cycle's start and re-enter Pending with backoff.
-            self._bind_batches.append((keys, hosts, bound_pods))
+            self._bind_batches.append((keys, hosts, bound_pods, False))
         else:
             try:
                 if bind_keys is not None:
@@ -2192,6 +2233,17 @@ class FastCycle:
         store.mark_objects_stale()
         self._record_fit_failures(solve_jobs, fit_failed)
         return True
+
+    def _apply_deferred_bind_records(self) -> None:
+        """Synchronously apply the node_name record walks of deferred
+        bind batches (normally the bind dispatcher's job), flipping
+        their flag so the dispatcher does not redo the work."""
+        for i, (keys, hosts, pods, set_nn) in enumerate(self._bind_batches):
+            if not set_nn:
+                continue
+            for pod, hostname in zip(pods.tolist(), hosts.tolist()):
+                pod.node_name = hostname
+            self._bind_batches[i] = (keys, hosts, pods, False)
 
     def _revert_failed_binds(self, failed_keys, keys: List[str],
                              bound_rows: List[int],

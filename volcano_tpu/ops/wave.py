@@ -206,7 +206,6 @@ def _solve_wave(
     score_prof: jnp.ndarray,  # [U, N] f32 custom scores ([1,1] if unused)
     pid: jnp.ndarray,  # [P] int32 global profile id per task
     wave_prof: jnp.ndarray,  # [NW, U_MAX] int32 profile ids present per wave
-    pid_local: jnp.ndarray,  # [P] int32 index into the wave's profile list
     wave_terms: jnp.ndarray,  # [NW, EW] int32 term ids per wave (pad=dummy)
     wave: int,
     n_waves: int,
@@ -230,7 +229,6 @@ def _solve_wave(
     P = tasks.job.shape[0]
     R = prof.req.shape[1]
     pid = pid.astype(jnp.int32)
-    pid_local = pid_local.astype(jnp.int32)
     N = nodes.idle.shape[0]
     J = jobs.min_available.shape[0]
     A = prof.aff_bits.shape[1]
@@ -335,7 +333,15 @@ def _solve_wave(
         jraw = sl(tjob)
         real_w = sl(tasks.real)
         is_first_w = sl(is_first)
-        pid_l = sl(pid_local)  # [W] -> rows of this wave's profile list
+        # Index of each task's profile in this wave's presence list,
+        # recomputed on device: every pid in the wave appears in
+        # wave_prof[w] by construction, so the equality argmax is exact
+        # — and a [W, UM] compare beats shipping a [P] vector through
+        # the tunnel.
+        pid_w = sl(pid)
+        pid_l = jnp.argmax(
+            pid_w[:, None] == wave_prof[w][None, :], axis=1
+        ).astype(jnp.int32)
 
         # Job window: job ids of a wave form a contiguous range (tasks are
         # job-contiguous), so job state lives in [W]-sized locals.
@@ -1545,17 +1551,17 @@ def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
     wave degenerate to the full profile table at scale; explicit presence
     lists keep UM at (distinct profiles per wave), padded to a power of
     two across waves to bound recompilation.  Padding repeats the wave's
-    first profile (read-only duplication).  Returns (wave_prof [NW, UM],
-    pid_local [P]).
+    first profile (read-only duplication).  Returns wave_prof [NW, UM];
+    the per-task index into its wave's list is recomputed on device (a
+    [W, UM] equality argmax per wave beats shipping a [P] vector through
+    the tunnel).
     """
     seg = pid.reshape(n_waves, wave)
     lists = []
-    invs = []
     um = 1
     for w in range(n_waves):
-        u, inv = np.unique(seg[w], return_inverse=True)
+        u = np.unique(seg[w])
         lists.append(u)
-        invs.append(inv)
         um = max(um, len(u))
     UM = 1
     while UM < um:
@@ -1564,8 +1570,7 @@ def _wave_profiles(pid: np.ndarray, n_waves: int, wave: int):
     for w, u in enumerate(lists):
         wave_prof[w, :len(u)] = u
         wave_prof[w, len(u):] = u[0]
-    pid_local = np.concatenate(invs).astype(np.int32)
-    return wave_prof, pid_local
+    return wave_prof
 
 
 def _pad_tasks(tasks: SolveTasks, pad: int) -> SolveTasks:
@@ -1703,7 +1708,7 @@ def solve_wave(
             ])
     else:
         score_prof = np.zeros((1, 1), np.float32)
-    wave_prof, pid_local = _wave_profiles(pid, n_waves, wave)
+    wave_prof = _wave_profiles(pid, n_waves, wave)
     # Input diet for the device call: the kernel reads only job/real
     # per-task (req/init_req come from profile gathers), so every other
     # per-task field ships as a [1, ...] dummy, and the three [P] id
@@ -1734,7 +1739,6 @@ def solve_wave(
     )
     if int(profiles.req.shape[0]) < 32767:
         pid = _put(np.asarray(pid).astype(np.int16))
-        pid_local = _put(np.asarray(pid_local).astype(np.int16))
     if int(jobs.min_available.shape[0]) < 32767:
         job_h = _np(job_in)
         if job_h.dtype != np.int16:
@@ -1848,7 +1852,7 @@ def solve_wave(
     with jax.default_matmul_precision("float32"):
         res = _solve_wave(
             nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
-            profiles, extra_prof, score_prof, pid, wave_prof, pid_local,
+            profiles, extra_prof, score_prof, pid, wave_prof,
             wave_terms,
             wave=wave, n_waves=n_waves, ew=ew, features=features,
             terms_disjoint=terms_disjoint,
