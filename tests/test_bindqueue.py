@@ -371,3 +371,20 @@ def test_deferred_record_walk_applies_before_failure_resync():
     assert len(named) == 32
     store.flush_binds(timeout=30)
     store.close()
+
+
+def test_apply_pending_bind_records_covers_undispatched_batches():
+    """Deferred record walks register with the STORE at commit time, so
+    a failure path can force them even when the dispatcher worker has
+    not processed the batch yet (prior-cycle coverage)."""
+    store = synthetic_cluster(n_nodes=4, n_pods=32, gang_size=4, seed=7)
+    store.async_bind = True
+    Scheduler(store).run_once()
+    # Do NOT flush: force synchronously, racing (idempotently) with the
+    # worker thread.
+    store.apply_pending_bind_records()
+    named = [p for p in store.pods.values() if p.node_name]
+    assert len(named) == 32
+    store.flush_binds(timeout=30)
+    assert len(store.binder.binds) == 32
+    store.close()

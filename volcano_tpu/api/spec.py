@@ -21,6 +21,14 @@ from .types import PodGroupPhase, QueueState, TaskStatus
 # scheduling.k8s.io/group-name (v1beta1/types.go KubeGroupNameAnnotationKey).
 GROUP_NAME_ANNOTATION = "scheduling.volcano-tpu/group-name"
 
+# Critical-pod exemption set (conformance.go:44-66): system priority
+# classes and the system namespace.  Canonical here — the conformance
+# plugin, the evict machinery, and the mirror's p_critical column all
+# consume these.
+SYSTEM_CLUSTER_CRITICAL = "system-cluster-critical"
+SYSTEM_NODE_CRITICAL = "system-node-critical"
+SYSTEM_NAMESPACE = "kube-system"
+
 _uid_counter = itertools.count(1)
 _ts_counter = itertools.count(1)
 

@@ -38,10 +38,12 @@ class BindDispatcher:
 
     def __init__(self, binder,
                  on_failure: Callable[[List[Tuple[str, object]]], None],
-                 on_success: Optional[Callable[[List[str], List[str]], None]] = None):
+                 on_success: Optional[Callable[[List[str], List[str]], None]] = None,
+                 materialize: Optional[Callable[[list], tuple]] = None):
         self._binder = binder
         self._on_failure = on_failure
         self._on_success = on_success
+        self._materialize = materialize
         self._q: List[Tuple[Sequence[str], Sequence[str], Sequence[object]]] = []
         self._cv = threading.Condition()
         self._stopped = False
@@ -53,12 +55,13 @@ class BindDispatcher:
 
     def dispatch(self, keys: Sequence[str], hosts: Sequence[str],
                  pods: Sequence[object],
-                 set_node_name: bool = False) -> None:
-        """``set_node_name`` batches arrive as numpy object arrays; the
-        worker materializes lists and applies the pod.node_name record
-        walk off the scheduling cycle's critical path."""
+                 entry: Optional[list] = None) -> None:
+        """Deferred batches pass ``entry`` (from the store's
+        ``defer_bind_records``); the worker materializes lists and
+        applies the pod.node_name record walk off the scheduling
+        cycle's critical path."""
         with self._cv:
-            self._q.append((keys, hosts, pods, set_node_name))
+            self._q.append((keys, hosts, pods, entry))
             self._inflight += 1
             self._cv.notify()
 
@@ -92,16 +95,13 @@ class BindDispatcher:
                     self._cv.wait()
                 if self._stopped and not self._q:
                     return
-                keys, hosts, pods, set_node_name = self._q.pop(0)
-            if set_node_name:
+                keys, hosts, pods, entry = self._q.pop(0)
+            if entry is not None:
                 # Deferred record walk: tolist + setattr over the whole
                 # batch runs here, off the scheduling cycle (idempotent
-                # — the failure path may re-run it after a resync).
-                keys = keys.tolist()
-                hosts = hosts.tolist()
-                pods = pods.tolist()
-                for pod, hostname in zip(pods, hosts):
-                    pod.node_name = hostname
+                # — a failure path may already have forced it through
+                # the store's apply_pending_bind_records).
+                keys, hosts, pods = self._materialize(entry)
             failed: List[str] = []
             bind_keys = getattr(self._binder, "bind_keys", None)
             batch_ok = False

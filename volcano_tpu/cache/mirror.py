@@ -38,7 +38,13 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..api import Pod, TaskStatus
+from ..api import (
+    SYSTEM_CLUSTER_CRITICAL,
+    SYSTEM_NAMESPACE,
+    SYSTEM_NODE_CRITICAL,
+    Pod,
+    TaskStatus,
+)
 from ..api.resource import Resource
 
 F = np.float32
@@ -46,6 +52,15 @@ I = np.int32
 
 HOSTNAME_KEY = "kubernetes.io/hostname"
 JOB_SELECTOR = "__job__"
+
+# PodGroup phase -> j_phase_code (fastpath._PHASE_CODE coding: 0 = no
+# PodGroup, 5 = any other phase incl. "").
+_PG_PHASE_CODE = {
+    "Pending": 1,
+    "Inqueue": 2,
+    "Running": 3,
+    "Unknown": 4,
+}
 
 # TaskStatus values are bit flags; keep them in int16 columns.
 _OCCUPYING = (
@@ -221,6 +236,11 @@ class StoreMirror:
         self.p_be = np.zeros(cap, bool)  # best-effort (empty init_req)
         self.p_has_ip = np.zeros(cap, bool)  # has inter-pod terms
         self.p_has_tol = np.zeros(cap, bool)  # has tolerations
+        # Critical (conformance-exempt) pods, precomputed at add time
+        # (conformance.go:44-66: system priority classes / kube-system):
+        # the evict machinery reads this as a column instead of walking
+        # 40k pod objects per session.
+        self.p_critical = np.zeros(cap, bool)
         self.p_prof = np.zeros(cap, I)  # task profile id (self.profiles)
         self.c_req = CSRColumn(has_val=True)
         self.c_init_req = CSRColumn(has_val=True)
@@ -273,6 +293,28 @@ class StoreMirror:
         self.qnames = Interner()
         self.j_ns_code = np.zeros(jcap, I)
         self.j_queue_code = np.zeros(jcap, I)
+        # PodGroup object ref + status snapshot columns, maintained by
+        # upsert (every store add/update funnels through it) and written
+        # through by the fast path's close write-back: the cycle reads
+        # them as views instead of re-walking 45k PodGroup objects per
+        # derive.  Phase coding matches fastpath._PHASE_CODE (0 = no
+        # PodGroup, 5 = any other phase).
+        self.j_pg: List[Optional[object]] = []
+        self.j_phase_code = np.zeros(jcap, np.int8)
+        self.j_st_run = np.zeros(jcap, I)
+        self.j_st_fail = np.zeros(jcap, I)
+        self.j_st_succ = np.zeros(jcap, I)
+        # Process-local hash of the Unschedulable condition last written
+        # (0 = none): close skips the per-object condition scan/rewrite
+        # for persistently-unschedulable jobs without touching the
+        # PodGroup at all.  Refreshed from the object on upsert so
+        # external status writers stay coherent.
+        self.j_cond_sig = np.zeros(jcap, np.int64)
+        # Prebuilt per-job metric label tuple (("job_name", name),) and
+        # event key ("PodGroup/ns/name"): close consumes 25k of each per
+        # config-4 cycle.
+        self.j_gauge_key: List[Optional[tuple]] = []
+        self.j_event_key: List[str] = []
         self.j_alive = np.zeros(jcap, bool)
         # Toleration specs per pod row (matched lazily per cycle, because
         # the taint dictionary may grow after the pod was added).
@@ -519,6 +561,7 @@ class StoreMirror:
         self.p_be = _grow(self.p_be, n)
         self.p_has_ip = _grow(self.p_has_ip, n)
         self.p_has_tol = _grow(self.p_has_tol, n)
+        self.p_critical = _grow(self.p_critical, n)
         self.p_prof = _grow(self.p_prof, n)
         self.p_aff_lo = _grow(self.p_aff_lo, n)
         self.p_aff_hi = _grow(self.p_aff_hi, n)
@@ -536,6 +579,11 @@ class StoreMirror:
         self.p_be[row] = feat.best_effort
         self.p_has_ip[row] = feat.has_ip
         self.p_has_tol[row] = bool(feat.tol)
+        self.p_critical[row] = (
+            pod.priority_class in (SYSTEM_CLUSTER_CRITICAL,
+                                   SYSTEM_NODE_CRITICAL)
+            or pod.namespace == SYSTEM_NAMESPACE
+        )
         self.p_prof[row] = self.profiles.intern(feat.key)
 
         self.c_req.append(*feat.req)
@@ -718,11 +766,20 @@ class StoreMirror:
             self.j_alive = _grow(self.j_alive, n)
             self.j_ns_code = _grow(self.j_ns_code, n)
             self.j_queue_code = _grow(self.j_queue_code, n)
+            self.j_phase_code = _grow(self.j_phase_code, n)
+            self.j_st_run = _grow(self.j_st_run, n)
+            self.j_st_fail = _grow(self.j_st_fail, n)
+            self.j_st_succ = _grow(self.j_st_succ, n)
+            self.j_cond_sig = _grow(self.j_cond_sig, n)
             self.j_queue.append("default")
             self.j_ns.append("default")
+            self.j_pg.append(None)
+            self.j_gauge_key.append(None)
+            self.j_event_key.append("")
             self.j_ns_code[row] = self.ns_names.intern("default")
             self.j_queue_code[row] = self.qnames.intern("default")
             self.j_alive[row] = False
+            self.j_phase_code[row] = 0
             self._j_uid_rank = None
         return row
 
@@ -752,6 +809,19 @@ class StoreMirror:
         self.j_ns_code[row] = self.ns_names.intern(pg.namespace)
         self.j_queue_code[row] = self.qnames.intern(pg.queue)
         self.j_alive[row] = True
+        self.j_pg[row] = pg
+        self.j_gauge_key[row] = (("job_name", pg.name),)
+        self.j_event_key[row] = f"PodGroup/{pg.namespace}/{pg.name}"
+        st = pg.status
+        self.j_phase_code[row] = _PG_PHASE_CODE.get(st.phase, 5)
+        self.j_st_run[row] = st.running
+        self.j_st_fail[row] = st.failed
+        self.j_st_succ[row] = st.succeeded
+        sig = 0
+        for c in st.conditions:
+            if c.type == "Unschedulable" and c.status == "True":
+                sig = hash((c.reason, c.message)) & 0x7FFFFFFFFFFFFFFF
+        self.j_cond_sig[row] = sig
         # Precompute the dense MinResources vector at add time (unknown
         # scalar names are interned like pod requests are), so enqueue's
         # budget walk never parses resource quantities in-cycle.
@@ -777,6 +847,9 @@ class StoreMirror:
         row = self.j_row.get(uid)
         if row is not None:
             self.j_alive[row] = False
+            self.j_pg[row] = None
+            self.j_phase_code[row] = 0
+            self.j_cond_sig[row] = 0
 
     # ========================================================== maintenance
 
@@ -798,6 +871,9 @@ class StoreMirror:
                      "c_n_taints", "node_objs", "domains", "j_uid", "j_row",
                      "j_minav", "j_prio", "j_create", "j_queue", "j_ns",
                      "ns_names", "qnames", "j_ns_code", "j_queue_code",
+                     "j_pg", "j_phase_code", "j_st_run", "j_st_fail",
+                     "j_st_succ", "j_cond_sig", "j_gauge_key",
+                     "j_event_key",
                      "j_alive", "_pods_ref", "_orphans", "epoch"):
             setattr(fresh, attr, getattr(old, attr))
         fresh._node_dom_dirty = True
@@ -814,7 +890,8 @@ class StoreMirror:
             fresh.p_row[uid] = len(fresh.p_uid) - 1
         n = len(live)
         for name in ("p_status", "p_node", "p_job", "p_prio", "p_create",
-                     "p_alive", "p_be", "p_has_ip", "p_has_tol", "p_prof"):
+                     "p_alive", "p_be", "p_has_ip", "p_has_tol",
+                     "p_critical", "p_prof"):
             arr = getattr(old, name)[:total][live]
             setattr(fresh, name, arr.copy())
         # CSR columns: re-append per live row (vectorized gather then bulk).

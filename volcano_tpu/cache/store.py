@@ -153,6 +153,15 @@ class ClusterStore:
             _collections.OrderedDict()
         )
         self._events_lock = threading.Lock()
+        # Whole batches parked by record_events_deferred, folded into
+        # the trails at the next read/record (off the cycle's clock).
+        self._deferred_events: List[tuple] = []
+
+        # Deferred bind-record walks not yet materialized (see
+        # defer_bind_records): registered at commit time so failure
+        # paths can force them before reading pod records.
+        self._record_walk_lock = threading.Lock()
+        self._pending_record_walks: List[list] = []
 
         # Create the default queue at startup, weight 1 (cache.go:244-254).
         self.add_queue(Queue(name=default_queue, weight=1))
@@ -171,6 +180,7 @@ class ClusterStore:
 
         now = _time.time()
         with self._events_lock:
+            self._drain_deferred_events_locked()
             self._record_event_locked(key, reason, message, now)
 
     def _record_event_locked(self, key, reason, message, now) -> None:
@@ -211,17 +221,43 @@ class ClusterStore:
                         break
             if len(tail) >= self.MAX_EVENT_OBJECTS:
                 with self._events_lock:
+                    # Parked deferred batches are older than this bulk:
+                    # the clear below would evict them anyway; drop them
+                    # so a later drain cannot resurrect them out of
+                    # order.
+                    self._deferred_events.clear()
                     self._events.clear()
                     # reversed() above collected newest-first; restore
                     # insertion order oldest-first for FIFO eviction.
                     self._events.update(reversed(tail.items()))
                 return
         with self._events_lock:
+            self._drain_deferred_events_locked()
+            for key, reason, message in items:
+                self._record_event_locked(key, reason, message, now)
+
+    def record_events_deferred(self, items) -> None:
+        """O(1) enqueue of a whole event batch; the per-event trail
+        bookkeeping (~2 us each — 90 ms for a config-4 eviction cycle's
+        45k events) runs at the next read/record instead of inside the
+        scheduling cycle.  The reference's event recorder is likewise an
+        async broadcaster the control loops feed."""
+        import time as _time
+
+        with self._events_lock:
+            self._deferred_events.append((_time.time(), items))
+
+    def _drain_deferred_events_locked(self) -> None:
+        if not self._deferred_events:
+            return
+        batches, self._deferred_events = self._deferred_events, []
+        for now, items in batches:
             for key, reason, message in items:
                 self._record_event_locked(key, reason, message, now)
 
     def events_for(self, key: str) -> List[dict]:
         with self._events_lock:
+            self._drain_deferred_events_locked()
             return [
                 {"reason": r, "message": m, "count": c,
                  "first_seen": f, "last_seen": l}
@@ -230,23 +266,65 @@ class ClusterStore:
 
     # -------------------------------------------------- async bind machinery
 
+    def defer_bind_records(self, keys_a, hosts_a, pods_a) -> list:
+        """Register a deferred bind batch (numpy object arrays).  The
+        100k-element tolist + pod.node_name record walk runs when the
+        batch is materialized — normally on the bind dispatcher's worker
+        thread, post-cycle (the reference's API-server-side NodeName
+        write, cache.go:536-552) — but any failure path that is about to
+        read pod RECORDS as scheduling truth must force it first via
+        ``apply_pending_bind_records`` (committed-but-unnamed pods would
+        read as unbound and double-schedule)."""
+        entry = [keys_a, hosts_a, pods_a, False]
+        with self._record_walk_lock:
+            self._pending_record_walks.append(entry)
+        return entry
+
+    def _materialize_bind_entry(self, entry: list):
+        """Idempotent: lists + node_name walk applied exactly once, from
+        whichever thread gets here first."""
+        with self._record_walk_lock:
+            if not entry[3]:
+                keys = entry[0].tolist()
+                hosts = entry[1].tolist()
+                pods = entry[2].tolist()
+                for pod, hostname in zip(pods, hosts):
+                    pod.node_name = hostname
+                entry[0], entry[1], entry[2] = keys, hosts, pods
+                entry[3] = True
+                try:
+                    self._pending_record_walks.remove(entry)
+                except ValueError:
+                    pass
+            return entry[0], entry[1], entry[2]
+
+    def apply_pending_bind_records(self) -> None:
+        """Synchronously apply every registered deferred record walk —
+        called before any path that treats pod records as scheduling
+        truth (mirror resync, the object-session fallback)."""
+        while True:
+            with self._record_walk_lock:
+                if not self._pending_record_walks:
+                    return
+                entry = self._pending_record_walks[0]
+            self._materialize_bind_entry(entry)
+
     def dispatch_binds(self, keys, hosts, pods,
-                       set_node_name: bool = False) -> None:
+                       entry: Optional[list] = None) -> None:
         """Queue a batch of binds on the background dispatcher (the
         goroutine analog); failures surface at the next cycle's
-        ``drain_bind_failures``.  ``set_node_name`` marks a deferred
-        batch (numpy object arrays): the worker materializes the lists
-        and applies the pod.node_name record walk post-cycle — the
-        reference's API-server-side NodeName write (cache.go:536-552)."""
+        ``drain_bind_failures``.  ``entry`` marks a deferred batch from
+        ``defer_bind_records``: the worker materializes it at process
+        time (pass keys/hosts/pods as None)."""
         if self._bind_dispatcher is None:
             from .bindqueue import BindDispatcher
 
             self._bind_dispatcher = BindDispatcher(
                 self.binder, self._on_bind_failures,
                 on_success=self._on_bind_success,
+                materialize=self._materialize_bind_entry,
             )
-        self._bind_dispatcher.dispatch(keys, hosts, pods,
-                                       set_node_name=set_node_name)
+        self._bind_dispatcher.dispatch(keys, hosts, pods, entry=entry)
 
     def flush_binds(self, timeout: Optional[float] = None) -> bool:
         if self._bind_dispatcher is None:

@@ -95,6 +95,11 @@ _PHASE_BY_CODE = {
     3: PodGroupPhase.Running.value,
     4: PodGroupPhase.Unknown.value,
 }
+# Vector form for the close write-back (codes 1-4 only; index 0/5 unused).
+_PHASE_STR_BY_CODE = np.array(
+    ["", _PHASE_BY_CODE[1], _PHASE_BY_CODE[2], _PHASE_BY_CODE[3],
+     _PHASE_BY_CODE[4], ""], object,
+)
 
 
 def _pow2(n: int, minimum: int = 8) -> int:
@@ -359,37 +364,20 @@ class FastCycle:
         self.session_jobs = [
             row for row in range(Jn) if m.j_alive[row]
         ]
-        # One pass over the podgroup dict serves every later consumer
-        # (_enqueue / _schedulable_rows / _close previously each paid a
-        # 12k+-element dict-lookup loop).  j_phase codes (_PHASE_CODE):
-        # 0 = missing, 1 = Pending, 2 = Inqueue, 3 = Running,
-        # 4 = Unknown, 5 = other — the full coding lets _close compute
-        # its jobStatus write-back vectorized instead of re-reading
-        # 12k PodGroup objects.  The j_st_* arrays snapshot the
-        # last-written status counters for the same change detection.
-        pgs = self.store.pod_groups
-        j_pgs: List[Optional[object]] = [None] * Jn
-        j_phase = np.zeros(Jn, np.int8)
-        j_st_run = np.zeros(Jn, I)
-        j_st_fail = np.zeros(Jn, I)
-        j_st_succ = np.zeros(Jn, I)
-        phase_code = _PHASE_CODE
-        j_uid = m.j_uid
-        for row in self.session_jobs:
-            pg = pgs.get(j_uid[row])
-            if pg is None:
-                continue
-            j_pgs[row] = pg
-            st = pg.status
-            j_phase[row] = phase_code.get(st.phase, 5)
-            j_st_run[row] = st.running
-            j_st_fail[row] = st.failed
-            j_st_succ[row] = st.succeeded
-        self.j_pgs = j_pgs
-        self.j_phase = j_phase
-        self.j_st_run = j_st_run
-        self.j_st_fail = j_st_fail
-        self.j_st_succ = j_st_succ
+        # PodGroup refs + status snapshot come straight from the mirror's
+        # incrementally-maintained columns (every store add/update
+        # funnels through upsert_pod_group) instead of a 45k-object walk
+        # per derive.  j_phase codes (_PHASE_CODE): 0 = missing,
+        # 1 = Pending, 2 = Inqueue, 3 = Running, 4 = Unknown, 5 = other.
+        # The VIEWS alias the mirror arrays on purpose: the cycle's
+        # in-place transitions (enqueue's Pending -> Inqueue) and the
+        # close write-back update "last written" state that must persist
+        # across cycles.
+        self.j_pgs = m.j_pg
+        self.j_phase = m.j_phase_code[:Jn]
+        self.j_st_run = m.j_st_run[:Jn]
+        self.j_st_fail = m.j_st_fail[:Jn]
+        self.j_st_succ = m.j_st_succ[:Jn]
 
     # ---------------------------------------------------------- resources
 
@@ -711,8 +699,10 @@ class FastCycle:
                 # falls back.  Deferred bind-record walks (node_name on
                 # committed pods, normally done post-cycle by the bind
                 # dispatcher) must land first or the resync would read
-                # committed pods as unbound and double-schedule them.
-                self._apply_deferred_bind_records()
+                # committed pods as unbound and double-schedule them —
+                # including batches a PRIOR cycle dispatched that the
+                # worker has not yet processed.
+                store.apply_pending_bind_records()
                 self.m.resync_status(self.store.pods)
                 raise
             if self._evictor is not None:
@@ -728,14 +718,13 @@ class FastCycle:
             # RECORDS, and committed-but-unnamed pods would read as
             # unbound and double-schedule.  Idempotent with the inner
             # handler's application above.
-            self._apply_deferred_bind_records()
+            store.apply_pending_bind_records()
             raise
         finally:
             # Committed binds dispatch even when close fails: binds are
             # idempotent and the commit bookkeeping already happened.
-            for keys, hosts, pods, set_node_name in self._bind_batches:
-                store.dispatch_binds(keys, hosts, pods,
-                                     set_node_name=set_node_name)
+            for keys, hosts, pods, entry in self._bind_batches:
+                store.dispatch_binds(keys, hosts, pods, entry=entry)
 
     def _evict_machinery(self):
         self._flush_aggr()
@@ -2038,7 +2027,6 @@ class FastCycle:
         jrank_never = never_ready[:len(solve_jobs)]
         committed = assigned >= 0
         if not committed.any():
-            self._record_fit_failures(solve_jobs, fit_failed)
             return False
 
         rows = task_rows[committed]
@@ -2118,18 +2106,20 @@ class FastCycle:
         if defer_records:
             # The reference sets pod.NodeName via the API server on the
             # async bind, observed later by informers — not inside the
-            # scheduling cycle (cache.go:536-552).  Ship the object
-            # ARRAYS to the bind dispatcher; its worker thread does the
-            # 100k-element tolist + node_name walk post-cycle (~45 ms
-            # off the commit lane at north-star scale).  Cycle-visible
-            # state (mirror arrays) is already updated above; the rare
-            # mid-cycle-failure resync applies the record walk first
-            # (_apply_deferred_bind_records, run()).
-            self._bind_batches.append(
-                (key_a[rows], name_a[nodes_c], pod_a[rows], True)
+            # scheduling cycle (cache.go:536-552).  Register the object
+            # ARRAYS with the store and ship the entry to the bind
+            # dispatcher; its worker thread does the 100k-element tolist
+            # + node_name walk post-cycle (~45 ms off the commit lane at
+            # north-star scale).  Cycle-visible state (mirror arrays) is
+            # already updated above; any failure path about to read pod
+            # records forces the walk first (apply_pending_bind_records
+            # — registration at commit time covers prior cycles' not-
+            # yet-processed batches too).
+            entry = store.defer_bind_records(
+                key_a[rows], name_a[nodes_c], pod_a[rows]
             )
+            self._bind_batches.append((None, None, None, entry))
             store.mark_objects_stale()
-            self._record_fit_failures(solve_jobs, fit_failed)
             return True
         pod_l = pod_a[rows].tolist()
         host_l = name_a[nodes_c].tolist()
@@ -2204,7 +2194,7 @@ class FastCycle:
             # list append (batches go to the dispatcher at cycle end —
             # see run()); failures surface via drain_bind_failures at
             # the next cycle's start and re-enter Pending with backoff.
-            self._bind_batches.append((keys, hosts, bound_pods, False))
+            self._bind_batches.append((keys, hosts, bound_pods, None))
         else:
             try:
                 if bind_keys is not None:
@@ -2231,19 +2221,7 @@ class FastCycle:
                 store._notify("Pod", "bind", pod)
 
         store.mark_objects_stale()
-        self._record_fit_failures(solve_jobs, fit_failed)
         return True
-
-    def _apply_deferred_bind_records(self) -> None:
-        """Synchronously apply the node_name record walks of deferred
-        bind batches (normally the bind dispatcher's job), flipping
-        their flag so the dispatcher does not redo the work."""
-        for i, (keys, hosts, pods, set_nn) in enumerate(self._bind_batches):
-            if not set_nn:
-                continue
-            for pod, hostname in zip(pods.tolist(), hosts.tolist()):
-                pod.node_name = hostname
-            self._bind_batches[i] = (keys, hosts, pods, False)
 
     def _revert_failed_binds(self, failed_keys, keys: List[str],
                              bound_rows: List[int],
@@ -2299,15 +2277,6 @@ class FastCycle:
             # cleared, so shared claims held by co-failed pods free up).
             if bound_pods[i].volumes:
                 self.store.release_claims_for(bound_pods[i])
-
-    def _record_fit_failures(self, solve_jobs: List[int],
-                             fit_failed: np.ndarray) -> None:
-        self._fit_failed_rows = getattr(self, "_fit_failed_rows", set())
-        hits = np.flatnonzero(fit_failed[:len(solve_jobs)])
-        if len(hits):
-            self._fit_failed_rows.update(
-                np.asarray(solve_jobs, np.int64)[hits].tolist()
-            )
 
     # ------------------------------------------------------------ backfill
 
@@ -2471,7 +2440,6 @@ class FastCycle:
         actually write back."""
         m = self.m
         store = self.store
-        fit_failed = getattr(self, "_fit_failed_rows", set())
         srows = np.asarray(self.session_jobs, np.int64)
         if not len(srows):
             if self._has("gang"):
@@ -2493,49 +2461,95 @@ class FastCycle:
             retry_keys = []
             unready_counts = (
                 m.j_minav[unready] - self.j_ready_base[unready]
-            ).tolist()
-            for row, n_unready in zip(unready.tolist(), unready_counts):
-                msg = self._gang_message(row, row in fit_failed)
-                pg = self.j_pgs[row]
-                if pg is not None:
-                    # Condition refresh throttling (job_updater.go
-                    # isPodGroupConditionsUpdated): an existing
-                    # Unschedulable condition differing only in
-                    # transition id is "the same" — keep it instead of
-                    # rewriting every cycle for persistently
-                    # unschedulable jobs.
-                    existing = next(
-                        (c for c in pg.status.conditions
-                         if c.type == POD_GROUP_UNSCHEDULABLE), None
-                    )
-                    if (
-                        existing is None
-                        or existing.status != "True"
-                        or existing.reason != "NotEnoughResources"
-                        or existing.message != msg
-                    ):
-                        conditions = [
-                            c for c in pg.status.conditions
-                            if c.type != POD_GROUP_UNSCHEDULABLE
-                        ]
-                        conditions.append(PodGroupCondition(
-                            type=POD_GROUP_UNSCHEDULABLE,
-                            status="True",
-                            transition_id=self.uid,
-                            reason="NotEnoughResources",
-                            message=msg,
-                        ))
-                        pg.status.conditions = conditions
-                        cond_changed[row] = True
-                        gang_events.append((
-                            f"PodGroup/{pg.namespace}/{pg.name}",
-                            "Unschedulable", msg,
-                        ))
-                key = (("job_name", m.j_uid[row].split("/")[-1]),)
-                gauge_pairs.append((key, n_unready))
-                retry_keys.append(key)
+            )
+            if len(unready):
+                # Group-wise messages: jobs sharing (status counts,
+                # minAvailable, unready, total) share the message text,
+                # so one np.unique + one build per GROUP replaces 25k
+                # per-row memo probes at config-4 scale.
+                counts = self._ensure_status_counts()
+                comp = np.concatenate([
+                    counts[unready],
+                    m.j_minav[unready][:, None].astype(np.int64),
+                    unready_counts[:, None].astype(np.int64),
+                    self.j_cnt_total[unready][:, None].astype(np.int64),
+                ], axis=1)
+                # 1-D composite hash (np.unique axis=0 pays a 66 ms void
+                # argsort at 25k rows): two independent wrapping dot
+                # products; a colliding pair would merely share message
+                # text, at ~2^-100 odds over the row space.
+                rng = np.random.RandomState(0x5EED)
+                with np.errstate(over="ignore"):
+                    hv = (
+                        comp * rng.randint(
+                            1, 1 << 62, size=comp.shape[1]
+                        ).astype(np.int64)[None, :]
+                    ).sum(axis=1)
+                    hv2 = (
+                        comp * rng.randint(
+                            1, 1 << 62, size=comp.shape[1]
+                        ).astype(np.int64)[None, :]
+                    ).sum(axis=1)
+                    hv = hv * np.int64(1_000_003) + hv2
+                _, reps, inv = np.unique(
+                    hv, return_index=True, return_inverse=True
+                )
+                grp_msgs = [
+                    self._gang_message(int(unready[ri])) for ri in reps
+                ]
+                # Same key shape as mirror.upsert_pod_group's refresh:
+                # hash((reason, message)) — the two must match or the
+                # throttle re-fires after every external status write.
+                grp_sigs = np.array(
+                    [hash(("NotEnoughResources", s)) & 0x7FFFFFFFFFFFFFFF
+                     for s in grp_msgs],
+                    np.int64,
+                )
+                sigs = grp_sigs[inv]
+                # Condition refresh throttling (job_updater.go
+                # isPodGroupConditionsUpdated): the mirror keeps the
+                # hash of the Unschedulable condition last written, so
+                # persistently-unschedulable jobs skip the per-object
+                # scan/rewrite entirely.
+                need = np.flatnonzero(sigs != m.j_cond_sig[unready])
+                j_pgs = self.j_pgs
+                uid_l = self.uid
+                cond_sig = m.j_cond_sig
+                for li in need.tolist():
+                    row = int(unready[li])
+                    pg = j_pgs[row]
+                    if pg is None:
+                        continue
+                    msg = grp_msgs[inv[li]]
+                    conditions = [
+                        c for c in pg.status.conditions
+                        if c.type != POD_GROUP_UNSCHEDULABLE
+                    ]
+                    conditions.append(PodGroupCondition(
+                        type=POD_GROUP_UNSCHEDULABLE,
+                        status="True",
+                        transition_id=uid_l,
+                        reason="NotEnoughResources",
+                        message=msg,
+                    ))
+                    pg.status.conditions = conditions
+                    cond_changed[row] = True
+                    cond_sig[row] = sigs[li]
+                    gang_events.append((
+                        m.j_event_key[row]
+                        or f"PodGroup/{pg.namespace}/{pg.name}",
+                        "Unschedulable", msg,
+                    ))
+                jk = m.j_gauge_key
+                uids = m.j_uid
+                retry_keys = [
+                    jk[row] or (("job_name", uids[row].split("/")[-1]),)
+                    for row in unready.tolist()
+                ]
+                gauge_pairs = list(zip(retry_keys,
+                                       unready_counts.tolist()))
             if gang_events:
-                store.record_events(gang_events)
+                store.record_events_deferred(gang_events)
             metrics.unschedule_task_count.set_many(gauge_pairs)
             metrics.job_retry_counts.inc_many(retry_keys)
             metrics.unschedule_job_count.set(len(unready))
@@ -2578,36 +2592,36 @@ class FastCycle:
         idx = np.flatnonzero(changed)
         failed_status_uids = None
         if len(idx):
-            rows_l = srows[idx].tolist()
-            code_l = new_code[idx].tolist()
+            rows_arr = srows[idx]
+            codes = new_code[idx]
+            rows_l = rows_arr.tolist()
             run_l = running_a[idx].tolist()
             fail_l = failed_a[idx].tolist()
             succ_l = succ_a[idx].tolist()
+            # new_code only produces codes 1-4 (all named phases), so the
+            # string lookup vectorizes; the snapshot arrays update in
+            # four vector writes instead of per-row stores.
+            phase_l = _PHASE_STR_BY_CODE[codes].tolist()
+            self.j_phase[rows_arr] = codes
+            self.j_st_run[rows_arr] = running_a[idx]
+            self.j_st_fail[rows_arr] = failed_a[idx]
+            self.j_st_succ[rows_arr] = succ_a[idx]
             j_pgs = self.j_pgs
-            j_phase = self.j_phase
-            phase_by_code = _PHASE_BY_CODE
             updater = store.status_updater
             batch_update = getattr(updater, "update_pod_groups", None)
             update = updater.update_pod_group
             written: List[object] = []
             watchers = store._watchers
-            j_st_run, j_st_fail, j_st_succ = (
-                self.j_st_run, self.j_st_fail, self.j_st_succ
-            )
-            for row, code, running, failed, succeeded in zip(
-                    rows_l, code_l, run_l, fail_l, succ_l):
+            for row, ph, running, failed, succeeded in zip(
+                    rows_l, phase_l, run_l, fail_l, succ_l):
                 pg = j_pgs[row]
                 if pg is None:
                     continue
                 status = pg.status
-                status.phase = phase_by_code.get(code, status.phase)
+                status.phase = ph
                 status.running = running
                 status.failed = failed
                 status.succeeded = succeeded
-                j_phase[row] = code
-                j_st_run[row] = running
-                j_st_fail[row] = failed
-                j_st_succ[row] = succeeded
                 if batch_update is not None:
                     written.append(pg)
                 else:
@@ -2638,11 +2652,10 @@ class FastCycle:
         if failed_status_uids:
             self._phase_dirty.update(failed_status_uids)
 
-    def _gang_message(self, row: int, fit_failed: bool) -> str:
-        """Replicates gang.go's unschedulable message via job.fit_error()."""
-        m = self.m
+    def _ensure_status_counts(self) -> np.ndarray:
         counts = getattr(self, "_status_counts", None)
         if counts is None:
+            m = self.m
             # One scatter pass over the pod axis serves every job (a
             # per-job flatnonzero scan is O(jobs x pods)).
             n_status = int(m.p_status[:self.Pn].max(initial=0)) + 1
@@ -2654,6 +2667,12 @@ class FastCycle:
                 1,
             )
             self._status_counts = counts
+        return counts
+
+    def _gang_message(self, row: int) -> str:
+        """Replicates gang.go's unschedulable message via job.fit_error()."""
+        m = self.m
+        counts = self._ensure_status_counts()
         unready = int(m.j_minav[row] - self.j_ready_base[row])
         total = int(self.j_cnt_total[row])
         key = (counts[row].tobytes(), int(m.j_minav[row]), unready, total)

@@ -52,9 +52,6 @@ ST_PENDING = int(TaskStatus.Pending)
 ST_RUNNING = int(TaskStatus.Running)
 ST_RELEASING = int(TaskStatus.Releasing)
 
-SYSTEM_CLUSTER_CRITICAL = "system-cluster-critical"
-SYSTEM_NODE_CRITICAL = "system-node-critical"
-SYSTEM_NAMESPACE = "kube-system"
 
 
 class EvictState:
@@ -84,20 +81,11 @@ class EvictState:
         self.pipelined_rows: List[int] = []  # rows pipelined this cycle
         self.pipe_node = np.full(Pn, -1, np.int64)
         self.j_waiting = np.zeros(cyc.Jn, np.int64)
-        # Critical (conformance-exempt) pods, resident rows only.
-        self.critical = np.zeros(Pn, bool)
-        pods = cyc.store.pods
-        for r in np.flatnonzero(cyc.resident):
-            uid = m.p_uid[r]
-            pod = pods.get(uid) if uid else None
-            if pod is None:
-                continue
-            if (
-                pod.priority_class in (SYSTEM_CLUSTER_CRITICAL,
-                                       SYSTEM_NODE_CRITICAL)
-                or pod.namespace == SYSTEM_NAMESPACE
-            ):
-                self.critical[r] = True
+        # Critical (conformance-exempt) pods, resident rows only — read
+        # from the mirror's precomputed column instead of a 40k-object
+        # walk per session (conformance.go:44-66 semantics encoded at
+        # pod add time).
+        self.critical = m.p_critical[:Pn] & cyc.resident
         # Residents grouped per node, in row order (NodeInfo.tasks
         # iteration order == pod arrival order).
         self.node_rows: List[List[int]] = [[] for _ in range(Nn)]
@@ -281,18 +269,19 @@ class EvictState:
 
         evictor = store.evictor
         evict_keys = getattr(evictor, "evict_keys", None)
+        # Object-array gathers over the mirror's pod/key columns: the
+        # 20k-victim dict-lookup + f-string walk costs ~60 ms at
+        # config-4 scale.
+        rows_arr = np.asarray(self.evicted_rows, np.int64)
+        pod_a, key_a, _ = c._obj_arrays()
+        pods_l = pod_a[rows_arr].tolist()
+        keys_l = key_a[rows_arr].tolist()
         entries = []  # (row, "ns/name", pod)
-        for row in self.evicted_rows:
-            uid = m.p_uid[row]
-            pod = store.pods.get(uid) if uid else None
+        for row, pod, key in zip(self.evicted_rows, pods_l, keys_l):
             if pod is None:
                 continue
             pod.deleting = True
-            try:
-                pod._mirror_feat = pod._mirror_feat  # keep feature cache
-            except Exception:
-                pass
-            entries.append((row, f"{pod.namespace}/{pod.name}", pod))
+            entries.append((row, key, pod))
         failed = set()
         if evict_keys is not None:
             try:
@@ -336,7 +325,7 @@ class EvictState:
         if failed:
             log.warning("%d evictions failed; pods revert to Running",
                         len(failed))
-        store.record_events(events)
+        store.record_events_deferred(events)
         store.mark_objects_stale()
 
 

@@ -8,13 +8,14 @@ from __future__ import annotations
 
 from typing import List
 
-from ..api import TaskInfo
+from ..api import (
+    SYSTEM_CLUSTER_CRITICAL,
+    SYSTEM_NAMESPACE,
+    SYSTEM_NODE_CRITICAL,
+    TaskInfo,
+)
 
 PLUGIN_NAME = "conformance"
-
-SYSTEM_CLUSTER_CRITICAL = "system-cluster-critical"
-SYSTEM_NODE_CRITICAL = "system-node-critical"
-SYSTEM_NAMESPACE = "kube-system"
 
 
 class ConformancePlugin:

@@ -202,6 +202,16 @@ class Scheduler:
                     log.exception(
                         "Fast path failed; falling back to object session"
                     )
+            # The object session snapshots pod RECORDS as scheduling
+            # truth: force any deferred bind-record walks (node_name on
+            # committed pods, normally applied post-cycle by the bind
+            # dispatcher) before building it, or committed pods read as
+            # unbound and double-schedule.
+            apply_records = getattr(
+                self.store, "apply_pending_bind_records", None
+            )
+            if apply_records is not None:
+                apply_records()
             ssn = open_session(self.store, conf.tiers, conf.configurations)
             try:
                 for name in action_names:
