@@ -51,6 +51,12 @@ void parallel_for(int64_t n, int64_t grain,
   for (auto& t : threads) t.join();
 }
 
+// Wire-frame layout helpers (see the frame codec below).
+inline int64_t vcsnap_align8(int64_t v) { return (v + 7) & ~int64_t{7}; }
+inline int64_t vcsnap_header_bytes(uint8_t ndim) {
+  return vcsnap_align8(8 + 8 * static_cast<int64_t>(ndim) + 8);
+}
+
 }  // namespace
 
 extern "C" {
@@ -129,6 +135,111 @@ void vcsnap_less_equal(const float* l, const float* rhs, const float* eps,
       out[i] = ok;
     }
   });
+}
+
+// ---------------------------------------------------------------------------
+// Multi-array wire frame (the remote-solver snapshot codec).
+//
+// The north-star bridge (BASELINE.json; the cache.go:492-554 RPC-boundary
+// analog): the scheduler-store process ships the per-cycle solver inputs to
+// a separate device-owning solver process as ONE contiguous frame, and the
+// assignment vectors come back the same way.  Layout (little-endian):
+//
+//   [0]  u32 magic 'VCSN'   [4] u32 version (1)   [8] u32 n_arrays
+//   [12] u32 manifest_len   [16] manifest bytes (caller-opaque, e.g. JSON)
+//   then per array, 8-byte aligned:
+//     u8 dtype  u8 ndim  6 pad bytes  i64 dims[ndim]  i64 nbytes
+//     data (8-byte aligned)
+//
+// Parsing returns offsets into the frame so the reader can view array data
+// zero-copy.  The pack is one parallel memcpy pass.
+
+int64_t vcsnap_frame_bytes(const uint8_t* ndims, const int64_t* nbytes,
+                           int32_t n, int64_t manifest_len) {
+  int64_t total = vcsnap_align8(16 + manifest_len);
+  for (int32_t i = 0; i < n; ++i) {
+    total += vcsnap_header_bytes(ndims[i]) + vcsnap_align8(nbytes[i]);
+  }
+  return total;
+}
+
+void vcsnap_frame_pack(const uint8_t* dtypes, const uint8_t* ndims,
+                       const int64_t* dims_flat, const int64_t* nbytes,
+                       const uint8_t* const* srcs, int32_t n,
+                       const uint8_t* manifest, int64_t manifest_len,
+                       uint8_t* out) {
+  uint32_t head[4] = {0x4E534356u, 1u, static_cast<uint32_t>(n),
+                      static_cast<uint32_t>(manifest_len)};
+  std::memcpy(out, head, 16);
+  if (manifest_len) std::memcpy(out + 16, manifest, manifest_len);
+  int64_t off = vcsnap_align8(16 + manifest_len);
+  int64_t dim_off = 0;
+  // First lay down headers and record data offsets, then copy the data
+  // segments in parallel (the large arrays dominate).
+  std::vector<int64_t> data_off(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i) {
+    out[off] = dtypes[i];
+    out[off + 1] = ndims[i];
+    std::memset(out + off + 2, 0, 6);
+    std::memcpy(out + off + 8, dims_flat + dim_off, 8 * ndims[i]);
+    std::memcpy(out + off + 8 + 8 * ndims[i], nbytes + i, 8);
+    off += vcsnap_header_bytes(ndims[i]);
+    data_off[static_cast<size_t>(i)] = off;
+    off += vcsnap_align8(nbytes[i]);
+    dim_off += ndims[i];
+  }
+  parallel_for(n, 1, [&](int64_t b, int64_t e) {
+    for (int64_t i = b; i < e; ++i) {
+      if (nbytes[i]) {
+        std::memcpy(out + data_off[static_cast<size_t>(i)], srcs[i],
+                    static_cast<size_t>(nbytes[i]));
+      }
+    }
+  });
+}
+
+int32_t vcsnap_frame_info(const uint8_t* buf, int64_t len,
+                          int64_t* manifest_off, int64_t* manifest_len) {
+  if (len < 16) return -1;
+  uint32_t head[4];
+  std::memcpy(head, buf, 16);
+  if (head[0] != 0x4E534356u || head[1] != 1u) return -1;
+  if (manifest_off) *manifest_off = 16;
+  if (manifest_len) *manifest_len = static_cast<int64_t>(head[3]);
+  if (16 + static_cast<int64_t>(head[3]) > len) return -1;
+  return static_cast<int32_t>(head[2]);
+}
+
+// Parses headers into caller buffers sized from vcsnap_frame_info's count:
+// dtypes[n], ndims[n], dims_flat[n*8] (max 8 dims), data_off[n], nbytes[n].
+// Returns 0 on success, -1 on malformed input (truncated frame / dim
+// overflow) — the reader must treat the frame as hostile until this
+// validates it.
+int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len, uint8_t* dtypes,
+                            uint8_t* ndims, int64_t* dims_flat,
+                            int64_t* data_off, int64_t* nbytes) {
+  int64_t moff = 0, mlen = 0;
+  int32_t n = vcsnap_frame_info(buf, len, &moff, &mlen);
+  if (n < 0) return -1;
+  int64_t off = vcsnap_align8(16 + mlen);
+  for (int32_t i = 0; i < n; ++i) {
+    if (off + 16 > len) return -1;
+    uint8_t nd = buf[off + 1];
+    if (nd > 8) return -1;
+    if (off + 8 + 8 * static_cast<int64_t>(nd) + 8 > len) return -1;
+    dtypes[i] = buf[off];
+    ndims[i] = nd;
+    std::memcpy(dims_flat + i * 8, buf + off + 8, 8 * nd);
+    int64_t nb;
+    std::memcpy(&nb, buf + off + 8 + 8 * nd, 8);
+    if (nb < 0) return -1;
+    off += vcsnap_header_bytes(nd);
+    if (off + nb > len) return -1;
+    data_off[i] = off;
+    nbytes[i] = nb;
+    off += vcsnap_align8(nb);
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -18,6 +18,21 @@ void vcsnap_less_equal(const float* l, const float* rhs, const float* eps,
                        const uint8_t* scalar_slot, int64_t rows,
                        int32_t r, uint8_t* out);
 
+// Multi-array wire frame (remote-solver snapshot codec; see vcsnap.cc).
+int64_t vcsnap_frame_bytes(const uint8_t* ndims, const int64_t* nbytes,
+                           int32_t n, int64_t manifest_len);
+void vcsnap_frame_pack(const uint8_t* dtypes, const uint8_t* ndims,
+                       const int64_t* dims_flat, const int64_t* nbytes,
+                       const uint8_t* const* srcs, int32_t n,
+                       const uint8_t* manifest, int64_t manifest_len,
+                       uint8_t* out);
+int32_t vcsnap_frame_info(const uint8_t* buf, int64_t len,
+                          int64_t* manifest_off, int64_t* manifest_len);
+int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len,
+                            uint8_t* dtypes, uint8_t* ndims,
+                            int64_t* dims_flat, int64_t* data_off,
+                            int64_t* nbytes);
+
 void* vcreclaim_ctx_new(
     const long long* node_ptr, const long long* node_rows,
     int16_t* p_status, const int32_t* p_job,

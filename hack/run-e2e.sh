@@ -4,4 +4,5 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
-  tests/test_admission_cli.py tests/test_examples.py -q "$@"
+  tests/test_admission_cli.py tests/test_examples.py \
+  tests/test_remote_solver.py -q "$@"

@@ -101,3 +101,38 @@ def test_checkpoint_then_schedule_more(tmp_path):
                       annotations={GROUP_NAME_ANNOTATION: "late"}))
     Scheduler(b).run_once()
     assert any(k.endswith("late-0") for k in b.binder.binds)
+
+
+def test_object_path_status_writes_refresh_mirror_columns():
+    """update_job_status / record_job_condition (the object session's
+    write-back) must re-sync the mirror's persistent j_phase_code /
+    j_st_* / j_cond_sig columns, or the fast path's change detection
+    works off stale 'last written' state after a slow-path cycle."""
+    from volcano_tpu.api import PodGroup, PodGroupCondition
+    from volcano_tpu.cache import ClusterStore
+
+    store = ClusterStore()
+    pg = PodGroup(name="g", min_member=2)
+    store.add_pod_group(pg)
+    m = store.mirror
+    row = m.j_row[pg.uid]
+    assert m.j_phase_code[row] == 1  # Pending
+
+    # Object-path write-back: phase + counters via update_job_status.
+    snap = store.snapshot()
+    job = snap.jobs[pg.uid]
+    job.pod_group.status.phase = "Running"
+    job.pod_group.status.running = 2
+    store.update_job_status(job)
+    assert m.j_phase_code[row] == 3
+    assert m.j_st_run[row] == 2
+
+    # Condition write via record_job_condition refreshes the signature.
+    cond = PodGroupCondition(
+        type="Unschedulable", status="True", transition_id="t",
+        reason="NotEnoughResources", message="0/2 ready",
+    )
+    store.record_job_condition(job, cond)
+    assert m.j_cond_sig[row] == (
+        hash(("NotEnoughResources", "0/2 ready")) & 0x7FFFFFFFFFFFFFFF
+    )

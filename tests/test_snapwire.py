@@ -1,0 +1,94 @@
+"""Wire-frame codec unit tests (csrc/vcsnap.cc vcsnap_frame_* +
+cache/snapwire.py): roundtrip fidelity, native/numpy layout parity,
+hostile-input rejection."""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.cache import snapwire as sw
+
+
+def _cases():
+    rng = np.random.RandomState(7)
+    return [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array([], np.int16),
+        rng.randint(0, 255, (5, 2, 3)).astype(np.uint8),
+        np.array(True),  # 0-dim
+        rng.standard_normal((7,)).astype(np.float64),
+        np.array([[1, -2], [3, 4]], np.int64),
+        np.zeros((2, 0, 3), np.int32),  # zero-size middle dim
+    ]
+
+
+def test_roundtrip_native_or_fallback():
+    arrays = _cases()
+    man = {"op": "solve", "k": [1, 2.5, "x"], "wave": None}
+    buf = sw.encode_frame(arrays, man)
+    m2, arrs2 = sw.decode_frame(buf)
+    assert m2 == man
+    for a, b in zip(arrays, arrs2):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b)
+
+
+def test_fallback_layout_byte_identical(monkeypatch):
+    arrays = _cases()
+    man = {"m": "x"}
+    native = sw.encode_frame(arrays, man)
+    monkeypatch.setattr(sw, "lib_or_none", lambda: None)
+    fallback = sw.encode_frame(arrays, man)
+    assert native == fallback
+    m, arrs = sw.decode_frame(native)  # fallback parser reads native frame
+    assert m == man and all(
+        np.array_equal(a, b) for a, b in zip(arrays, arrs)
+    )
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_malformed_frames_rejected(monkeypatch, use_native):
+    if not use_native:
+        monkeypatch.setattr(sw, "lib_or_none", lambda: None)
+    good = sw.encode_frame([np.arange(4, dtype=np.int32)], {})
+    with pytest.raises(ValueError):
+        sw.decode_frame(b"nope")
+    with pytest.raises(ValueError):
+        sw.decode_frame(good[:20])  # truncated mid-headers
+    bad_magic = b"XXXX" + good[4:]
+    with pytest.raises(ValueError):
+        sw.decode_frame(bad_magic)
+
+
+def test_tree_flatten_roundtrip():
+    from volcano_tpu.ops.allocate import SolveJobs
+
+    arrays: list = []
+    tree = sw.flatten_tree(
+        (SolveJobs(queue=np.zeros(3, np.int32),
+                   min_available=np.ones(3, np.int32),
+                   ready_base=np.zeros(3, np.int32)),
+         None, 2.5, "s", (np.array([1.0], np.float32),)),
+        arrays,
+    )
+    out = sw.unflatten_tree(tree, arrays, {"SolveJobs": SolveJobs})
+    jobs, none_v, f, s, tup = out
+    assert isinstance(jobs, SolveJobs) and none_v is None
+    assert f == 2.5 and s == "s"
+    assert np.array_equal(tup[0], [1.0])
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_hostile_count_and_dtype_rejected(monkeypatch, use_native):
+    """A corrupt header must not size allocations (huge array count) or
+    index dtype tables (out-of-range code) — both parsers reject with
+    ValueError before touching memory."""
+    if not use_native:
+        monkeypatch.setattr(sw, "lib_or_none", lambda: None)
+    # magic+version intact, n_arrays = 0x7FFFFFFF, no manifest
+    evil = np.array([0x4E534356, 1, 0x7FFFFFFF, 0], np.uint32).tobytes()
+    with pytest.raises(ValueError):
+        sw.decode_frame(evil)
+    good = bytearray(sw.encode_frame([np.arange(4, dtype=np.int32)], {}))
+    good[16] = 200  # dtype code out of range
+    with pytest.raises(ValueError):
+        sw.decode_frame(bytes(good))

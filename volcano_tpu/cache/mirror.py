@@ -843,6 +843,27 @@ class StoreMirror:
             except Exception:
                 pass
 
+    def refresh_pod_group_status(self, pg) -> None:
+        """Re-sync the persistent status-snapshot columns (j_phase_code /
+        j_st_* / j_cond_sig) from the PodGroup object.  Every writer that
+        mutates pg.status OUTSIDE the fast path's close (the object
+        session's jobUpdater write-back, condition records) must call
+        this, or the fast path's change detection works off stale
+        'last written' state and skips real writes."""
+        row = self.j_row.get(pg.uid)
+        if row is None:
+            return
+        st = pg.status
+        self.j_phase_code[row] = _PG_PHASE_CODE.get(st.phase, 5)
+        self.j_st_run[row] = st.running
+        self.j_st_fail[row] = st.failed
+        self.j_st_succ[row] = st.succeeded
+        sig = 0
+        for c in st.conditions:
+            if c.type == "Unschedulable" and c.status == "True":
+                sig = hash((c.reason, c.message)) & 0x7FFFFFFFFFFFFFFF
+        self.j_cond_sig[row] = sig
+
     def remove_pod_group(self, uid: str) -> None:
         row = self.j_row.get(uid)
         if row is not None:

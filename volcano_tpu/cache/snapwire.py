@@ -1,0 +1,211 @@
+"""Wire codec for solver snapshots: numpy pytrees <-> one contiguous frame.
+
+The remote-solver bridge (BASELINE.json north star; the reference's two
+planes likewise talk only through serialized API-server state,
+``pkg/scheduler/cache/cache.go:492-554``): the scheduler-store process
+ships each cycle's solver inputs to the device-owning solver process as a
+single frame packed by the C++ serializer (``csrc/vcsnap.cc``
+``vcsnap_frame_pack``), and the assignment vectors return the same way.
+Reads are zero-copy: arrays are numpy views into the received buffer.
+
+A pure-numpy fallback keeps the codec available when the native library
+cannot build; both sides produce byte-identical frames.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..native import lib_or_none
+
+# dtype <-> u8 code (stable wire contract; extend append-only).
+_DTYPES = [
+    np.dtype(np.float32), np.dtype(np.float64), np.dtype(np.int8),
+    np.dtype(np.int16), np.dtype(np.int32), np.dtype(np.int64),
+    np.dtype(np.uint8), np.dtype(np.uint16), np.dtype(np.uint32),
+    np.dtype(np.uint64), np.dtype(np.bool_),
+]
+_DTYPE_CODE = {dt: i for i, dt in enumerate(_DTYPES)}
+
+
+def _align8(v: int) -> int:
+    return (v + 7) & ~7
+
+
+def encode_frame(arrays: List[np.ndarray], manifest: dict) -> bytes:
+    """Pack arrays + a JSON manifest into one frame."""
+    man = json.dumps(manifest, separators=(",", ":")).encode()
+    # ascontiguousarray promotes 0-d to 1-d; restore the scalar shape so
+    # the roundtrip is exact.
+    arrs = [
+        np.ascontiguousarray(a).reshape(np.shape(a)) for a in arrays
+    ]
+    for a in arrs:
+        if a.dtype not in _DTYPE_CODE:
+            raise TypeError(f"unsupported wire dtype {a.dtype}")
+        if a.ndim > 8:
+            raise ValueError(f"unsupported wire ndim {a.ndim}")
+    n = len(arrs)
+    dtypes = np.array([_DTYPE_CODE[a.dtype] for a in arrs], np.uint8)
+    ndims = np.array([a.ndim for a in arrs], np.uint8)
+    dims_flat = np.array(
+        [d for a in arrs for d in a.shape], np.int64
+    ) if n else np.zeros(0, np.int64)
+    nbytes = np.array([a.nbytes for a in arrs], np.int64)
+    lib = lib_or_none()
+    if lib is not None:
+        total = lib.vcsnap_frame_bytes(ndims, nbytes, n, len(man))
+        out = np.zeros(int(total), np.uint8)
+        src_ptrs = (ctypes.POINTER(ctypes.c_uint8) * max(n, 1))()
+        for i, a in enumerate(arrs):
+            src_ptrs[i] = a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        man_arr = np.frombuffer(man or b"\0", np.uint8)
+        lib.vcsnap_frame_pack(
+            dtypes, ndims, dims_flat, nbytes, src_ptrs, n,
+            man_arr, len(man), out,
+        )
+        return out.tobytes()
+    # NumPy fallback: byte-identical layout.
+    parts = [np.frombuffer(
+        np.array([0x4E534356, 1, n, len(man)], np.uint32).tobytes()
+        + man, np.uint8
+    )]
+    pad = _align8(16 + len(man)) - (16 + len(man))
+    parts.append(np.zeros(pad, np.uint8))
+    for i, a in enumerate(arrs):
+        head = bytearray(8)
+        head[0] = int(dtypes[i])
+        head[1] = int(ndims[i])
+        head = bytes(head) + np.array(a.shape, np.int64).tobytes() \
+            + np.int64(a.nbytes).tobytes()
+        hpad = _align8(len(head)) - len(head)
+        parts.append(np.frombuffer(head + b"\0" * hpad, np.uint8))
+        parts.append(np.frombuffer(a.tobytes(), np.uint8))
+        dpad = _align8(a.nbytes) - a.nbytes
+        parts.append(np.zeros(dpad, np.uint8))
+    return b"".join(p.tobytes() for p in parts)
+
+
+def decode_frame(buf: bytes) -> Tuple[dict, List[np.ndarray]]:
+    """Parse a frame into (manifest, arrays).  Arrays are zero-copy
+    read-only views into ``buf``."""
+    raw = np.frombuffer(buf, np.uint8)
+    lib = lib_or_none()
+    if lib is not None:
+        moff = ctypes.c_int64()
+        mlen = ctypes.c_int64()
+        n = lib.vcsnap_frame_info(
+            raw, len(raw), ctypes.byref(moff), ctypes.byref(mlen),
+        )
+        # Treat the frame as hostile until unpack validates it: a corrupt
+        # header's array count must not size allocations (each array
+        # needs >= 24 header+data bytes in a well-formed frame).
+        if n < 0 or n > len(raw) // 24 + 1:
+            raise ValueError("malformed snapshot frame")
+        dtypes = np.zeros(max(n, 1), np.uint8)
+        ndims = np.zeros(max(n, 1), np.uint8)
+        dims_flat = np.zeros(max(n, 1) * 8, np.int64)
+        data_off = np.zeros(max(n, 1), np.int64)
+        nbytes = np.zeros(max(n, 1), np.int64)
+        rc = lib.vcsnap_frame_unpack(
+            raw, len(raw), dtypes, ndims, dims_flat, data_off, nbytes,
+        )
+        if rc != 0:
+            raise ValueError("malformed snapshot frame")
+        manifest = json.loads(
+            bytes(raw[int(moff.value):int(moff.value) + int(mlen.value)])
+            or b"{}"
+        )
+        arrays = []
+        for i in range(n):
+            if int(dtypes[i]) >= len(_DTYPES):
+                raise ValueError("malformed snapshot frame")
+            dt = _DTYPES[int(dtypes[i])]
+            shape = tuple(dims_flat[i * 8:i * 8 + int(ndims[i])].tolist())
+            start = int(data_off[i])
+            arrays.append(
+                np.frombuffer(buf, dt, count=int(np.prod(shape, dtype=np.int64)),
+                              offset=start).reshape(shape)
+            )
+        return manifest, arrays
+    # NumPy fallback parser.
+    if len(buf) < 16:
+        raise ValueError("malformed snapshot frame")
+    head = np.frombuffer(buf, np.uint32, count=4)
+    if int(head[0]) != 0x4E534356 or int(head[1]) != 1:
+        raise ValueError("malformed snapshot frame")
+    n = int(head[2])
+    mlen = int(head[3])
+    manifest = json.loads(buf[16:16 + mlen] or b"{}")
+    off = _align8(16 + mlen)
+    arrays = []
+    for _ in range(n):
+        if off + 16 > len(buf):
+            raise ValueError("malformed snapshot frame")
+        dt_code = buf[off]
+        nd = buf[off + 1]
+        if nd > 8 or dt_code >= len(_DTYPES):
+            raise ValueError("malformed snapshot frame")
+        shape = tuple(np.frombuffer(buf, np.int64, count=nd,
+                                    offset=off + 8).tolist())
+        nb = int(np.frombuffer(buf, np.int64, count=1,
+                               offset=off + 8 + 8 * nd)[0])
+        off = _align8(off + 8 + 8 * nd + 8)
+        if nb < 0 or off + nb > len(buf):
+            raise ValueError("malformed snapshot frame")
+        dt = _DTYPES[dt_code]
+        arrays.append(
+            np.frombuffer(buf, dt, count=int(np.prod(shape, dtype=np.int64)),
+                          offset=off).reshape(shape)
+        )
+        off = _align8(off + nb)
+    return manifest, arrays
+
+
+# --------------------------------------------------------------- pytrees
+
+def flatten_tree(obj: Any, arrays: List[np.ndarray]) -> Any:
+    """Recursively flatten a solver-input pytree (NamedTuples / numpy
+    arrays / scalars / None / tuples) into a JSON-able spec + an array
+    list.  jax arrays are materialized to numpy."""
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, np.ndarray):
+        arrays.append(obj)
+        return {"t": "a", "i": len(arrays) - 1}
+    if isinstance(obj, (bool, int, float, str)):
+        return {"t": "v", "v": obj}
+    if hasattr(obj, "_fields"):  # NamedTuple
+        return {
+            "t": "nt", "n": type(obj).__name__,
+            "f": [flatten_tree(x, arrays) for x in obj],
+        }
+    if isinstance(obj, (tuple, list)):
+        return {"t": "l", "f": [flatten_tree(x, arrays) for x in obj]}
+    # jax / other array-likes
+    a = np.asarray(obj)
+    arrays.append(a)
+    return {"t": "a", "i": len(arrays) - 1}
+
+
+def unflatten_tree(spec: Any, arrays: List[np.ndarray],
+                   registry: Dict[str, type]) -> Any:
+    t = spec["t"]
+    if t == "none":
+        return None
+    if t == "a":
+        return arrays[spec["i"]]
+    if t == "v":
+        return spec["v"]
+    if t == "nt":
+        cls = registry[spec["n"]]
+        return cls(*[unflatten_tree(f, arrays, registry)
+                     for f in spec["f"]])
+    if t == "l":
+        return tuple(unflatten_tree(f, arrays, registry)
+                     for f in spec["f"])
+    raise ValueError(f"bad tree spec node {t!r}")

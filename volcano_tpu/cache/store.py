@@ -892,6 +892,10 @@ class ClusterStore:
             stored = self.pod_groups.get(pg.uid)
             if stored is not None:
                 stored.status = pg.status
+                # Keep the mirror's persistent status-snapshot columns
+                # coherent: the fast path's write-back change detection
+                # reads them as "last written" state.
+                self.mirror.refresh_pod_group_status(stored)
                 self.status_updater.update_pod_group(stored)
                 self._notify("PodGroup", "status", stored)
             return job
@@ -907,6 +911,7 @@ class ClusterStore:
             conditions = [c for c in pg.status.conditions if c.type != condition.type]
             conditions.append(condition)
             pg.status.conditions = conditions
+            self.mirror.refresh_pod_group_status(pg)
 
     # --------------------------------------------------------------- helpers
 

@@ -1063,7 +1063,14 @@ class FastCycle:
                     lanes["encode"] = (lanes.get("encode", 0.0)
                                        + time.perf_counter() - t_enc)
                     t0 = time.perf_counter()
-                    if solver == "wave":
+                    remote = getattr(store, "remote_solver", None)
+                    if solver == "wave" and remote is not None:
+                        # Remote-solver split (BASELINE north-star
+                        # bridge): inputs cross to the device-owning
+                        # process as one C++-packed frame; assignment
+                        # vectors come back as numpy.
+                        result = remote.solve(inputs, pid, profiles)
+                    elif solver == "wave":
                         result = solve_fn(*inputs, pid=pid,
                                           profiles=profiles)
                     else:

@@ -52,6 +52,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.vcsnap_less_equal.argtypes = [
         _f32p, _f32p, _f32p, _u8p, ctypes.c_int64, ctypes.c_int32, _u8p,
     ]
+    # Wire-frame codec (remote-solver snapshot bridge, cache/snapwire.py).
+    lib.vcsnap_frame_bytes.restype = ctypes.c_int64
+    lib.vcsnap_frame_bytes.argtypes = [
+        _u8p, _i64p, ctypes.c_int32, ctypes.c_int64,
+    ]
+    lib.vcsnap_frame_pack.argtypes = [
+        _u8p, _u8p, _i64p, _i64p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)), ctypes.c_int32,
+        _u8p, ctypes.c_int64, _u8p,
+    ]
+    lib.vcsnap_frame_info.restype = ctypes.c_int32
+    lib.vcsnap_frame_info.argtypes = [
+        _u8p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.vcsnap_frame_unpack.restype = ctypes.c_int32
+    lib.vcsnap_frame_unpack.argtypes = [
+        _u8p, ctypes.c_int64, _u8p, _u8p, _i64p, _i64p, _i64p,
+    ]
     # Reclaim engine: all stable pointers are captured once into a C-side
     # context; the hot per-reclaimer call takes raw addresses (c_void_p)
     # to keep ctypes marshalling off the 20k-calls-per-cycle path.
@@ -134,6 +153,11 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def lib_or_none() -> Optional[ctypes.CDLL]:
+    """The bound native library, or None (NumPy fallbacks apply)."""
+    return _load()
 
 
 # --------------------------------------------------------------------- API

@@ -128,6 +128,7 @@ class Service:
         remote_binder: Optional[str] = None,
         remote_evictor: Optional[str] = None,
         remote_status_updater: Optional[str] = None,
+        remote_solver: Optional[str] = None,
     ):
         # Remote side-effect boundaries (cache/remote.py): binds
         # (cache.go:492-554), evictions (:439-491), and status writes
@@ -166,6 +167,16 @@ class Service:
                 remote_status_updater, "HttpStatusUpdater"
             )
         self.store = store or ClusterStore()
+        if remote_solver:
+            # Remote-solver split (the north-star bridge): this process
+            # keeps the store/controllers/encode/commit; the wave solver
+            # runs in the device-owning process at this address, fed one
+            # C++-packed snapshot frame per solve (solver_service.py).
+            from .solver_service import RemoteSolver
+
+            client = RemoteSolver(remote_solver)
+            client.ping()  # fail fast on a permanently wrong address
+            self.store.remote_solver = client
         # Production binds dispatch on the background worker with
         # errTasks-style failure backoff (cache.go:536-552, 627-649);
         # opt out with VOLCANO_TPU_ASYNC_BIND=0 (tests that assert binds
@@ -485,6 +496,13 @@ def main(argv=None) -> int:
                    help="URL of a remote status service (cache/remote.py); "
                         "PodGroup status writes cross a process boundary "
                         "like the reference's API writes (cache.go:556-599)")
+    p.add_argument("--remote-solver", default=None,
+                   help="host:port of a vtpu-solver process "
+                        "(solver_service.py).  The scheduler then never "
+                        "touches an accelerator: each cycle's solver "
+                        "inputs ship as one C++-packed snapshot frame and "
+                        "the assignment vectors return — the north-star "
+                        "store<->solver bridge (cache.go:492-554 analog)")
     args = p.parse_args(argv)
 
     svc = Service(
@@ -497,6 +515,7 @@ def main(argv=None) -> int:
         remote_binder=args.remote_binder,
         remote_evictor=args.remote_evictor,
         remote_status_updater=args.remote_status_updater,
+        remote_solver=args.remote_solver,
     )
     port = svc.start(http_port=args.listen_port,
                      bind_address=args.bind_address)

@@ -1,0 +1,341 @@
+"""Remote-solver split: the device-owning solver as its own process.
+
+The north-star bridge (BASELINE.json; the reference's two planes likewise
+communicate only through serialized API-server state,
+``pkg/scheduler/cache/cache.go:492-554``): the scheduler process — store,
+controllers, session encode, commit — runs WITHOUT touching an
+accelerator, shipping each cycle's solver inputs over a socket as one
+C++-packed frame (``cache/snapwire.py`` / ``csrc/vcsnap.cc``), and the
+solver process — which owns the TPU — runs ``ops.wave.solve_wave`` and
+returns the assignment vectors the commit consumes.
+
+Wire protocol (one TCP connection, request/response):
+
+    [u64 little-endian frame length][frame bytes]
+
+Request manifest: ``{"op": "solve", "tree": <spec>, "wave": int|None}``
+(``tree`` is the ``snapwire.flatten_tree`` spec of
+``(solve_args, pid, profiles)``), or ``{"op": "ping"}``.
+Response manifest: ``{"op": "result", "tree": ...}`` with
+``(assigned, pipelined, never_ready, fit_failed, iters)``, or
+``{"op": "error", "message": ...}``.
+
+Run the solver:  ``vtpu-solver --port 18477``  (or
+``python -m volcano_tpu.solver_service``).
+Point a scheduler at it:  ``vtpu-service --remote-solver 127.0.0.1:18477``.
+
+Failure semantics: a transport or solver error fails the cycle; the
+scheduler's next period retries (the store is untouched — solve is pure).
+The client reconnects per error, so a restarted solver process heals
+without scheduler intervention (its jit cache re-warms via the
+persistent compilation cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socket
+import struct
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+# A full hyperscale chunk is ~1 GB of count tensors; anything beyond this
+# is a corrupt length prefix, not a snapshot.
+MAX_FRAME = 8 << 30
+
+
+def _registry():
+    from .arrays.affinity import AffinityArgs
+    from .ops.allocate import (
+        SolveJobs,
+        SolveNodes,
+        SolveQueues,
+        SolveTasks,
+    )
+    from .ops.scoring import ScoreWeights
+    from .ops.wave import SolveProfiles
+
+    return {
+        cls.__name__: cls
+        for cls in (SolveNodes, SolveTasks, SolveJobs, SolveQueues,
+                    ScoreWeights, AffinityArgs, SolveProfiles)
+    }
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, 8))
+    if n > MAX_FRAME:
+        raise ValueError(f"frame length {n} exceeds limit")
+    return _recv_exact(sock, n)
+
+
+# ------------------------------------------------------------------ server
+
+
+class SolverServer:
+    """Owns the local JAX device; serves solve requests over TCP."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 18477):
+        self._sock = socket.create_server((host, port))
+        self.port = self._sock.getsockname()[1]
+        self.host = host
+        self._stop = threading.Event()
+        self.solves = 0
+
+    def serve_forever(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            log.info("solver client connected: %s", addr)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------ handling
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from .cache import snapwire as sw
+
+        registry = _registry()
+        try:
+            while True:
+                try:
+                    req = recv_frame(conn)
+                except (ConnectionError, ValueError, OSError):
+                    return
+                try:
+                    reply = self._handle(req, registry, sw)
+                except Exception as e:  # solver-side error -> client raises
+                    log.exception("solve failed")
+                    reply = sw.encode_frame(
+                        [], {"op": "error", "message": f"{type(e).__name__}: {e}"}
+                    )
+                try:
+                    send_frame(conn, reply)
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: bytes, registry, sw) -> bytes:
+        manifest, arrays = sw.decode_frame(req)
+        op = manifest.get("op")
+        if op == "ping":
+            try:
+                import jax
+
+                backend = jax.default_backend()
+            except Exception as e:  # pragma: no cover
+                backend = f"unavailable: {e}"
+            return sw.encode_frame(
+                [], {"op": "pong", "solves": self.solves,
+                     "backend": backend}
+            )
+        if op != "solve":
+            return sw.encode_frame(
+                [], {"op": "error", "message": f"unknown op {op!r}"}
+            )
+        # Received views are read-only; the solver only reads them.
+        solve_args, pid, profiles = sw.unflatten_tree(
+            manifest["tree"], arrays, registry
+        )
+        from .ops.wave import solve_wave
+        from .scheduler import enable_compilation_cache
+
+        enable_compilation_cache()
+
+        import jax
+
+        kw = {}
+        if manifest.get("wave") is not None:
+            kw["wave"] = int(manifest["wave"])
+        import time as _time
+
+        t0 = _time.perf_counter()
+        res = solve_wave(*solve_args, pid=pid, profiles=profiles, **kw)
+        out = jax.device_get(
+            (res.assigned, res.pipelined, res.never_ready, res.fit_failed,
+             res.iters if res.iters is not None else np.int32(0))
+        )
+        solve_ms = (_time.perf_counter() - t0) * 1e3
+        self.solves += 1
+        arrays_out = []
+        tree = sw.flatten_tree(tuple(np.asarray(x) for x in out), arrays_out)
+        return sw.encode_frame(
+            arrays_out,
+            {"op": "result", "tree": tree, "solve_ms": round(solve_ms, 1)},
+        )
+
+
+# ------------------------------------------------------------------ client
+
+
+class RemoteSolver:
+    """Client-side drop-in for ``solve_wave`` over the snapshot bridge.
+
+    One persistent connection; reconnects after any transport error so a
+    restarted solver process heals transparently.  Thread-compatible with
+    the scheduler's single cycle thread (no internal locking needed
+    beyond reconnect)."""
+
+    def __init__(self, address: str, timeout: float = 300.0):
+        if "//" in address:
+            address = address.split("//", 1)[1]
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        # Round-trip + payload telemetry for the BASELINE overhead table.
+        self.requests = 0
+        self.bytes_out = 0
+        self.bytes_in = 0
+        self.last_solve_ms: Optional[float] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout
+            )
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()
+
+    def _roundtrip(self, payload: bytes) -> bytes:
+        with self._lock:
+            try:
+                sock = self._connect()
+                send_frame(sock, payload)
+                return recv_frame(sock)
+            except (OSError, ConnectionError, ValueError):
+                # One reconnect attempt (solver restart); then give up
+                # and let the cycle fail/retry next period.
+                self._close_locked()
+                try:
+                    sock = self._connect()
+                    send_frame(sock, payload)
+                    return recv_frame(sock)
+                except (OSError, ConnectionError, ValueError):
+                    self._close_locked()
+                    raise
+
+    def ping(self) -> dict:
+        from .cache import snapwire as sw
+
+        manifest, _ = sw.decode_frame(
+            self._roundtrip(sw.encode_frame([], {"op": "ping"}))
+        )
+        return manifest
+
+    def solve(self, solve_args: Sequence, pid, profiles,
+              wave: Optional[int] = None):
+        """Ship (solve_args, pid, profiles); return an AllocResult-shaped
+        namedtuple of numpy arrays (assigned/pipelined/never_ready/
+        fit_failed/iters; idle/q_alloc stay device-side concerns and are
+        not transported — the host commit recomputes both)."""
+        from .cache import snapwire as sw
+        from .ops.allocate import AllocResult
+
+        arrays: list = []
+        tree = sw.flatten_tree(
+            (tuple(solve_args), np.asarray(pid), profiles), arrays
+        )
+        payload = sw.encode_frame(
+            arrays, {"op": "solve", "tree": tree, "wave": wave}
+        )
+        self.requests += 1
+        self.bytes_out += len(payload) + 8
+        reply = self._roundtrip(payload)
+        self.bytes_in += len(reply) + 8
+        manifest, rarrays = sw.decode_frame(reply)
+        if manifest.get("op") == "error":
+            raise RuntimeError(
+                f"remote solver failed: {manifest.get('message')}"
+            )
+        self.last_solve_ms = manifest.get("solve_ms")
+        assigned, pipelined, never_ready, fit_failed, iters = (
+            sw.unflatten_tree(manifest["tree"], rarrays, _registry())
+        )
+        return AllocResult(
+            assigned=assigned, pipelined=pipelined,
+            never_ready=never_ready, fit_failed=fit_failed,
+            idle=None, q_alloc=None, iters=iters,
+        )
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="volcano-tpu remote solver (device-owning process)"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=18477)
+    parser.add_argument("--announce", action="store_true",
+                        help="print 'SOLVER <port>' once listening "
+                             "(spawners parse this)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = SolverServer(host=args.host, port=args.port)
+    if args.announce:
+        print(f"SOLVER {server.port}", flush=True)
+    log.info("solver listening on %s:%d", server.host, server.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()
