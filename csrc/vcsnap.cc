@@ -230,6 +230,9 @@ int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len, uint8_t* dtypes,
     dtypes[i] = buf[off];
     ndims[i] = nd;
     std::memcpy(dims_flat + i * 8, buf + off + 8, 8 * nd);
+    for (uint8_t d = 0; d < nd; ++d) {
+      if (dims_flat[i * 8 + d] < 0) return -1;
+    }
     int64_t nb;
     std::memcpy(&nb, buf + off + 8 + 8 * nd, 8);
     if (nb < 0) return -1;

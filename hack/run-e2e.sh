@@ -5,4 +5,4 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
-  tests/test_remote_solver.py -q "$@"
+  tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

@@ -1,0 +1,49 @@
+"""Worker payload for the rendezvous e2e (the rebuild's MPI-hello-world
+moment, test/e2e/mpi.go:27 analog): consume the env the svc/env job
+plugins injected into the bound pod and complete a real
+``jax.distributed.initialize`` handshake with the other workers.
+
+Launched as its own OS process per pod by tests/test_rendezvous_e2e.py
+(the test plays the kubelet, as kind's node containers do for the
+reference's e2e).
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    count = int(os.environ["VC_PROCESS_COUNT"])
+    pid = int(os.environ["VC_PROCESS_ID"])
+    addr = os.environ["VC_COORDINATOR_ADDRESS"]
+    host, _, port = addr.rpartition(":")
+    # Production resolves the headless-service DNS name
+    # (job-task-0.job); this single-host e2e loops back — exactly what
+    # kind's cluster DNS does for the reference's MPI example.
+    addr = f"127.0.0.1:{port}"
+
+    import jax
+
+    # The CI harness force-selects its accelerator platform regardless of
+    # JAX_PLATFORMS; pin CPU through the config API so both workers hold
+    # one local CPU device each.
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=count, process_id=pid
+    )
+    assert jax.process_count() == count, jax.process_count()
+    global_devices = len(jax.devices())
+    local_devices = len(jax.local_devices())
+    print(json.dumps({
+        "process_id": pid,
+        "process_count": jax.process_count(),
+        "global_devices": global_devices,
+        "local_devices": local_devices,
+        "coordinator": addr,
+    }), flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

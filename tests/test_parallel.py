@@ -82,3 +82,68 @@ def test_sharded_wave_solve_with_sparse_cnt0(monkeypatch):
     mesh = make_mesh(8)
     res = sharded_solve_wave(mesh, args)
     assert (np.asarray(res.assigned) >= 0).any()
+
+
+@needs_8
+def test_full_cycle_on_mesh_with_sharded_count_tensors():
+    """The COMPLETE fastpath cycle (enqueue -> allocate -> commit ->
+    close) dispatched over the 8-device mesh via store.solve_mesh, with
+    a required-affinity/anti/spread mix so cnt0 shards on the domain
+    axis (parallel/mesh.py shard_wave_inputs — the hyperscale memory
+    wall).  Bind-count parity with the single-device cycle; a mesh-path
+    failure must raise, not silently fall back."""
+    import os
+
+    from volcano_tpu.parallel import make_mesh
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    kw = dict(n_nodes=64, n_pods=128, gang_size=4, zones=4,
+              affinity_fraction=0.25, anti_affinity_fraction=0.25,
+              spread_fraction=0.25, seed=31)
+    single = synthetic_cluster(**kw)
+    Scheduler(single).run_once()
+    single.flush_binds()
+
+    meshed = synthetic_cluster(**kw)
+    meshed.solve_mesh = make_mesh(8)
+    os.environ["VOLCANO_TPU_FALLBACK"] = "never"
+    try:
+        Scheduler(meshed).run_once()
+    finally:
+        os.environ.pop("VOLCANO_TPU_FALLBACK", None)
+    meshed.flush_binds()
+    assert len(meshed.binder.binds) == len(single.binder.binds)
+    assert len(meshed.binder.binds) == 128
+    single.close()
+    meshed.close()
+
+
+@needs_8
+def test_mesh_sparse_rebuild_sharded_cnt0(monkeypatch):
+    """Sparse cnt0/profile-table rebuilds under a COLUMN-sharded mesh
+    caller: the rebuilt [E+1, D] pair inherits the domain-axis sharding
+    and the [U, Ep+1] tables fall back to replicated when the term axis
+    does not divide (ops/wave.py rebuild fallback)."""
+    import os
+
+    import volcano_tpu.ops.wave as wave
+    from volcano_tpu.parallel import make_mesh
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.synth import synthetic_cluster
+
+    monkeypatch.setattr(wave, "CNT0_SPARSE_MIN", 0)
+    monkeypatch.setattr(wave, "PROF_SPARSE_MIN", 0)
+    store = synthetic_cluster(
+        n_nodes=32, n_pods=64, gang_size=4, zones=4,
+        affinity_fraction=0.5, anti_affinity_fraction=0.25, seed=13,
+    )
+    store.solve_mesh = make_mesh(8)
+    os.environ["VOLCANO_TPU_FALLBACK"] = "never"
+    try:
+        Scheduler(store).run_once()
+    finally:
+        os.environ.pop("VOLCANO_TPU_FALLBACK", None)
+    store.flush_binds()
+    assert len(store.binder.binds) >= 60
+    store.close()

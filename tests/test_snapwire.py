@@ -92,3 +92,25 @@ def test_hostile_count_and_dtype_rejected(monkeypatch, use_native):
     good[16] = 200  # dtype code out of range
     with pytest.raises(ValueError):
         sw.decode_frame(bytes(good))
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_dims_nbytes_mismatch_rejected(monkeypatch, use_native):
+    """A corrupt dim that disagrees with the recorded byte length must
+    not decode into a view bleeding into the next array's bytes."""
+    if not use_native:
+        monkeypatch.setattr(sw, "lib_or_none", lambda: None)
+    good = bytearray(sw.encode_frame(
+        [np.arange(8, dtype=np.int32).reshape(2, 4),
+         np.arange(6, dtype=np.int32)], {}))
+    # First array header starts at offset 16 (empty manifest): dims are
+    # at +8; double dim0 from 2 to 4.
+    dim0 = np.frombuffer(bytes(good[24:32]), np.int64)[0]
+    assert dim0 == 2
+    good[24:32] = np.int64(4).tobytes()
+    with pytest.raises(ValueError):
+        sw.decode_frame(bytes(good))
+    # Negative dim likewise.
+    good[24:32] = np.int64(-1).tobytes()
+    with pytest.raises(ValueError):
+        sw.decode_frame(bytes(good))

@@ -126,9 +126,15 @@ def decode_frame(buf: bytes) -> Tuple[dict, List[np.ndarray]]:
                 raise ValueError("malformed snapshot frame")
             dt = _DTYPES[int(dtypes[i])]
             shape = tuple(dims_flat[i * 8:i * 8 + int(ndims[i])].tolist())
+            count = int(np.prod(shape, dtype=np.int64))
+            # Shape and byte length must agree or the view would bleed
+            # into the next array's bytes (hostile-until-validated).
+            if min(shape, default=0) < 0 or \
+                    count * dt.itemsize != int(nbytes[i]):
+                raise ValueError("malformed snapshot frame")
             start = int(data_off[i])
             arrays.append(
-                np.frombuffer(buf, dt, count=int(np.prod(shape, dtype=np.int64)),
+                np.frombuffer(buf, dt, count=count,
                               offset=start).reshape(shape)
             )
         return manifest, arrays
@@ -158,9 +164,11 @@ def decode_frame(buf: bytes) -> Tuple[dict, List[np.ndarray]]:
         if nb < 0 or off + nb > len(buf):
             raise ValueError("malformed snapshot frame")
         dt = _DTYPES[dt_code]
+        count = int(np.prod(shape, dtype=np.int64))
+        if min(shape, default=0) < 0 or count * dt.itemsize != nb:
+            raise ValueError("malformed snapshot frame")
         arrays.append(
-            np.frombuffer(buf, dt, count=int(np.prod(shape, dtype=np.int64)),
-                          offset=off).reshape(shape)
+            np.frombuffer(buf, dt, count=count, offset=off).reshape(shape)
         )
         off = _align8(off + nb)
     return manifest, arrays

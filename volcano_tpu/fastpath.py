@@ -1064,12 +1064,22 @@ class FastCycle:
                                        + time.perf_counter() - t_enc)
                     t0 = time.perf_counter()
                     remote = getattr(store, "remote_solver", None)
+                    mesh = getattr(store, "solve_mesh", None)
                     if solver == "wave" and remote is not None:
                         # Remote-solver split (BASELINE north-star
                         # bridge): inputs cross to the device-owning
                         # process as one C++-packed frame; assignment
                         # vectors come back as numpy.
                         result = remote.solve(inputs, pid, profiles)
+                    elif solver == "wave" and mesh is not None:
+                        # Multi-chip dispatch: node axis + affinity
+                        # count tensors sharded over the mesh
+                        # (parallel/mesh.py shard_wave_inputs).
+                        from .parallel.mesh import sharded_solve_wave_cycle
+
+                        result = sharded_solve_wave_cycle(
+                            mesh, inputs, pid, profiles
+                        )
                     elif solver == "wave":
                         result = solve_fn(*inputs, pid=pid,
                                           profiles=profiles)

@@ -1812,10 +1812,23 @@ def solve_wave(
         )
         in_sh = getattr(cnt0_in, "sharding", None)
         if in_sh is not None and not isinstance(cnt0_in, np.ndarray):
-            d_aff, d_anti, d_mat, d_soft = (
-                jax.device_put(x, in_sh)
-                for x in (d_aff, d_anti, d_mat, d_soft)
-            )
+            try:
+                d_aff, d_anti, d_mat, d_soft = tuple(
+                    jax.device_put(x, in_sh)
+                    for x in (d_aff, d_anti, d_mat, d_soft)
+                )
+            except ValueError:
+                # A partitioned in_sh whose axis does not divide the
+                # rebuilt [U, Ep+1] tables (mesh callers sharding the
+                # term axis): replicate them instead — the [E, D] count
+                # pair is the memory wall, not these.
+                rep = jax.sharding.NamedSharding(
+                    in_sh.mesh, jax.sharding.PartitionSpec()
+                )
+                d_aff, d_anti, d_mat, d_soft = tuple(
+                    jax.device_put(x, rep)
+                    for x in (d_aff, d_anti, d_mat, d_soft)
+                )
         profiles = profiles._replace(
             t_req_aff=d_aff, t_req_anti=d_anti, t_matches=d_mat,
             t_soft=d_soft,

@@ -109,3 +109,112 @@ def sharded_solve_wave(mesh: Mesh, solve_args: Sequence,
     args = shard_solve_args(mesh, solve_args, axis)
     kw = {} if wave is None else {"wave": wave}
     return solve_wave(*args, **kw)
+
+
+def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
+                      axis: str = NODES_AXIS):
+    """Mesh placement for the fast path's pre-profiled wave inputs.
+
+    Beyond the node-axis sharding of ``shard_solve_args``, the affinity
+    COUNT tENSORS shard too — they are the hyperscale memory wall
+    (an [E, D] int32 pair with D ~ N reaches GBs at 50k nodes; round-4
+    root cause of the 16 GB-chip OOM), so replicating them would cap the
+    cluster size one chip can hold regardless of mesh width:
+
+    - ``aff.cnt0`` [E, D] shards on the DOMAIN axis (hostname domains
+      are per-node, so D scales with N; XLA pads uneven shards),
+    - the profile term tables (``t_req_aff``/``t_req_anti``/
+      ``t_matches``/``t_soft`` [U, E]) shard on the TERM axis,
+    - ``pid`` and the remaining profile rows are replicated (profile
+      counts are tiny next to [*, N] and [E, D] state).
+
+    The kernel's count-window contraction (cnt @ dom_ohT over D) then
+    runs as partial products with an XLA-inserted reduce over ICI.
+    """
+    node_sharded = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+    col_sharded = NamedSharding(mesh, P(None, axis))
+
+    nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = solve_args
+    n_nodes = int(np.asarray(nodes.idle).shape[0])
+
+    def put_node(x):
+        # The slim fast path ships [1, R] broadcast dummies for
+        # releasing/pipelined; those replicate (a 1-row axis cannot
+        # shard over the mesh).
+        a = np.asarray(x)
+        sh = node_sharded if (a.ndim and a.shape[0] == n_nodes) \
+            else replicated
+        return jax.device_put(a, sh)
+
+    n_mesh = mesh.devices.size
+
+    def put_cols(x):
+        # Shard axis 1, zero-padding it up to a mesh multiple (padded
+        # domain/term columns are inert: domain ids and term windows
+        # only ever index the original range).  Tables too small to
+        # split stay replicated.
+        a = np.asarray(x)
+        if a.ndim < 2 or a.shape[1] < n_mesh:
+            return jax.device_put(a, replicated)
+        pad = (-a.shape[1]) % n_mesh
+        if pad:
+            a = np.concatenate(
+                [a, np.zeros((a.shape[0], pad, *a.shape[2:]), a.dtype)],
+                axis=1,
+            )
+        return jax.device_put(a, col_sharded)
+
+    nodes = type(nodes)(*[put_node(x) for x in nodes])
+    aff = type(aff)(
+        node_dom=put_node(aff.node_dom),
+        term_key=jax.device_put(np.asarray(aff.term_key), replicated),
+        cnt0=put_cols(aff.cnt0),
+        t_req_aff=jax.device_put(np.asarray(aff.t_req_aff), replicated),
+        t_req_anti=jax.device_put(np.asarray(aff.t_req_anti), replicated),
+        t_matches=jax.device_put(np.asarray(aff.t_matches), replicated),
+        t_soft=jax.device_put(np.asarray(aff.t_soft), replicated),
+    )
+    rep = lambda tree: jax.tree_util.tree_map(
+        lambda x: jax.device_put(np.asarray(x), replicated), tree
+    )
+    profiles = type(profiles)(
+        req=jax.device_put(np.asarray(profiles.req), replicated),
+        init_req=jax.device_put(np.asarray(profiles.init_req), replicated),
+        ports=jax.device_put(np.asarray(profiles.ports), replicated),
+        sel_bits=jax.device_put(np.asarray(profiles.sel_bits), replicated),
+        aff_bits=jax.device_put(np.asarray(profiles.aff_bits), replicated),
+        aff_terms=jax.device_put(np.asarray(profiles.aff_terms),
+                                 replicated),
+        tol_bits=jax.device_put(np.asarray(profiles.tol_bits), replicated),
+        pref_bits=jax.device_put(np.asarray(profiles.pref_bits),
+                                 replicated),
+        pref_w=jax.device_put(np.asarray(profiles.pref_w), replicated),
+        t_req_aff=put_cols(profiles.t_req_aff),
+        t_req_anti=put_cols(profiles.t_req_anti),
+        t_matches=put_cols(profiles.t_matches),
+        t_soft=put_cols(profiles.t_soft),
+    )
+    args = (
+        nodes, rep(tasks), rep(jobs), rep(queues), rep(weights),
+        jax.device_put(np.asarray(eps), replicated),
+        jax.device_put(np.asarray(scalar_slot), replicated),
+        aff,
+    )
+    pid = jax.device_put(np.asarray(pid), replicated)
+    return args, pid, profiles
+
+
+def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
+                             profiles, axis: str = NODES_AXIS,
+                             wave: Optional[int] = None):
+    """The fast path's solve dispatch on a mesh (FastCycle._allocate when
+    ``store.solve_mesh`` is set): pre-profiled inputs, node axis + count
+    tensors sharded per ``shard_wave_inputs``."""
+    from ..ops.wave import solve_wave
+
+    args, pid, profiles = shard_wave_inputs(
+        mesh, solve_args, pid, profiles, axis
+    )
+    kw = {} if wave is None else {"wave": wave}
+    return solve_wave(*args, pid=pid, profiles=profiles, **kw)

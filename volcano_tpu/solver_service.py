@@ -81,7 +81,10 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    # Two sendalls, no prefix+payload concatenation: at hyperscale a
+    # frame carries ~GB of count tensors and the concat would copy it.
+    sock.sendall(_LEN.pack(len(payload)))
+    sock.sendall(payload)
 
 
 def recv_frame(sock: socket.socket) -> bytes:
