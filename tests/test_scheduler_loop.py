@@ -98,6 +98,46 @@ def test_leadership_gate_skips_cycles_and_clears_failures():
     assert len(store.binder.binds) == 8
 
 
+def test_stop_joins_thread_and_drains_inflight_dispatch():
+    """stop() must leave the loop thread DEAD (not a timed-out join that
+    silently leaks a scheduling thread behind a restart) and must drain
+    the pipelined dispatch parked between cycles — the solved pods stay
+    Pending and re-place after a restart."""
+    import numpy as np
+
+    from volcano_tpu.api import TaskStatus
+
+    store = small_store()
+    store.pipeline = True
+    st_bound = int(TaskStatus.Bound)
+
+    # Steady-state feed: re-pend whatever the commit just bound, so every
+    # cycle dispatches a fresh solve and an in-flight handle is parked
+    # whenever the loop is between cycles.
+    def feed(fc):
+        rows = np.flatnonzero(
+            (fc.m.p_status[:fc.Pn] == st_bound) & fc.m.p_alive[:fc.Pn]
+        )
+        if len(rows):
+            fc._unbind_rows(rows)
+
+    store.cycle_feed = feed
+    sched = Scheduler(store, schedule_period=0.01)
+    sched.run()
+    t = sched._thread
+    assert t is not None
+    deadline = time.time() + 10.0
+    while (getattr(store, "_inflight_solve", None) is None
+           and time.time() < deadline):
+        time.sleep(0.005)
+    assert store._inflight_solve is not None, "no dispatch ever parked"
+    sched.stop()
+    assert not t.is_alive()          # the loop thread is DEAD
+    assert sched._thread is None     # and not retained for a re-join
+    # The parked device future was abandoned, not leaked.
+    assert getattr(store, "_inflight_solve", None) is None
+
+
 def test_repeated_failures_flip_healthz(monkeypatch):
     store = small_store()
     sched = Scheduler(store, schedule_period=0.01)

@@ -103,14 +103,17 @@ def test_dims_nbytes_mismatch_rejected(monkeypatch, use_native):
     good = bytearray(sw.encode_frame(
         [np.arange(8, dtype=np.int32).reshape(2, 4),
          np.arange(6, dtype=np.int32)], {}))
-    # First array header starts at offset 16 (empty manifest): dims are
-    # at +8; double dim0 from 2 to 4.
-    dim0 = np.frombuffer(bytes(good[24:32]), np.int64)[0]
+    # First array header starts after the 16-byte frame header plus the
+    # manifest ("{}" = 2 bytes) padded to 8; dims are at +8 within it.
+    # Double dim0 from 2 to 4.
+    man_len = len(b"{}")
+    d0 = ((16 + man_len + 7) & ~7) + 8
+    dim0 = np.frombuffer(bytes(good[d0:d0 + 8]), np.int64)[0]
     assert dim0 == 2
-    good[24:32] = np.int64(4).tobytes()
+    good[d0:d0 + 8] = np.int64(4).tobytes()
     with pytest.raises(ValueError):
         sw.decode_frame(bytes(good))
     # Negative dim likewise.
-    good[24:32] = np.int64(-1).tobytes()
+    good[d0:d0 + 8] = np.int64(-1).tobytes()
     with pytest.raises(ValueError):
         sw.decode_frame(bytes(good))

@@ -229,6 +229,12 @@ class StoreMirror:
         self.p_row: Dict[str, int] = {}
         self.p_status = np.zeros(cap, np.int16)
         self.p_node = np.full(cap, -1, I)
+        # Bound hostname per row (None = unbound): written as ONE batched
+        # column write at commit time (fastpath._commit) instead of a
+        # 100k-iteration per-record setattr walk — the mirror-side source
+        # of truth for bound placements; pod RECORDS still sync lazily
+        # through the deferred bind-record walk (store.defer_bind_records).
+        self.p_node_name = np.empty(cap, object)
         self.p_job = np.full(cap, -1, I)
         self.p_prio = np.zeros(cap, I)
         self.p_create = np.zeros(cap, np.float64)
@@ -323,6 +329,22 @@ class StoreMirror:
         self._orphans: Dict[str, List[str]] = {}
         # Epoch bumps force full fallback-path consumers to resync if needed.
         self.epoch = 0
+        # Monotone pod/node mutation counter: the pipelined cycle's
+        # staleness guard compares the value captured at solve dispatch
+        # against the value at fetch — equality proves NO pod/node state
+        # changed during the overlap, so the capacity re-validation can
+        # be skipped wholesale (the steady-state case).
+        self.mutation_seq = 0
+        # Bumped when maybe_compact renumbers pod rows: an in-flight
+        # solve's row indices are void across a compaction and the whole
+        # result must be dropped (rows are otherwise stable for a pod's
+        # lifetime — tombstoned rows are never reused).
+        self.compact_gen = 0
+        # Node rows touched since the last reset_node_delta(): lets the
+        # device-resident snapshot upload per-row deltas instead of the
+        # full [N, *] planes on every node-table epoch bump.
+        self._node_dirty_rows: set = set()
+        self._node_dirty_floor = 0
 
     # ================================================================ pods
 
@@ -521,6 +543,7 @@ class StoreMirror:
 
     def upsert_pod(self, pod: Pod, job_row_of) -> None:
         """Insert or update a pod row.  ``job_row_of(job_id) -> row``."""
+        self.mutation_seq += 1
         feat = self._feat(pod)
         status = int(pod.task_status())
         node_row = -1
@@ -540,6 +563,7 @@ class StoreMirror:
                 # group name after the fact (pg_controller_handler.go:72-105).
                 self.p_status[row] = status
                 self.p_node[row] = node_row
+                self.p_node_name[row] = pod.node_name or None
                 jid = pod.job_id()
                 self.p_job[row] = job_row_of(jid) if jid else -1
                 return
@@ -567,9 +591,11 @@ class StoreMirror:
         self.p_aff_hi = _grow(self.p_aff_hi, n)
         self.p_pref_lo = _grow(self.p_pref_lo, n)
         self.p_pref_hi = _grow(self.p_pref_hi, n)
+        self.p_node_name = _grow(self.p_node_name, n)
 
         self.p_status[row] = status
         self.p_node[row] = node_row
+        self.p_node_name[row] = pod.node_name or None
         jid = pod.job_id()
         jrow = job_row_of(jid) if jid else -1
         self.p_job[row] = jrow
@@ -628,8 +654,10 @@ class StoreMirror:
         row = self.p_row.pop(uid, None)
         if row is None:
             return
+        self.mutation_seq += 1
         self.p_alive[row] = False
         self.p_uid[row] = None
+        self.p_node_name[row] = None
         if self.p_pod[row] is not None:
             self.p_pod_nones += 1
         self.p_pod[row] = None
@@ -638,8 +666,12 @@ class StoreMirror:
     def set_pod_state(self, uid: str, status: int, node_row: int) -> None:
         row = self.p_row.get(uid)
         if row is not None:
+            self.mutation_seq += 1
             self.p_status[row] = status
             self.p_node[row] = node_row
+            self.p_node_name[row] = (
+                self.n_name[node_row] if node_row >= 0 else None
+            )
 
     # ================================================================ nodes
 
@@ -699,6 +731,8 @@ class StoreMirror:
         self.n_maxtasks[row] = alloc.max_task_num
         self._node_dom_dirty = True
         self.epoch += 1
+        self.mutation_seq += 1
+        self._node_dirty_rows.add(row)
         for uid in self._orphans.pop(node.name, []):
             prow = self.p_row.get(uid)
             if prow is not None:
@@ -722,6 +756,21 @@ class StoreMirror:
             # Pods pointing at this node keep their row; their node col is
             # fixed up by the per-cycle liveness mask (n_alive).
             self.epoch += 1
+            self.mutation_seq += 1
+            self._node_dirty_rows.add(row)
+
+    def node_delta_rows(self, since_epoch: int) -> Optional[np.ndarray]:
+        """Node rows changed since ``since_epoch``, or None when the
+        dirty set cannot prove it covers that span (a second consumer
+        reset it, or the caller predates the tracking floor).  Single-
+        consumer contract: call ``reset_node_delta`` after applying."""
+        if since_epoch < self._node_dirty_floor:
+            return None
+        return np.array(sorted(self._node_dirty_rows), np.int64)
+
+    def reset_node_delta(self) -> None:
+        self._node_dirty_rows.clear()
+        self._node_dirty_floor = self.epoch
 
     def node_dom(self) -> np.ndarray:
         """[Nrows, K] topology domain ids (interned, append-only)."""
@@ -910,9 +959,9 @@ class StoreMirror:
             fresh.p_feat.append(old.p_feat[r])
             fresh.p_row[uid] = len(fresh.p_uid) - 1
         n = len(live)
-        for name in ("p_status", "p_node", "p_job", "p_prio", "p_create",
-                     "p_alive", "p_be", "p_has_ip", "p_has_tol",
-                     "p_critical", "p_prof"):
+        for name in ("p_status", "p_node", "p_node_name", "p_job",
+                     "p_prio", "p_create", "p_alive", "p_be", "p_has_ip",
+                     "p_has_tol", "p_critical", "p_prof"):
             arr = getattr(old, name)[:total][live]
             setattr(fresh, name, arr.copy())
         # CSR columns: re-append per live row (vectorized gather then bulk).
@@ -958,12 +1007,22 @@ class StoreMirror:
             kv: [int(remap[r]) for r in rows if remap[r] >= 0]
             for kv, rows in old._pods_by_pair.items()
         }
+        # Counters survive compaction (fresh.__init__ zeroed them):
+        # row indices held by in-flight solves are void now, so bump the
+        # generation; any delta consumer must also full-resync.
+        seq, gen = self.mutation_seq, self.compact_gen
+        dirty, floor = self._node_dirty_rows, self._node_dirty_floor
         self.__dict__.update(fresh.__dict__)
+        self.mutation_seq = seq + 1
+        self.compact_gen = gen + 1
+        self._node_dirty_rows = dirty
+        self._node_dirty_floor = floor
 
     def resync_status(self, pods: Dict[str, "Pod"]) -> None:
         """Re-derive every live row's dynamic state from the pod records
         (the system of record).  Recovery path: a failed fast cycle may
         leave uncommitted status mutations in the mirror."""
+        self.mutation_seq += 1
         for uid, row in self.p_row.items():
             pod = pods.get(uid)
             if pod is None:
@@ -972,6 +1031,7 @@ class StoreMirror:
             self.p_node[row] = (
                 self.n_row.get(pod.node_name, -1) if pod.node_name else -1
             )
+            self.p_node_name[row] = pod.node_name or None
 
     # ---------------------------------------------------------- inspection
 

@@ -335,6 +335,11 @@ class ClusterStore:
         """Stop background machinery (the bind dispatcher thread).  The
         dispatcher's callbacks pin this store, so long-lived processes
         creating many stores (benchmarks) must close them."""
+        from ..pipeline import abandon_inflight
+
+        # A parked pipelined solve holds device buffers (or a remote
+        # solver's reply slot); drop it with the store.
+        abandon_inflight(self)
         if self._bind_dispatcher is not None:
             self._bind_dispatcher.stop()
             self._bind_dispatcher = None

@@ -186,6 +186,14 @@ class FastCycle:
         for tier in conf.tiers:
             for opt in tier.plugins:
                 self.plugin_opts.setdefault(opt.name, opt)
+        # Pipelined sessions (ISSUE 1): the device solve is dispatched
+        # without blocking and committed at the top of the NEXT cycle,
+        # hiding the device round trip behind the host lanes.  Opt in
+        # per store (bench, service flag) or globally via env.
+        flag = getattr(store, "pipeline", None)
+        if flag is None:
+            flag = os.environ.get("VOLCANO_TPU_PIPELINE", "0") == "1"
+        self._pipeline_on = bool(flag)
 
     # --------------------------------------------------------- eligibility
 
@@ -673,6 +681,20 @@ class FastCycle:
         self._bind_batches: List[tuple] = []
         try:
             try:
+                # Double-buffered sessions: the previous cycle's
+                # dispatched-but-uncommitted solve lands FIRST, so its
+                # device round trip ran concurrently with that cycle's
+                # close/enqueue and this cycle's derive (pipeline.py).
+                self._commit_inflight()
+                # Workload-injection seam (bench.py steady state, loop
+                # tests): new work "arrives" after the commit and before
+                # this cycle's actions, so every pipelined cycle both
+                # commits session N-1 and dispatches session N.
+                feed = getattr(store, "cycle_feed", None)
+                if feed is not None:
+                    t0 = time.perf_counter()
+                    feed(self)
+                    self.lanes["feed"] = time.perf_counter() - t0
                 for name in self.action_names:
                     t0 = time.perf_counter()
                     with metrics.action_timer(name):
@@ -681,11 +703,23 @@ class FastCycle:
                         elif name == "allocate":
                             self._allocate()
                         elif name == "backfill":
-                            self._backfill()
+                            if self._backfill():
+                                # Backfill bound BestEffort rows directly
+                                # in the mirror; stamp for the staleness
+                                # guard (disjoint rows from the solve,
+                                # but node task slots moved).
+                                self.m.mutation_seq += 1
                         elif name == "preempt":
                             self._evict_machinery().preempt()
+                            # Evictions write p_status directly; the
+                            # pipelined staleness guard keys off the
+                            # mirror's mutation counter, so stamp the
+                            # action (preempt/reclaim run AFTER the
+                            # allocate dispatch in the standard confs).
+                            self.m.mutation_seq += 1
                         elif name == "reclaim":
                             self._evict_machinery().reclaim()
+                            self.m.mutation_seq += 1
                     if name in ("preempt", "reclaim", "enqueue",
                                 "backfill"):
                         self.lanes[name] = (
@@ -1054,8 +1088,46 @@ class FastCycle:
             progress_any = False
             never_any = False
             try:
-                for cjobs, crows in self._solve_chunks(solve_jobs,
-                                                       task_rows):
+                chunks = list(self._solve_chunks(solve_jobs, task_rows))
+                remote = getattr(store, "remote_solver", None)
+                mesh = getattr(store, "solve_mesh", None)
+                # Pipelined dispatch (ISSUE 1): a single-chunk wave
+                # solve is shipped WITHOUT blocking on the result; the
+                # commit lands at the top of the next cycle.  Chunked
+                # solves stay synchronous — later chunks must see
+                # earlier chunks' placements — and the mesh path keeps
+                # its own sharded dispatch.
+                if (self._pipeline_on and solver == "wave"
+                        and mesh is None and len(chunks) == 1):
+                    cjobs, crows = chunks[0]
+                    had_aff_chunks |= self._chunks_had_terms
+                    t_enc = time.perf_counter()
+                    inputs, pid, profiles = self._solve_inputs(
+                        cjobs, crows, slim=True)
+                    lanes["encode"] = (lanes.get("encode", 0.0)
+                                       + time.perf_counter() - t_enc)
+                    t0 = time.perf_counter()
+                    if remote is not None:
+                        payload = remote.solve_async(inputs, pid,
+                                                     profiles)
+                        kind = "remote"
+                    else:
+                        payload = solve_fn(*inputs, pid=pid,
+                                           profiles=profiles,
+                                           taint_any=self._taint_any)
+                        # Start the device->host transfer now; the
+                        # fetch at the next cycle's top only waits for
+                        # whatever is still in flight.
+                        try:
+                            payload.assigned.copy_to_host_async()
+                        except AttributeError:
+                            pass
+                        kind = "local"
+                    self._dispatch_async(cjobs, crows, kind, payload)
+                    lanes["device"] = (lanes.get("device", 0.0)
+                                       + time.perf_counter() - t0)
+                    break
+                for cjobs, crows in chunks:
                     had_aff_chunks |= self._chunks_had_terms
                     t_enc = time.perf_counter()
                     inputs, pid, profiles = self._solve_inputs(
@@ -1063,8 +1135,6 @@ class FastCycle:
                     lanes["encode"] = (lanes.get("encode", 0.0)
                                        + time.perf_counter() - t_enc)
                     t0 = time.perf_counter()
-                    remote = getattr(store, "remote_solver", None)
-                    mesh = getattr(store, "solve_mesh", None)
                     if solver == "wave" and remote is not None:
                         # Remote-solver split (BASELINE north-star
                         # bridge): inputs cross to the device-owning
@@ -1077,12 +1147,18 @@ class FastCycle:
                         # (parallel/mesh.py shard_wave_inputs).
                         from .parallel.mesh import sharded_solve_wave_cycle
 
+                        if not hasattr(store, "_mesh_plane_cache"):
+                            store._mesh_plane_cache = {}
                         result = sharded_solve_wave_cycle(
-                            mesh, inputs, pid, profiles
+                            mesh, inputs, pid, profiles,
+                            plane_cache=store._mesh_plane_cache,
+                            epoch=self.m.epoch,
+                            taint_any=self._taint_any,
                         )
                     elif solver == "wave":
                         result = solve_fn(*inputs, pid=pid,
-                                          profiles=profiles)
+                                          profiles=profiles,
+                                          taint_any=self._taint_any)
                     else:
                         result = solve_fn(*inputs)
                     # One batched device->host fetch: through a
@@ -1143,6 +1219,189 @@ class FastCycle:
                     store._aff_clean_cycles = 0
                 else:
                     store._aff_clean_cycles = clean
+
+    # ------------------------------------------------- pipelined sessions
+
+    def _dispatch_async(self, cjobs: List[int], crows: np.ndarray,
+                        kind: str, payload) -> None:
+        """Park a dispatched-but-unread device solve on the store; the
+        device round trip then runs concurrently with this cycle's
+        backfill/close/enqueue and the next cycle's derive, and
+        ``_commit_inflight`` lands it at the top of cycle N+1 (the
+        double-buffered session of ISSUE 1).  ``payload`` is either a
+        jax ``AllocResult`` with ``copy_to_host_async`` already issued
+        (kind "local") or a ``solver_service.PendingSolve`` (kind
+        "remote")."""
+        from .pipeline import InflightSolve
+
+        # Commit prep that needs no assignment overlaps the round trip.
+        req_gather = self.m.c_req.gather(crows)
+        self.store._inflight_solve = InflightSolve(
+            kind, payload, list(cjobs), crows, req_gather,
+            self.m.mutation_seq, self.m.epoch, self.m.compact_gen,
+            self.Nn,
+        )
+
+    def _commit_inflight(self) -> None:
+        """Fetch + commit the previous cycle's dispatched solve (runs
+        first, before this cycle's actions).  A staleness guard drops
+        rows invalidated by store mutations that landed during the
+        overlap — pod deleted/bound/evicted, node gone, capacity taken
+        by the fast path — the same per-task semantics the async-bind
+        failure queue already has; everything else commits exactly as a
+        synchronous cycle would have."""
+        from .pipeline import take_inflight
+
+        inflight = take_inflight(self.store)
+        if inflight is None:
+            return
+        m = self.m
+        lanes = self.lanes
+        if inflight.compact_gen != m.compact_gen:
+            # Pod rows were renumbered while the solve was in flight;
+            # the whole result is void (rows are otherwise stable for a
+            # pod's lifetime).  The pods are still Pending and re-place
+            # this cycle.
+            log.info("in-flight solve predates a mirror compaction; "
+                     "dropped (%d rows re-place this cycle)",
+                     len(inflight.task_rows))
+            inflight.abandon()
+            return
+        t0 = time.perf_counter()
+        try:
+            assigned = inflight.fetch()
+        except Exception as e:
+            if inflight.kind == "remote" and isinstance(
+                    e, (OSError, ConnectionError, ValueError)):
+                # Lost reply (solver child died, connection dropped):
+                # the pods are still Pending and re-place below; a
+                # persistently dead child surfaces synchronously at
+                # this cycle's own dispatch (solve_async's send).
+                log.warning(
+                    "in-flight remote solve reply lost; %d rows "
+                    "re-place this cycle",
+                    len(inflight.task_rows), exc_info=True,
+                )
+                return
+            if self._is_device_crash(e):
+                # Execution-time crashes surface at the async fetch,
+                # not at dispatch: route them through the same budget
+                # degradation the synchronous solve gets (halve the
+                # affinity chunk budget, re-probe the runtime; raises
+                # when the device stayed down so the scheduler's
+                # failure/health accounting takes over).
+                log.warning(
+                    "in-flight solve fetch hit a device crash; %d "
+                    "rows re-place this cycle",
+                    len(inflight.task_rows),
+                )
+                self._on_device_crash(e)
+                return
+            # A programming error must propagate, exactly as it would
+            # from a synchronous solve.
+            raise
+        t_done = time.perf_counter()
+        lanes["device"] = lanes.get("device", 0.0) + (t_done - t0)
+        # The residual wait is the pipeline's health signal: it
+        # approaches zero exactly when the overlap works.  The
+        # dispatch->available round trip is unobservable here (the
+        # solve may have finished during the inter-cycle sleep), so
+        # device_solve_latency keeps its synchronous-solve meaning and
+        # gets nothing from this path.
+        metrics.inflight_fetch_wait.observe((t_done - t0) * 1e3)
+        t0 = time.perf_counter()
+        task_rows = inflight.task_rows
+        assigned = np.asarray(assigned[:len(task_rows)]).astype(
+            np.int64, copy=False)
+        req_gather = inflight.req_gather
+        if (m.mutation_seq != inflight.mutation_seq
+                or self.Nn != inflight.n_nodes):
+            assigned = self._revalidate_inflight(
+                task_rows, assigned,
+                node_churn=(m.epoch != inflight.epoch),
+            )
+            # Row set changed: let _commit re-gather the committed rows.
+            req_gather = None
+        if (assigned >= 0).any():
+            self._commit(
+                inflight.solve_jobs, task_rows, assigned,
+                np.zeros(len(inflight.solve_jobs), bool),
+                np.zeros(len(task_rows), bool), req_gather,
+            )
+        lanes["commit"] = (lanes.get("commit", 0.0)
+                           + time.perf_counter() - t0)
+
+    def _revalidate_inflight(self, task_rows: np.ndarray,
+                             assigned: np.ndarray,
+                             node_churn: bool = False) -> np.ndarray:
+        """Drop assignment rows invalidated during the overlap; returns
+        ``assigned`` with conflicting rows forced to -1.
+
+        Checks, all vectorized: the pod row is still alive + Pending
+        (deletes, fast-path binds, evictions, bind-failure resyncs all
+        leave some other status), the target node row still exists, is
+        alive and ready, and charging the surviving rows neither
+        oversubscribes a node's allocatable nor its task slots (rows on
+        a conflicted node are dropped wholesale — conservative, the
+        next cycle re-places them).
+
+        Constraint-sensitive rows cannot be re-checked cheaply, so they
+        drop conservatively and re-place next cycle against fresh
+        state: pods with inter-pod terms whenever ANY mutation landed
+        (a peer's placement may have moved the affinity landscape), and
+        pods with a node selector, node-affinity terms, or tolerations
+        when ``node_churn`` says the node table itself changed (labels/
+        taints the solve matched against are stale)."""
+        m = self.m
+        nn = self.Nn
+        ok = assigned >= 0
+        ok &= m.p_alive[task_rows] & (m.p_status[task_rows] == ST_PENDING)
+        ok &= ~m.p_has_ip[task_rows]
+        if node_churn:
+            sensitive = (
+                m.p_has_tol[task_rows]
+                | (m.p_aff_lo[task_rows] < m.p_aff_hi[task_rows])
+            )
+            er, _li = m.c_sel.gather(task_rows)
+            has_sel = np.zeros(len(task_rows), bool)
+            has_sel[er] = True
+            ok &= ~(sensitive | has_sel)
+        ok &= assigned < nn
+        node = np.clip(assigned, 0, max(nn - 1, 0))
+        if nn:
+            ok &= self.n_ready[node]
+        dropped_live = int(np.count_nonzero((assigned >= 0) & ~ok))
+        if not ok.any():
+            if dropped_live:
+                log.info("in-flight solve fully invalidated by "
+                         "concurrent mutations (%d rows)", dropped_live)
+            return np.where(ok, assigned, -1)
+        # Capacity re-check against TODAY's derive: the req gather is
+        # re-read (a pod update may have changed requests in place).
+        rows_ok = task_rows[ok]
+        nodes_ok = assigned[ok]
+        er, si, v = m.c_req.gather(rows_ok)
+        add = np.bincount(
+            nodes_ok[er].astype(np.int64) * self.R + si,
+            weights=v, minlength=nn * self.R,
+        ).reshape(nn, self.R).astype(F)
+        ntasks_add = np.bincount(nodes_ok, minlength=nn).astype(I)
+        bad = (
+            ((self.n_used + add) > self.n_alloc + self.eps[None, :])
+            .any(axis=1)
+            | ((self.n_ntasks + ntasks_add) > self.n_maxtasks)
+        )
+        if bad.any():
+            ok &= ~bad[node]
+        out = np.where(ok, assigned, -1)
+        n_drop = int(np.count_nonzero((assigned >= 0) & (out < 0)))
+        if n_drop:
+            log.info(
+                "staleness guard dropped %d/%d in-flight rows "
+                "(concurrent store mutations); survivors commit",
+                n_drop, int(np.count_nonzero(assigned >= 0)),
+            )
+        return out
 
     def _solve_chunks(self, solve_jobs: List[int], task_rows: np.ndarray):
         """Split one solve call at job boundaries when the affinity count
@@ -1598,6 +1857,19 @@ class FastCycle:
         return (req, init_req, port_bits, sel_bits, aff_bits, aff_terms,
                 tol_bits, pref_bits, pref_w)
 
+    def _device_snapshot(self):
+        """The store's persistent device-resident snapshot, or None on
+        paths that ship numpy (remote solver frames, mesh sharding — the
+        mesh keeps its own per-device plane cache in parallel/mesh.py)
+        or when disabled (VOLCANO_TPU_DEVSNAP=0)."""
+        if (getattr(self.store, "remote_solver", None) is not None
+                or getattr(self.store, "solve_mesh", None) is not None
+                or os.environ.get("VOLCANO_TPU_DEVSNAP", "1") == "0"):
+            return None
+        from .ops.devsnap import for_store
+
+        return for_store(self.store)
+
     def _solve_inputs(self, solve_jobs: List[int], task_rows: np.ndarray,
                       slim: bool = False):
         self._flush_aggr()
@@ -1659,18 +1931,60 @@ class FastCycle:
             releasing_in = np.zeros((1, R), F)
         else:
             releasing_in = padN(releasing_np)
+        # Device-resident snapshot (ops/devsnap.py): the node planes that
+        # move only with the NODE table — allocatable, max-task counts,
+        # readiness, label/taint bit planes — live on the device across
+        # cycles, updated by per-row delta scatters from the mirror's
+        # dirty set instead of full re-uploads.  Per-cycle planes (idle,
+        # ntasks, ports) still ship fresh.  The host copies above stay
+        # the taint-feature source (solve_wave must not fetch a device
+        # array back through the tunnel just to compute a static flag).
+        self._taint_any = bool(n_taint_bits.any()) if slim else None
+        snap = self._device_snapshot() if slim else None
+        if snap is not None and N:
+            planes = snap.node_planes(m, (m.epoch, Np, R, LW, TW), {
+                # rows=None -> full padded plane; rows array -> just
+                # those rows (devsnap's delta scatter, so a one-node
+                # change never materializes full [Np, *] host copies).
+                "allocatable": lambda rows: (
+                    padN(self.n_alloc.astype(F)) if rows is None
+                    else self.n_alloc[rows].astype(F)),
+                "max_tasks": lambda rows: (
+                    padN(self.n_maxtasks) if rows is None
+                    else self.n_maxtasks[rows]),
+                "ready": lambda rows: (
+                    padN(self.n_ready) if rows is None
+                    else self.n_ready[rows]),
+                "label_bits": lambda rows: (
+                    n_label_bits if rows is None
+                    else n_label_bits[rows]),
+                "taint_bits": lambda rows: (
+                    n_taint_bits if rows is None
+                    else n_taint_bits[rows]),
+            })
+            alloc_in = planes["allocatable"]
+            maxt_in = planes["max_tasks"]
+            ready_in = planes["ready"]
+            lbits_in = planes["label_bits"]
+            tbits_in = planes["taint_bits"]
+        else:
+            alloc_in = padN(self.n_alloc.astype(F))
+            maxt_in = padN(self.n_maxtasks)
+            ready_in = padN(self.n_ready)
+            lbits_in = n_label_bits
+            tbits_in = n_taint_bits
         nodes = SolveNodes(
             idle=padN(self.n_idle.astype(F)),
-            allocatable=padN(self.n_alloc.astype(F)),
+            allocatable=alloc_in,
             releasing=releasing_in,
             pipelined=(np.zeros((1, R), F) if slim
                        else np.zeros((Np, R), F)),
             ntasks=padN(self.n_ntasks),
-            max_tasks=padN(self.n_maxtasks),
+            max_tasks=maxt_in,
             ports=n_ports,
-            ready=padN(self.n_ready),
-            label_bits=n_label_bits,
-            taint_bits=n_taint_bits,
+            ready=ready_in,
+            label_bits=lbits_in,
+            taint_bits=tbits_in,
         )
 
         # ---- tasks
@@ -2114,6 +2428,11 @@ class FastCycle:
         bind_keys = getattr(binder, "bind_keys", None)
         notify = store._watchers
         pod_a, key_a, name_a = self._obj_arrays()
+        # Bound hostnames land in the mirror as ONE batched column write
+        # (the vectorized replacement for the 100k pod-record setattr
+        # walk, which now only runs for record consumers — deferred to
+        # the bind dispatcher or the sync-bind path below).
+        m.p_node_name[rows] = name_a[nodes_c]
         defer_records = (
             getattr(store, "async_bind", False)
             and not notify
@@ -2253,14 +2572,31 @@ class FastCycle:
         bound while errTasks resyncs the failed one, with the gang
         plugin's session-close conditions and the job's lifecycle
         policies handling a persistently failing member."""
-        m = self.m
-        self._flush_aggr()
         failed = set(failed_keys)
         idx = [i for i, k in enumerate(keys) if k in failed]
         if not idx:
             return
         log.warning("%d binds failed; tasks resync to Pending", len(idx))
-        rows_f = np.array([bound_rows[i] for i in idx], np.int64)
+        self._unbind_rows(np.array([bound_rows[i] for i in idx], np.int64))
+        for i in idx:
+            bound_pods[i].node_name = None
+        for i in idx:
+            # Claims the failed pod pinned/bound roll back with it
+            # (release only after every failed pod's node_name is
+            # cleared, so shared claims held by co-failed pods free up).
+            if bound_pods[i].volumes:
+                self.store.release_claims_for(bound_pods[i])
+
+    def _unbind_rows(self, rows_f: np.ndarray) -> None:
+        """Return bound mirror rows to Pending, reversing the commit's
+        bookkeeping (node capacity/task slots, job and queue counters) —
+        the vectorized core shared by the bind-failure resync above and
+        the steady-state workload feed (``store.cycle_feed``), which
+        re-pends just-committed rows to emulate continuous pod arrival
+        at constant backlog.  Pod RECORDS are not touched; callers that
+        need ``pod.node_name`` cleared do it themselves."""
+        m = self.m
+        self._flush_aggr()
         nodes_f = m.p_node[rows_f].astype(np.int64)
         sub = np.zeros((self.Nn, self.R), F)
         er, si, v = m.c_req.gather(rows_f)
@@ -2270,6 +2606,7 @@ class FastCycle:
         np.add.at(self.n_ntasks, nodes_f, -1)
         m.p_status[rows_f] = ST_PENDING
         m.p_node[rows_f] = -1
+        m.p_node_name[rows_f] = None
         self.resident[rows_f] = False
         jr = self.jobr[rows_f]
         np.add.at(self.j_cnt_alloc, jr, -1)
@@ -2286,19 +2623,14 @@ class FastCycle:
             np.add.at(
                 self.q_alloc, (q_of[er][er_q], si[er_q]), -v[er_q]
             )
-        for i in idx:
-            bound_pods[i].node_name = None
-        for i in idx:
-            # Claims the failed pod pinned/bound roll back with it
-            # (release only after every failed pod's node_name is
-            # cleared, so shared claims held by co-failed pods free up).
-            if bound_pods[i].volumes:
-                self.store.release_claims_for(bound_pods[i])
+        # Mirror state moved: an overlapping dispatch must re-validate.
+        m.mutation_seq += 1
 
     # ------------------------------------------------------------ backfill
 
-    def _backfill(self) -> None:
-        """Place zero-request pending tasks (backfill.go:39-88)."""
+    def _backfill(self) -> bool:
+        """Place zero-request pending tasks (backfill.go:39-88).
+        Returns True when any row was bound (mirror state moved)."""
         m = self.m
         Pn = self.Pn
         status = m.p_status[:Pn]
@@ -2306,7 +2638,7 @@ class FastCycle:
             m.p_alive[:Pn] & (status == ST_PENDING) & m.p_be[:Pn]
         )
         if not len(be_rows):
-            return
+            return False
         schedulable = set(self._schedulable_rows())
         # Node order: store insertion order (dict iteration in the object
         # path) == mirror row order.
@@ -2327,6 +2659,7 @@ class FastCycle:
             if placed is not None:
                 m.p_status[row] = ST_BOUND
                 m.p_node[row] = placed
+                m.p_node_name[row] = m.n_name[placed]
                 self.n_ntasks[placed] += 1
                 self.resident[row] = True
                 self.j_cnt_alloc[jrow] += 1
@@ -2380,6 +2713,7 @@ class FastCycle:
                     m.p_status[row] = ST_PENDING
                     self.n_ntasks[m.p_node[row]] -= 1
                     m.p_node[row] = -1
+                    m.p_node_name[row] = None
                     self.resident[row] = False
                     pod.node_name = None
                     if jrow >= 0:
@@ -2395,6 +2729,7 @@ class FastCycle:
                 if store._watchers:
                     store._notify("Pod", "bind", pod)
             store.mark_objects_stale()
+        return bool(bound_rows)
 
     def _host_predicate(self, row: int, feat, ni: int) -> bool:
         """Host predicates for best-effort tasks (predicates.go:144-293,

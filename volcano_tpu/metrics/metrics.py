@@ -169,6 +169,12 @@ class Metrics:
             f"{ns}_device_solve_latency_milliseconds",
             "Device allocate-solver latency in milliseconds",
         )
+        self.inflight_fetch_wait = _Histogram(
+            f"{ns}_inflight_fetch_wait_milliseconds",
+            "Residual wait fetching the pipelined in-flight solve at "
+            "cycle top; approaches zero when the overlap hides the "
+            "device round trip",
+        )
         self.device_crash_recoveries = _Counter(
             f"{ns}_device_crash_recoveries_total",
             "Mid-solve TPU runtime crashes recovered by degrading the "

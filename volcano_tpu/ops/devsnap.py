@@ -1,0 +1,142 @@
+"""Device-resident snapshot planes with delta uploads.
+
+The synchronous cycle re-shipped every solver input each solve, although
+most node-side planes — allocatable capacity, label/taint bit planes,
+max-task counts, readiness, topology domains — change only when the NODE
+table changes (the mirror's epoch key), not per cycle.  Through a
+remote-TPU tunnel (~35 MB/s effective into-execution bandwidth,
+BASELINE.md) those re-uploads sit on the dispatch path of every cycle.
+
+``DeviceSnapshot`` keeps one persistent per-device array per plane,
+keyed by the mirror epoch + plane shape:
+
+- key unchanged  -> the cached device array is handed straight to the
+  jit call: zero upload, zero host copy;
+- epoch advanced with shapes intact -> only the rows the mirror recorded
+  dirty (``StoreMirror.node_delta_rows``) are uploaded and scattered
+  into the DONATED persistent buffer (``donate_argnums`` on the scatter
+  carry, so steady-state updates allocate nothing device-side);
+- shape changed / delta unprovable -> full re-upload.
+
+One snapshot instance lives per store (``store.device_snapshot``),
+created by the fast path on first use; it only serves the single-process
+wave path — the remote split ships numpy frames (the child process owns
+its own device state) and the mesh path has its own sharded input cache
+(``parallel.mesh.shard_wave_inputs``).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# Above this fraction of rows dirty, a full re-upload beats the scatter.
+DELTA_MAX_FRACTION = 0.25
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(buf, rows, vals):
+    """Write ``vals`` into ``buf`` at ``rows`` (leading axis), reusing the
+    donated buffer in place.  Padded duplicate rows rewrite the same
+    value — idempotent."""
+    return buf.at[rows].set(vals)
+
+
+def _pad_delta(rows: np.ndarray, vals: np.ndarray):
+    """Pad a delta to a headroomed pow2 bucket (ops.wave.bucket_pow2:
+    +25% so dirty-row counts hovering at a power of two don't flip
+    buckets cycle-to-cycle — each flip recompiles the scatter) so the
+    jit compiles per bucket, not per distinct dirty-row count
+    (duplicates of row 0 are idempotent rewrites)."""
+    from .wave import bucket_pow2
+
+    k = bucket_pow2(len(rows), floor=8)
+    pad = k - len(rows)
+    if pad:
+        rows = np.concatenate([rows, np.full(pad, rows[0], rows.dtype)])
+        vals = np.concatenate(
+            [vals, np.repeat(vals[:1], pad, axis=0)], axis=0
+        )
+    return rows.astype(np.int32), vals
+
+
+class DeviceSnapshot:
+    """Persistent per-device plane set for one store (see module doc)."""
+
+    def __init__(self):
+        # name -> device array, all planes sharing self._key.
+        self._planes: Dict[str, object] = {}
+        self._key: Optional[Tuple] = None
+        # Telemetry for tests/bench: full vs delta vs hit counts.
+        self.full_uploads = 0
+        self.delta_uploads = 0
+        self.hits = 0
+
+    # ------------------------------------------------------------- planes
+
+    def node_planes(self, m, key: Tuple,
+                    build: Dict[str, Callable[[], np.ndarray]]):
+        """Return ``{name: device_array}`` for the node-side planes.
+
+        ``key`` is ``(epoch, shape components...)`` with the epoch FIRST;
+        ``build[name](rows)`` returns the full padded host plane when
+        ``rows`` is None, or just those rows' values for a delta scatter
+        (only called on upload — a key hit touches no host memory).  All
+        planes move together under one key."""
+        if self._key == key and self._planes.keys() == build.keys():
+            self.hits += 1
+            return self._planes
+        delta_rows = None
+        if (
+            self._key is not None
+            and self._key[1:] == key[1:]
+            and self._planes.keys() == build.keys()
+        ):
+            delta_rows = m.node_delta_rows(self._key[0])
+            n_rows = key[1] if len(key) > 1 else 0
+            if delta_rows is not None and (
+                len(delta_rows) == 0
+                or len(delta_rows) > max(1, int(n_rows))
+                * DELTA_MAX_FRACTION
+            ):
+                delta_rows = None if len(delta_rows) else delta_rows
+        if delta_rows is not None and len(delta_rows) == 0:
+            # Epoch moved but no node rows recorded dirty (defensive —
+            # epoch bumps outside the node table); planes are current.
+            m.reset_node_delta()
+            self._key = key
+            self.hits += 1
+            return self._planes
+        if delta_rows is not None:
+            for name, fn in build.items():
+                rows, vals = _pad_delta(
+                    delta_rows, np.asarray(fn(delta_rows))
+                )
+                self._planes[name] = _scatter_rows(
+                    self._planes[name], rows, vals
+                )
+            m.reset_node_delta()
+            self._key = key
+            self.delta_uploads += 1
+            return self._planes
+        self._planes = {
+            name: jax.device_put(np.asarray(fn(None)))
+            for name, fn in build.items()
+        }
+        m.reset_node_delta()
+        self._key = key
+        self.full_uploads += 1
+        return self._planes
+
+def for_store(store) -> DeviceSnapshot:
+    """The store's snapshot, created on first use."""
+    snap = getattr(store, "device_snapshot", None)
+    if snap is None:
+        snap = store.device_snapshot = DeviceSnapshot()
+    return snap

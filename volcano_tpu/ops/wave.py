@@ -1625,6 +1625,7 @@ def solve_wave(
     profiles: SolveProfiles = None,
     extra_ok=None,
     extra_score=None,
+    taint_any=None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -1761,7 +1762,12 @@ def solve_wave(
             or _np(profiles.t_soft).any()
             or cnt0_any
         ),
-        bool(_np(nodes.taint_bits).any()),
+        # Device-resident callers (ops/devsnap.py, the mesh plane cache)
+        # pass the taint feature as a host-computed hint — fetching a
+        # persistent device plane back just to .any() it would put a
+        # tunnel round trip on every dispatch.
+        (bool(taint_any) if taint_any is not None
+         else bool(_np(nodes.taint_bits).any())),
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),
         extra_ok is not None,

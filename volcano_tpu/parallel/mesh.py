@@ -111,8 +111,19 @@ def sharded_solve_wave(mesh: Mesh, solve_args: Sequence,
     return solve_wave(*args, **kw)
 
 
+# SolveNodes fields that move only with the NODE table (the mirror's
+# epoch key), not per cycle: with a plane cache these skip the per-cycle
+# device_put entirely (the multi-chip analog of ops/devsnap.py — the
+# sharded placement makes them a persistent PER-DEVICE array set).
+_EPOCH_STABLE_NODE_FIELDS = frozenset(
+    {"allocatable", "max_tasks", "ready", "label_bits", "taint_bits"}
+)
+
+
 def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
-                      axis: str = NODES_AXIS):
+                      axis: str = NODES_AXIS,
+                      plane_cache: Optional[dict] = None,
+                      epoch: Optional[int] = None):
     """Mesh placement for the fast path's pre-profiled wave inputs.
 
     Beyond the node-axis sharding of ``shard_solve_args``, the affinity
@@ -130,6 +141,11 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
 
     The kernel's count-window contraction (cnt @ dom_ohT over D) then
     runs as partial products with an XLA-inserted reduce over ICI.
+
+    ``plane_cache`` (with ``epoch``) keeps the epoch-stable node planes
+    and ``aff.node_dom`` resident on the mesh across cycles: a hit skips
+    their host->device transfer entirely (pass the same dict every
+    cycle; the fast path parks one on the store).
     """
     node_sharded = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
@@ -146,6 +162,20 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
         sh = node_sharded if (a.ndim and a.shape[0] == n_nodes) \
             else replicated
         return jax.device_put(a, sh)
+
+    def put_node_cached(name, x):
+        # Persistent per-device plane: re-ship only when the node table
+        # (epoch) or the padded shape moved.
+        if plane_cache is None or epoch is None:
+            return put_node(x)
+        a = np.asarray(x)
+        key = (epoch, a.shape, a.dtype.str, mesh.devices.size)
+        hit = plane_cache.get(name)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        arr = put_node(a)
+        plane_cache[name] = (key, arr)
+        return arr
 
     n_mesh = mesh.devices.size
 
@@ -165,9 +195,13 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
             )
         return jax.device_put(a, col_sharded)
 
-    nodes = type(nodes)(*[put_node(x) for x in nodes])
+    nodes = type(nodes)(*[
+        put_node_cached(name, x)
+        if name in _EPOCH_STABLE_NODE_FIELDS else put_node(x)
+        for name, x in zip(type(nodes)._fields, nodes)
+    ])
     aff = type(aff)(
-        node_dom=put_node(aff.node_dom),
+        node_dom=put_node_cached("node_dom", aff.node_dom),
         term_key=jax.device_put(np.asarray(aff.term_key), replicated),
         cnt0=put_cols(aff.cnt0),
         t_req_aff=jax.device_put(np.asarray(aff.t_req_aff), replicated),
@@ -207,14 +241,20 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
 
 def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
                              profiles, axis: str = NODES_AXIS,
-                             wave: Optional[int] = None):
+                             wave: Optional[int] = None,
+                             plane_cache: Optional[dict] = None,
+                             epoch: Optional[int] = None,
+                             taint_any=None):
     """The fast path's solve dispatch on a mesh (FastCycle._allocate when
     ``store.solve_mesh`` is set): pre-profiled inputs, node axis + count
-    tensors sharded per ``shard_wave_inputs``."""
+    tensors sharded per ``shard_wave_inputs``; epoch-stable planes stay
+    mesh-resident across cycles via ``plane_cache``."""
     from ..ops.wave import solve_wave
 
     args, pid, profiles = shard_wave_inputs(
-        mesh, solve_args, pid, profiles, axis
+        mesh, solve_args, pid, profiles, axis,
+        plane_cache=plane_cache, epoch=epoch,
     )
     kw = {} if wave is None else {"wave": wave}
-    return solve_wave(*args, pid=pid, profiles=profiles, **kw)
+    return solve_wave(*args, pid=pid, profiles=profiles,
+                      taint_any=taint_any, **kw)

@@ -1,0 +1,117 @@
+"""Double-buffered scheduler sessions: the in-flight solve handle.
+
+The pipelined cycle (ISSUE 1; Gavel, arxiv 2008.09213 — overlapping the
+optimizer solve with state ingestion and commit is where accelerator-
+batched schedulers get their throughput) dispatches the device solve for
+session N WITHOUT waiting for the result; the device round trip then
+runs concurrently with cycle N's close/enqueue and cycle N+1's
+derive/order/encode host lanes.  The assignment vectors are fetched and
+committed at the TOP of cycle N+1, after a staleness guard re-validates
+them against store mutations that landed during the overlap
+(``fastpath.FastCycle._commit_inflight``).
+
+``InflightSolve`` is the handle the fast path parks on the store
+(``store._inflight_solve``) between the two cycles.  Two payload kinds:
+
+- ``"local"``: a jax ``AllocResult`` whose arrays are still device
+  futures (``copy_to_host_async`` already issued); ``fetch()`` is one
+  batched ``jax.device_get``.  Covers the single-process and mesh paths.
+- ``"remote"``: a ``solver_service.PendingSolve`` — frame N was sent,
+  the reply has not been read; ``fetch()`` receives and decodes it.
+
+Validity bookkeeping captured at dispatch time:
+
+- ``mutation_seq``: the mirror's pod/node mutation counter.  Equality at
+  fetch time proves nothing moved during the overlap, so the capacity
+  re-validation is skipped wholesale (the steady-state case).
+- ``epoch``: the mirror's node-table epoch.  A bump means node labels,
+  taints, allocatable, or membership changed — the re-validation then
+  drops rows whose pods carry node-sensitive constraints (selector,
+  node-affinity terms, tolerations) since the solve saw stale planes.
+- ``compact_gen``: pod rows are stable for a pod's lifetime (tombstones
+  are never reused), so row indices survive every mutation EXCEPT a
+  table compaction — a generation bump voids the whole result.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class InflightSolve:
+    """A dispatched-but-uncommitted device solve (session N's result,
+    consumed at the top of session N+1)."""
+
+    __slots__ = (
+        "kind", "payload", "solve_jobs", "task_rows", "req_gather",
+        "mutation_seq", "epoch", "compact_gen", "n_nodes",
+    )
+
+    def __init__(self, kind: str, payload, solve_jobs: List[int],
+                 task_rows: np.ndarray, req_gather: Tuple,
+                 mutation_seq: int, epoch: int, compact_gen: int,
+                 n_nodes: int):
+        self.kind = kind
+        self.payload = payload
+        self.solve_jobs = solve_jobs
+        self.task_rows = task_rows
+        # (elem_rows, slot_idx, values) c_req gather over task_rows,
+        # prepared at dispatch time so the commit needs no host gather.
+        self.req_gather = req_gather
+        self.mutation_seq = mutation_seq
+        self.epoch = epoch
+        self.compact_gen = compact_gen
+        self.n_nodes = n_nodes
+
+    # ----------------------------------------------------------- lifecycle
+
+    def fetch(self) -> np.ndarray:
+        """Block on the remaining device/remote round trip; return the
+        assignment vector ([P] int32, node row or -1) as numpy."""
+        if self.kind == "remote":
+            res = self.payload.fetch()
+            return np.asarray(res.assigned)
+        import jax
+
+        (assigned,) = jax.device_get((self.payload.assigned,))
+        return np.asarray(assigned)
+
+    def abandon(self) -> None:
+        """Drop the pending result without committing it.  The solved
+        pods are still Pending store-side, so nothing is lost — the next
+        dispatched cycle simply re-places them."""
+        if self.kind == "remote":
+            try:
+                self.payload.abandon()
+            except Exception:  # pragma: no cover - best-effort teardown
+                log.debug("in-flight remote solve abandon failed",
+                          exc_info=True)
+        # Local device futures just lose their last reference; the
+        # runtime completes and frees them off-thread.
+        self.payload = None
+
+
+def take_inflight(store) -> Optional[InflightSolve]:
+    """Pop the store's in-flight solve (None when no dispatch pending)."""
+    inflight = getattr(store, "_inflight_solve", None)
+    if inflight is not None:
+        store._inflight_solve = None
+    return inflight
+
+
+def abandon_inflight(store) -> bool:
+    """Drop a pending dispatch, if any (scheduler shutdown / restart:
+    the solved pods stay Pending and re-place on the next cycle).
+    Returns True when one was abandoned."""
+    inflight = take_inflight(store)
+    if inflight is None:
+        return False
+    log.info("abandoning in-flight solve of %d task rows",
+             len(inflight.task_rows))
+    inflight.abandon()
+    return True

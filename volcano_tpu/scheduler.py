@@ -202,6 +202,14 @@ class Scheduler:
                     log.exception(
                         "Fast path failed; falling back to object session"
                     )
+            # An in-flight pipelined solve must not survive into the
+            # object session: its pods still read as Pending there and
+            # would double-schedule when the fast path later committed
+            # the stale assignment.  Abandoning is safe — the pods
+            # re-place on whichever path runs this cycle.
+            from .pipeline import abandon_inflight
+
+            abandon_inflight(self.store)
             # The object session snapshots pod RECORDS as scheduling
             # truth: force any deferred bind-record walks (node_name on
             # committed pods, normally applied post-cycle by the bind
@@ -304,7 +312,34 @@ class Scheduler:
             elapsed = time.time() - t0
             self._stop.wait(max(self.schedule_period - elapsed, 0.0))
 
-    def stop(self) -> None:
+    # stop(): how long to wait for the loop thread.  Cycles never block
+    # on the device any more (the pipelined dispatch is asynchronous and
+    # the fetch happens at cycle top), so a healthy thread exits within
+    # one cycle; the bound covers a wedged device runtime.
+    STOP_TIMEOUT = 30.0
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Stop the periodic loop and drain the pipelined dispatch.
+
+        Joins the loop thread (it must die — a silently-leaked thread
+        kept scheduling behind restarts), then abandons any in-flight
+        device solve left parked between cycles: the solved pods are
+        still Pending store-side, so nothing is lost — a restarted
+        scheduler simply re-places them on its first cycle."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        t = self._thread
+        if t is not None:
+            t.join(self.STOP_TIMEOUT if timeout is None else timeout)
+            if t.is_alive():
+                log.error(
+                    "scheduler loop thread did not exit within %.0fs; "
+                    "in-flight state NOT drained",
+                    self.STOP_TIMEOUT if timeout is None else timeout,
+                )
+                return
+            self._thread = None
+        # Only after the thread is dead: the cycle thread owns the
+        # in-flight handle while it runs.
+        from .pipeline import abandon_inflight
+
+        abandon_inflight(self.store)

@@ -129,6 +129,7 @@ class Service:
         remote_evictor: Optional[str] = None,
         remote_status_updater: Optional[str] = None,
         remote_solver: Optional[str] = None,
+        pipeline: Optional[bool] = None,
     ):
         # Remote side-effect boundaries (cache/remote.py): binds
         # (cache.go:492-554), evictions (:439-491), and status writes
@@ -177,6 +178,11 @@ class Service:
             client = RemoteSolver(remote_solver)
             client.ping()  # fail fast on a permanently wrong address
             self.store.remote_solver = client
+        if pipeline is not None:
+            # Pipelined sessions (double-buffered cycles, ISSUE 1): the
+            # device solve dispatches asynchronously and commits at the
+            # top of the next cycle.  None defers to VOLCANO_TPU_PIPELINE.
+            self.store.pipeline = bool(pipeline)
         # Production binds dispatch on the background worker with
         # errTasks-style failure backoff (cache.go:536-552, 627-649);
         # opt out with VOLCANO_TPU_ASYNC_BIND=0 (tests that assert binds
@@ -373,6 +379,36 @@ class Service:
                             if evs:
                                 d["events"] = evs
                             self._json(200, d)
+                    elif parts[:2] == ["apis", "placements"]:
+                        # Bound placements straight from the mirror's
+                        # batched p_node_name column (one vectorized
+                        # mask + gather) — the scheduler's authoritative
+                        # view, current even while the async bind
+                        # dispatcher's 100k pod-record walks are still
+                        # deferred (records lag the commit by design).
+                        import numpy as _np
+
+                        from .api import TaskStatus
+
+                        limit = int(parse_qs(url.query).get(
+                            "limit", [1000])[0])
+                        st = service.store
+                        m = st.mirror
+                        with st._lock:
+                            n = len(m.p_uid)
+                            rows = _np.flatnonzero(
+                                m.p_alive[:n]
+                                & (m.p_status[:n]
+                                   == int(TaskStatus.Bound))
+                            )
+                            total = int(len(rows))
+                            rows = rows[:max(limit, 0)]
+                            hosts = m.p_node_name[rows].tolist()
+                            keys = [m.p_key[r] for r in rows.tolist()]
+                        self._json(200, {
+                            "bound": total,
+                            "placements": dict(zip(keys, hosts)),
+                        })
                     elif parts[:2] == ["apis", "queues"]:
                         self._json(
                             200,
@@ -503,6 +539,13 @@ def main(argv=None) -> int:
                         "inputs ship as one C++-packed snapshot frame and "
                         "the assignment vectors return — the north-star "
                         "store<->solver bridge (cache.go:492-554 analog)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="pipelined scheduler cycles: dispatch the device "
+                        "solve asynchronously and commit it at the top of "
+                        "the next cycle, hiding the device round trip "
+                        "behind the host lanes (a staleness guard drops "
+                        "rows invalidated during the overlap).  Also "
+                        "reachable via VOLCANO_TPU_PIPELINE=1")
     args = p.parse_args(argv)
 
     svc = Service(
@@ -516,6 +559,7 @@ def main(argv=None) -> int:
         remote_evictor=args.remote_evictor,
         remote_status_updater=args.remote_status_updater,
         remote_solver=args.remote_solver,
+        pipeline=args.pipeline or None,
     )
     port = svc.start(http_port=args.listen_port,
                      bind_address=args.bind_address)

@@ -228,6 +228,9 @@ class RemoteSolver:
         self.timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # Outstanding pipelined request (solve_async): the wire protocol
+        # is strict request/reply, so at most one may be unread.
+        self._pending: Optional["PendingSolve"] = None
         # Round-trip + payload telemetry for the BASELINE overhead table.
         self.requests = 0
         self.bytes_out = 0
@@ -253,10 +256,16 @@ class RemoteSolver:
 
     def close(self) -> None:
         with self._lock:
+            self._pending = None
             self._close_locked()
 
     def _roundtrip(self, payload: bytes) -> bytes:
         with self._lock:
+            if self._pending is not None:
+                raise RuntimeError(
+                    "a pipelined solve is in flight; fetch or abandon "
+                    "it before a synchronous round trip"
+                )
             try:
                 sock = self._connect()
                 send_frame(sock, payload)
@@ -281,25 +290,22 @@ class RemoteSolver:
         )
         return manifest
 
-    def solve(self, solve_args: Sequence, pid, profiles,
-              wave: Optional[int] = None):
-        """Ship (solve_args, pid, profiles); return an AllocResult-shaped
-        namedtuple of numpy arrays (assigned/pipelined/never_ready/
-        fit_failed/iters; idle/q_alloc stay device-side concerns and are
-        not transported — the host commit recomputes both)."""
+    def _encode_request(self, solve_args: Sequence, pid, profiles,
+                        wave: Optional[int]) -> bytes:
         from .cache import snapwire as sw
-        from .ops.allocate import AllocResult
 
         arrays: list = []
         tree = sw.flatten_tree(
             (tuple(solve_args), np.asarray(pid), profiles), arrays
         )
-        payload = sw.encode_frame(
+        return sw.encode_frame(
             arrays, {"op": "solve", "tree": tree, "wave": wave}
         )
-        self.requests += 1
-        self.bytes_out += len(payload) + 8
-        reply = self._roundtrip(payload)
+
+    def _decode_result(self, reply: bytes):
+        from .cache import snapwire as sw
+        from .ops.allocate import AllocResult
+
         self.bytes_in += len(reply) + 8
         manifest, rarrays = sw.decode_frame(reply)
         if manifest.get("op") == "error":
@@ -315,6 +321,92 @@ class RemoteSolver:
             never_ready=never_ready, fit_failed=fit_failed,
             idle=None, q_alloc=None, iters=iters,
         )
+
+    def solve(self, solve_args: Sequence, pid, profiles,
+              wave: Optional[int] = None):
+        """Ship (solve_args, pid, profiles); return an AllocResult-shaped
+        namedtuple of numpy arrays (assigned/pipelined/never_ready/
+        fit_failed/iters; idle/q_alloc stay device-side concerns and are
+        not transported — the host commit recomputes both)."""
+        payload = self._encode_request(solve_args, pid, profiles, wave)
+        self.requests += 1
+        self.bytes_out += len(payload) + 8
+        return self._decode_result(self._roundtrip(payload))
+
+    def solve_async(self, solve_args: Sequence, pid, profiles,
+                    wave: Optional[int] = None) -> "PendingSolve":
+        """Pipelined dispatch: send frame N and return WITHOUT reading
+        the reply, so the child's upload+solve+fetch runs concurrently
+        with the scheduler's host lanes; ``PendingSolve.fetch`` receives
+        it (normally at the top of cycle N+1 — the double-buffered
+        session of ISSUE 1).  One request may be outstanding at a time
+        (the wire protocol is strict request/reply on one connection).
+
+        Send errors reconnect-and-resend once, like ``solve`` — no reply
+        is outstanding yet, so the resend is safe.  A fetch error does
+        NOT resend: the frame may be mid-solve in the child, and the
+        caller's staleness machinery already treats a lost reply as "this
+        cycle placed nothing" (the pods stay Pending and re-place)."""
+        payload = self._encode_request(solve_args, pid, profiles, wave)
+        with self._lock:
+            if self._pending is not None:
+                raise RuntimeError(
+                    "a remote solve is already in flight; fetch or "
+                    "abandon it before dispatching another"
+                )
+            try:
+                sock = self._connect()
+                send_frame(sock, payload)
+            except (OSError, ConnectionError, ValueError):
+                self._close_locked()
+                sock = self._connect()
+                send_frame(sock, payload)
+            handle = PendingSolve(self)
+            self._pending = handle
+        self.requests += 1
+        self.bytes_out += len(payload) + 8
+        return handle
+
+    def _finish_async(self, handle: "PendingSolve") -> bytes:
+        with self._lock:
+            if self._pending is not handle:
+                raise RuntimeError("stale PendingSolve handle")
+            self._pending = None
+            try:
+                return recv_frame(self._sock)
+            except (OSError, ConnectionError, ValueError):
+                # The connection's request/reply framing is now
+                # indeterminate; drop it so the next dispatch starts
+                # clean on a fresh socket.
+                self._close_locked()
+                raise
+
+    def _abandon_async(self, handle: "PendingSolve") -> None:
+        with self._lock:
+            if self._pending is not handle:
+                return
+            self._pending = None
+            # The unread reply would desynchronize the next request;
+            # closing the socket resets the framing (the server logs the
+            # dead peer and drops the reply).
+            self._close_locked()
+
+
+class PendingSolve:
+    """An unread remote-solve reply (see ``RemoteSolver.solve_async``)."""
+
+    def __init__(self, client: RemoteSolver):
+        self._client = client
+
+    def fetch(self):
+        """Receive + decode the reply; returns the AllocResult-shaped
+        numpy namedtuple ``RemoteSolver.solve`` returns."""
+        return self._client._decode_result(
+            self._client._finish_async(self)
+        )
+
+    def abandon(self) -> None:
+        self._client._abandon_async(self)
 
 
 def main(argv=None) -> None:
