@@ -57,6 +57,25 @@ inline int64_t vcsnap_header_bytes(uint8_t ndim) {
   return vcsnap_align8(8 + 8 * static_cast<int64_t>(ndim) + 8);
 }
 
+// Frame-codec wire constants + dtype table.  These MUST mirror the
+// Python side (cache/snapwire.py: WIRE_MAGIC / WIRE_VERSION /
+// WIRE_MAX_DIMS / _DTYPES, code = list index); tools/vclint's schema
+// cross-checker parses both sides and fails the green-gate on any
+// drift (VCL301/VCL302).  The dtype table extends APPEND-ONLY — codes
+// are wire format.
+struct VcsnapDtype { uint8_t code; const char* name; int32_t size; };
+constexpr uint32_t kVcsnapMagic = 0x4E534356u;
+constexpr uint32_t kVcsnapVersion = 1u;
+constexpr int32_t kVcsnapMaxDims = 8;
+constexpr VcsnapDtype kVcsnapDtypes[] = {
+    {0, "float32", 4}, {1, "float64", 8}, {2, "int8", 1},
+    {3, "int16", 2},   {4, "int32", 4},   {5, "int64", 8},
+    {6, "uint8", 1},   {7, "uint16", 2},  {8, "uint32", 4},
+    {9, "uint64", 8},  {10, "bool", 1},
+};
+constexpr int32_t kVcsnapNDtypes =
+    static_cast<int32_t>(sizeof(kVcsnapDtypes) / sizeof(kVcsnapDtypes[0]));
+
 }  // namespace
 
 extern "C" {
@@ -168,7 +187,8 @@ void vcsnap_frame_pack(const uint8_t* dtypes, const uint8_t* ndims,
                        const uint8_t* const* srcs, int32_t n,
                        const uint8_t* manifest, int64_t manifest_len,
                        uint8_t* out) {
-  uint32_t head[4] = {0x4E534356u, 1u, static_cast<uint32_t>(n),
+  uint32_t head[4] = {kVcsnapMagic, kVcsnapVersion,
+                      static_cast<uint32_t>(n),
                       static_cast<uint32_t>(manifest_len)};
   std::memcpy(out, head, 16);
   if (manifest_len) std::memcpy(out + 16, manifest, manifest_len);
@@ -203,7 +223,7 @@ int32_t vcsnap_frame_info(const uint8_t* buf, int64_t len,
   if (len < 16) return -1;
   uint32_t head[4];
   std::memcpy(head, buf, 16);
-  if (head[0] != 0x4E534356u || head[1] != 1u) return -1;
+  if (head[0] != kVcsnapMagic || head[1] != kVcsnapVersion) return -1;
   if (manifest_off) *manifest_off = 16;
   if (manifest_len) *manifest_len = static_cast<int64_t>(head[3]);
   if (16 + static_cast<int64_t>(head[3]) > len) return -1;
@@ -225,17 +245,28 @@ int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len, uint8_t* dtypes,
   for (int32_t i = 0; i < n; ++i) {
     if (off + 16 > len) return -1;
     uint8_t nd = buf[off + 1];
-    if (nd > 8) return -1;
+    if (nd > kVcsnapMaxDims) return -1;
     if (off + 8 + 8 * static_cast<int64_t>(nd) + 8 > len) return -1;
-    dtypes[i] = buf[off];
+    uint8_t dt = buf[off];
+    if (dt >= kVcsnapNDtypes) return -1;
+    dtypes[i] = dt;
     ndims[i] = nd;
     std::memcpy(dims_flat + i * 8, buf + off + 8, 8 * nd);
+    int64_t elems = 1;
     for (uint8_t d = 0; d < nd; ++d) {
-      if (dims_flat[i * 8 + d] < 0) return -1;
+      int64_t dim = dims_flat[i * 8 + d];
+      // A well-formed array's byte length fits the frame, so any dim
+      // pushing the element product past `len` marks a hostile header
+      // (and guards the multiply against overflow).
+      if (dim < 0 || (dim > 0 && elems > len / dim)) return -1;
+      elems *= dim;
     }
     int64_t nb;
     std::memcpy(&nb, buf + off + 8 + 8 * nd, 8);
     if (nb < 0) return -1;
+    // Shape x dtype width must equal the declared byte length, or a
+    // reader's zero-copy view would bleed into the next array's bytes.
+    if (nb != elems * kVcsnapDtypes[dt].size) return -1;
     off += vcsnap_header_bytes(nd);
     if (off + nb > len) return -1;
     data_off[i] = off;

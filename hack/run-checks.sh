@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Pre-snapshot green-gate (ISSUE 2): a red lint, a red sanitizer smoke,
+# or a red tier-1 suite must never again be the committed state.  Runs:
+#
+#   1. vclint        — lock discipline, device hot-path hygiene, and
+#                      schema<->C++ ABI drift (tools/vclint; exits
+#                      nonzero on any unsuppressed finding),
+#   2. csrc smoke    — the ASAN + TSAN sanitizer binaries
+#                      (make -C csrc test; -Wall -Wextra -Werror build),
+#   3. tier-1 pytest — the ROADMAP.md verify line (CPU-only, not slow).
+#
+# hack/run-e2e.sh runs this first; run it directly before any snapshot.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/3] vclint (static analysis) =="
+python -m tools.vclint
+
+echo "== [2/3] csrc sanitizer smoke (ASAN + TSAN, -Werror) =="
+make -C csrc test
+
+echo "== [3/3] tier-1 pytest =="
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider "$@"
+
+echo "run-checks: all green"

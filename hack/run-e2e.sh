@@ -3,11 +3,12 @@
 # hack/run-e2e-kind.sh): full control-plane + scheduler + fake kubelet.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
+# Green-gate first (ISSUE 2): vclint + csrc ASAN/TSAN smoke + tier-1
+# suite — the e2e pass below must never run on a red tree.
+hack/run-checks.sh
+# The pipelined-mode pass (tests/test_pipeline.py: double-buffered
+# sessions over the remote-solver split, overlap-correctness gate) runs
+# inside run-checks.sh's tier-1 leg above — not repeated here.
+exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"
-# Pipelined-mode pass: double-buffered sessions over the remote-solver
-# split (two real OS processes, frame N+1 sent while frame N's reply is
-# in flight) plus the tier-1 overlap-correctness gate.  Runs under
-# JAX_PLATFORMS=cpu — no TPU required (tier1 marker, pyproject.toml).
-exec python -m pytest tests/test_pipeline.py -q "$@"

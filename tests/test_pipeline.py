@@ -306,6 +306,45 @@ def test_fetch_programming_error_propagates(monkeypatch):
         run_cycle_fast(store, sched._load_conf())
 
 
+def test_remote_garbage_replies_fail_cycle_after_cap(monkeypatch):
+    """A solver child that keeps replying garbage never fails the
+    send-side probe, so each cycle's fetch raises and used to be
+    swallowed as a 'lost reply' forever — pods Pending, healthz green.
+    Past REMOTE_FETCH_FAIL_CAP consecutive fetch failures the cycle
+    must fail loudly (scheduler failure accounting takes over); one
+    success resets the counter."""
+    from volcano_tpu import pipeline as pl
+    from volcano_tpu.fastpath import FastCycle, run_cycle_fast
+
+    store = _small(seed=33)
+    store.pipeline = True
+    sched = Scheduler(store)
+    conf = sched._load_conf()
+    sched.run_once()
+    assert store._inflight_solve is not None
+
+    def garbage(self):
+        raise ValueError("malformed snapshot frame")
+
+    monkeypatch.setattr(pl.InflightSolve, "fetch", garbage)
+    for _ in range(FastCycle.REMOTE_FETCH_FAIL_CAP - 1):
+        # Present the parked handle as a remote dispatch; the failure
+        # is swallowed and the cycle re-dispatches.
+        store._inflight_solve.kind = "remote"
+        run_cycle_fast(store, conf)
+        assert store._inflight_solve is not None
+    store._inflight_solve.kind = "remote"
+    with pytest.raises(ValueError, match="malformed"):
+        run_cycle_fast(store, conf)
+    # Recovery: a successful fetch resets the consecutive counter (the
+    # first cycle after the failure only re-dispatches; the fetch that
+    # resets lands at the top of the one after).
+    monkeypatch.undo()
+    sched.run_once()
+    sched.run_once()
+    assert store._remote_fetch_fails == 0
+
+
 # ------------------------------------------------------- stop / restart
 
 

@@ -1,0 +1,365 @@
+"""Fixture tests for tools/vclint: each analyzer family must catch its
+seeded violation at the exact code + location, and the committed tree
+must lint clean (the green-gate's first leg).
+
+Tier-1, CPU-only: pure AST analysis, nothing here touches jax.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+from tools.vclint import hotpath, lockcheck, schemacheck
+from tools.vclint.cli import run as vclint_run
+from tools.vclint.findings import finish
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _codes(findings, path=None):
+    return [
+        (f.code, f.line) for f in findings
+        if not f.suppressed and (path is None or f.path == path)
+    ]
+
+
+# ---------------------------------------------------------------- lock
+
+
+LOCK_FIXTURE = textwrap.dedent('''\
+    import threading
+
+
+    class Widget:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._events_lock = threading.Lock()
+            self.items = {}  # guarded-by: _lock
+            self.trail = []  # guarded-by: _events_lock
+
+        def good_read(self):
+            with self._lock:
+                return len(self.items)
+
+        def bad_write(self):
+            self.items["k"] = 1
+
+        def drain_locked(self):
+            return list(self.items)
+
+        def nests(self):
+            with self._lock:
+                with self._events_lock:
+                    self.trail.append(1)
+
+        def inverted(self):
+            with self._events_lock:
+                with self._lock:
+                    return len(self.items)
+
+        # holds: _lock
+        def needs_lock(self):
+            self.items.clear()
+
+        def forgets(self):
+            self.needs_lock()
+''')
+
+
+def test_lock_checker_catches_seeded_violations():
+    raw = lockcheck.analyze_files([("fix.py", LOCK_FIXTURE)])
+    findings = finish("fix.py", LOCK_FIXTURE, raw)
+    got = _codes(findings)
+    # bad_write: unguarded write of 'items' (line 16)
+    assert ("VCL102", 16) in got
+    # forgets: calls needs_lock() without _lock (line 36)
+    assert ("VCL105", 36) in got
+    # nests() vs inverted(): _lock -> _events_lock AND the reverse
+    assert any(c == "VCL103" for c, _l in got)
+    # the guarded read via the *_locked method and the with-guarded
+    # read produce NO findings
+    lines_flagged = {l for _c, l in got}
+    assert 13 not in lines_flagged  # good_read body
+    assert 19 not in lines_flagged  # drain_locked body
+    # needs_lock's own body is covered by its holds declaration
+    assert 33 not in lines_flagged
+
+
+def test_lock_checker_unknown_lock_and_bad_annotation():
+    src = textwrap.dedent('''\
+        class W:
+            def __init__(self):
+                self.x = 1  # guarded-by: _ghost_lock
+    ''')
+    findings = finish("w.py", src, lockcheck.analyze_files([("w.py", src)]))
+    assert ("VCL104", 3) in _codes(findings)
+
+
+def test_suppression_requires_reason():
+    src = textwrap.dedent('''\
+        import threading
+
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 1  # guarded-by: _lock
+
+            def a(self):
+                return self.x  # vclint: disable=VCL101 -- single-writer
+
+            def b(self):
+                return self.x  # vclint: disable=VCL101
+    ''')
+    findings = finish("w.py", src, lockcheck.analyze_files([("w.py", src)]))
+    got = _codes(findings)
+    # a(): suppressed with a reason -> gone; b(): reasonless -> VCL002
+    # hygiene finding AND the original finding stays open.
+    assert ("VCL101", 10) not in got
+    assert ("VCL002", 13) in got
+    assert ("VCL101", 13) in got
+    sup = [f for f in findings if f.suppressed]
+    assert len(sup) == 1 and sup[0].line == 10
+    assert sup[0].reason == "single-writer"
+
+
+# ------------------------------------------------------------- hot path
+
+
+HOT_FIXTURE = textwrap.dedent('''\
+    from functools import partial
+
+    import jax
+    import numpy as np
+
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def scatter(buf, rows, vals):
+        return buf.at[rows].set(vals)
+
+
+    @partial(jax.jit, static_argnames=("mode", "gone"))
+    def kernel(x, mode):
+        return x * 2
+
+
+    def hot(buf, rows, vals, x):
+        out = solve_fn(x)
+        n = float(out)
+        buf2 = scatter(buf, rows, vals)
+        y = buf + 1
+        z = kernel(x, mode=[1, 2])
+        fetched = jax.device_get(out)
+        ok = float(fetched)
+        return n, buf2, y, z, ok
+''')
+
+
+def test_hotpath_checker_catches_seeded_violations():
+    raw = hotpath.analyze_file(
+        "hot.py", HOT_FIXTURE, [hotpath.HotEntry("hot")]
+    )
+    findings = finish("hot.py", HOT_FIXTURE, raw)
+    got = _codes(findings)
+    # float() on the device value (line 19)
+    assert ("VCL201", 19) in got
+    # read of buf after donation to scatter (line 21)
+    assert ("VCL202", 21) in got
+    # unhashable static at the call site (line 22)
+    assert ("VCL203", 22) in got
+    # static_argnames entry 'gone' is not a kernel parameter (def line)
+    assert ("VCL203", 13) in got
+    # float() on the device_get result is sanctioned (line 24) — the
+    # donated-and-reassigned idiom (buf2 = scatter(buf, ...)) too.
+    lines = {l for c, l in got if c == "VCL201"}
+    assert 24 not in lines
+
+
+def test_hotpath_registry_matches_tree():
+    # Every registry entry must resolve to a real function — a renamed
+    # lane must update the registry, not silently drop out of analysis.
+    for rel, entries in hotpath.HOT_REGISTRY.items():
+        src = (REPO_ROOT / rel).read_text()
+        raw = hotpath.analyze_file(rel, src, entries)
+        missing = [
+            f for f in raw
+            if f.code == "VCL001" and "not found" in f.message
+        ]
+        assert not missing, missing
+
+
+# ------------------------------------------------------- schema <-> ABI
+
+
+SNAPWIRE_FIX = textwrap.dedent('''\
+    import numpy as np
+
+    WIRE_MAGIC = 0x4E534356
+    WIRE_VERSION = 1
+    WIRE_MAX_DIMS = 8
+    _DTYPES = [
+        np.dtype(np.float32), np.dtype(np.int32),
+    ]
+''')
+
+CC_FIX_DRIFTED = textwrap.dedent('''\
+    struct VcsnapDtype { uint8_t code; const char* name; int32_t size; };
+    constexpr uint32_t kVcsnapMagic = 0x4E534357u;
+    constexpr uint32_t kVcsnapVersion = 1u;
+    constexpr int32_t kVcsnapMaxDims = 8;
+    constexpr VcsnapDtype kVcsnapDtypes[] = {
+        {0, "float32", 4}, {1, "int32", 8},
+    };
+''')
+
+SCHEMA_FIX = textwrap.dedent('''\
+    from typing import NamedTuple, Tuple
+
+    import numpy as np
+
+
+    class NodeArrays(NamedTuple):
+        idle: np.ndarray
+        ready: np.ndarray
+
+
+    WIRE_COLUMNS: Tuple = (
+        ("NodeArrays", "idle", "float32", 2),
+        ("NodeArrays", "ready", "float16", 1),
+    )
+''')
+
+HEADER_FIX = textwrap.dedent('''\
+    extern "C" {
+    void vcsnap_pack_bits(const int32_t* idx, const int64_t* off,
+                          int64_t rows, int32_t words, uint32_t* out);
+    }
+''')
+
+NATIVE_FIX = textwrap.dedent('''\
+    import ctypes
+
+    import numpy as np
+
+    _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    _u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+
+
+    def _bind(lib):
+        lib.vcsnap_pack_bits.argtypes = [
+            _i32p, _i64p, ctypes.c_int64, _u32p,
+        ]
+        return lib
+''')
+
+
+def test_schema_checker_catches_seeded_drift():
+    raw = schemacheck.analyze(
+        "sw.py", SNAPWIRE_FIX, "sc.py", SCHEMA_FIX,
+        "cc.cc", CC_FIX_DRIFTED, "h.h", HEADER_FIX,
+        "nat.py", NATIVE_FIX,
+    )
+    codes = {f.code for f in raw}
+    msgs = "\n".join(f.message for f in raw)
+    # int32 declared 8 bytes wide in C++ -> VCL301
+    assert "VCL301" in codes and "width 8" in msgs
+    # magic differs -> VCL302
+    assert "VCL302" in codes and "kVcsnapMagic" in msgs
+    # pack_bits bound with 4 argtypes against a 5-param prototype
+    assert "VCL303" in codes and "4 argtypes" in msgs
+    # float16 is not a wire dtype -> VCL304
+    assert "VCL304" in codes and "float16" in msgs
+
+
+def test_schema_checker_real_tree_is_clean():
+    paths = {
+        k: (REPO_ROOT / rel)
+        for k, rel in (
+            ("snapwire", "volcano_tpu/cache/snapwire.py"),
+            ("schema", "volcano_tpu/arrays/schema.py"),
+            ("cc", "csrc/vcsnap.cc"),
+            ("header", "csrc/vcsnap.h"),
+            ("native", "volcano_tpu/native.py"),
+        )
+    }
+    raw = schemacheck.analyze(
+        "snapwire", paths["snapwire"].read_text(),
+        "schema", paths["schema"].read_text(),
+        "cc", paths["cc"].read_text(),
+        "header", paths["header"].read_text(),
+        "native", paths["native"].read_text(),
+    )
+    assert raw == [], [f.render() for f in raw]
+
+
+def test_wire_columns_match_real_encoder_output():
+    """WIRE_COLUMNS pins dtype AND ndim of what encode_cluster actually
+    produces — the static cross-check verifies table<->NamedTuple and
+    table<->wire-dtype-set; this runtime leg closes the loop against
+    the producing authority itself."""
+    import numpy as np
+
+    from volcano_tpu.api import (
+        GROUP_NAME_ANNOTATION, ClusterInfo, JobInfo, Node, NodeInfo,
+        Pod, PodGroup, Queue, QueueInfo, TaskInfo,
+    )
+    from volcano_tpu.arrays.schema import WIRE_COLUMNS, encode_cluster
+
+    cluster = ClusterInfo()
+    node = Node(name="n0", allocatable={"cpu": "4", "memory": "8Gi"},
+                labels={"zone": "a"})
+    cluster.nodes["n0"] = NodeInfo(node)
+    cluster.queues["q1"] = QueueInfo(Queue(name="q1", weight=1))
+    pg = PodGroup(name="j", namespace="default", min_member=1,
+                  queue="q1")
+    job = JobInfo(pg.uid)
+    job.set_pod_group(pg)
+    pod = Pod(uid="p0", name="j-0", namespace="default",
+              annotations={GROUP_NAME_ANNOTATION: pg.name},
+              containers=[{"cpu": "500m"}],
+              node_selector={"zone": "a"})
+    ti = TaskInfo(pod)
+    job.add_task_info(ti)
+    cluster.jobs[pg.uid] = job
+    arrays, _maps = encode_cluster(cluster, [ti], [pg.uid])
+
+    produced = {}
+    for group in (arrays.nodes, arrays.tasks, arrays.jobs,
+                  arrays.queues):
+        gname = type(group).__name__
+        for fname, value in zip(type(group)._fields, group):
+            a = np.asarray(value)
+            produced[(gname, fname)] = (a.dtype.name, a.ndim)
+    declared = {
+        (g, f): (dt, nd) for g, f, dt, nd in WIRE_COLUMNS
+    }
+    assert set(declared) == set(produced)
+    mismatched = {
+        k: (declared[k], produced[k])
+        for k in declared if declared[k] != produced[k]
+    }
+    assert not mismatched, mismatched
+
+
+# ------------------------------------------------------------- the gate
+
+
+def test_vclint_exits_zero_on_committed_tree(tmp_path):
+    # Library-level run (what hack/run-checks.sh invokes via -m).
+    out = (tmp_path / "out.txt").open("w")
+    rc = vclint_run(REPO_ROOT, out=out)
+    out.close()
+    assert rc == 0, (tmp_path / "out.txt").read_text()
+
+
+def test_vclint_module_entry_point():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.vclint"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "vclint: 0 finding(s)" in proc.stdout

@@ -1,0 +1,1 @@
+# Repo-native developer tooling (not shipped in the volcano-tpu wheel).

@@ -1,0 +1,17 @@
+"""vclint: repo-native static analysis for the volcano-tpu tree.
+
+Three analyzer families (see docs/static_analysis.md):
+
+- lock discipline over ``# guarded-by`` / ``# holds`` annotations
+  (VCL1xx, ``tools/vclint/lockcheck.py``),
+- device hot-path hygiene over a registry of solve/commit-lane
+  functions (VCL2xx, ``tools/vclint/hotpath.py``),
+- schema <-> C++ ABI drift between the Python wire codec / ctypes
+  bindings and ``csrc/vcsnap.{h,cc}`` (VCL3xx,
+  ``tools/vclint/schemacheck.py``).
+
+Entry point: ``python -m tools.vclint`` (wired into
+``hack/run-checks.sh``, the pre-snapshot green-gate).
+"""
+
+from .findings import Finding  # noqa: F401

@@ -1,0 +1,143 @@
+"""vclint driver: run the three analyzer families over the repo.
+
+``python -m tools.vclint`` exits 0 only when the committed tree carries
+zero unsuppressed findings — it is the first leg of the pre-snapshot
+green-gate (``hack/run-checks.sh``), ahead of the csrc ASAN/TSAN smoke
+and the tier-1 pytest suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List
+
+from . import hotpath, lockcheck, schemacheck
+from .findings import Finding, finish
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+# Files under the lock-discipline analysis (the concurrency surface of
+# the pipelined scheduler: shared store state, the mirror, the in-flight
+# solve handle, the remote-solver client).
+LOCK_FILES = [
+    "volcano_tpu/cache/store.py",
+    "volcano_tpu/cache/mirror.py",
+    "volcano_tpu/cache/bindqueue.py",
+    "volcano_tpu/pipeline.py",
+    "volcano_tpu/scheduler.py",
+    "volcano_tpu/solver_service.py",
+    "volcano_tpu/fastpath.py",
+    "volcano_tpu/fastpath_evict.py",
+    "volcano_tpu/ops/devsnap.py",
+]
+
+SCHEMA_FILES = {
+    "snapwire": "volcano_tpu/cache/snapwire.py",
+    "schema": "volcano_tpu/arrays/schema.py",
+    "cc": "csrc/vcsnap.cc",
+    "header": "csrc/vcsnap.h",
+    "native": "volcano_tpu/native.py",
+}
+
+
+def _read(rel: str, root: Path) -> str:
+    return (root / rel).read_text()
+
+
+def run(root: Path = REPO_ROOT, verbose: bool = False,
+        out=sys.stdout) -> int:
+    all_findings: List[Finding] = []
+
+    # ---- lock discipline (two-pass: cross-file registries) ----------
+    sources = []
+    for rel in LOCK_FILES:
+        path = root / rel
+        if path.is_file():
+            sources.append((rel, path.read_text()))
+        else:
+            all_findings.append(Finding(
+                "VCL001", rel, 1,
+                "lock-discipline file set names a missing file",
+            ))
+    raw = lockcheck.analyze_files(sources)
+    by_file = {rel: [] for rel, _ in sources}
+    for f in raw:
+        by_file.setdefault(f.path, []).append(f)
+    for rel, src in sources:
+        all_findings.extend(finish(rel, src, by_file.get(rel, [])))
+
+    # ---- hot-path hygiene ------------------------------------------
+    for rel, entries in hotpath.HOT_REGISTRY.items():
+        path = root / rel
+        if not path.is_file():
+            all_findings.append(Finding(
+                "VCL001", rel, 1,
+                "hot registry names a missing file",
+            ))
+            continue
+        src = path.read_text()
+        all_findings.extend(finish(rel, src, hotpath.analyze_file(
+            rel, src, entries
+        )))
+
+    # ---- schema <-> ABI --------------------------------------------
+    try:
+        texts = {k: _read(rel, root) for k, rel in SCHEMA_FILES.items()}
+    except OSError as err:
+        all_findings.append(Finding(
+            "VCL001", str(err.filename or "?"), 1,
+            f"schema cross-check input unreadable: {err}",
+        ))
+    else:
+        raw3 = schemacheck.analyze(
+            SCHEMA_FILES["snapwire"], texts["snapwire"],
+            SCHEMA_FILES["schema"], texts["schema"],
+            SCHEMA_FILES["cc"], texts["cc"],
+            SCHEMA_FILES["header"], texts["header"],
+            SCHEMA_FILES["native"], texts["native"],
+        )
+        by_path = {}
+        for f in raw3:
+            by_path.setdefault(f.path, []).append(f)
+        for key, rel in SCHEMA_FILES.items():
+            all_findings.extend(finish(
+                rel, texts[key], by_path.get(rel, [])
+            ))
+
+    # ---- report -----------------------------------------------------
+    open_findings = [f for f in all_findings if not f.suppressed]
+    suppressed = [f for f in all_findings if f.suppressed]
+    for f in open_findings:
+        print(f.render(), file=out)
+    if verbose:
+        for f in suppressed:
+            print(f.render(), file=out)
+    print(
+        f"vclint: {len(open_findings)} finding(s), "
+        f"{len(suppressed)} suppressed "
+        f"({len(sources)} lock files, "
+        f"{sum(len(v) for v in hotpath.HOT_REGISTRY.values())} hot "
+        "functions, 1 schema/ABI surface)",
+        file=out,
+    )
+    return 1 if open_findings else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vclint",
+        description="repo-native static analysis: lock discipline, "
+        "device hot-path hygiene, schema<->C++ ABI drift",
+    )
+    parser.add_argument("--root", default=str(REPO_ROOT),
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="also print suppressed findings")
+    args = parser.parse_args(argv)
+    return run(Path(args.root), verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

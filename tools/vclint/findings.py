@@ -1,0 +1,150 @@
+"""Finding model + suppression handling shared by every vclint analyzer.
+
+Finding codes (see docs/static_analysis.md for the full catalog):
+
+- VCL0xx  annotation / suppression hygiene
+- VCL1xx  lock discipline (``# guarded-by`` / ``# holds`` contracts)
+- VCL2xx  device hot-path hygiene (host syncs, donation, retrace)
+- VCL3xx  schema <-> C++ ABI drift (wire codec, ctypes bindings)
+
+Suppression convention: a finding is silenced by a trailing comment on
+the SAME line it is reported at, or by a comment-only line DIRECTLY
+above it::
+
+    x = self._events          # vclint: disable=VCL101 -- cycle-thread read
+
+    # vclint: disable=VCL101 -- cycle-thread read; drain reconciles
+    x = self._events
+
+The ``-- reason`` part is mandatory; a reasonless suppression is itself
+reported (VCL002) and cannot be suppressed.  ``disable=all`` silences
+every code on the line.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# Codes that may never be suppressed (suppression hygiene itself).
+UNSUPPRESSABLE = {"VCL001", "VCL002"}
+
+CODE_TITLES = {
+    "VCL001": "malformed vclint annotation",
+    "VCL002": "suppression without a reason",
+    "VCL101": "unguarded read of a guarded attribute",
+    "VCL102": "unguarded write of a guarded attribute",
+    "VCL103": "lock-order inversion",
+    "VCL104": "guarded-by names an unknown lock",
+    "VCL105": "call to a lock-requiring method without the lock",
+    "VCL201": "implicit host sync in a device hot path",
+    "VCL202": "use of a buffer after donation",
+    "VCL203": "jit retrace hazard",
+    "VCL301": "wire dtype table drift (python vs C++)",
+    "VCL302": "frame-codec constant drift (python vs C++)",
+    "VCL303": "ctypes binding drift vs C prototype",
+    "VCL304": "schema column declaration drift",
+}
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.code} {self.message}{tag}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*vclint:\s*disable=([A-Za-z0-9,\s]+?)"
+    r"(?:--\s*(.*?))?\s*$"
+)
+
+
+@dataclass
+class Suppressions:
+    """Per-file map of line -> (codes, reason, comment_only)."""
+
+    by_line: Dict[int, Tuple[Set[str], str, bool]] = field(
+        default_factory=dict)
+    comment_lines: Set[int] = field(default_factory=set)
+    errors: List[Tuple[int, str]] = field(default_factory=list)
+
+    @classmethod
+    def scan(cls, source: str) -> "Suppressions":
+        out = cls()
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if text.lstrip().startswith("#"):
+                out.comment_lines.add(lineno)
+            if "vclint:" not in text or "disable=" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                out.errors.append(
+                    (lineno, "unparseable vclint suppression comment")
+                )
+                continue
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            reason = (m.group(2) or "").strip()
+            if not codes:
+                out.errors.append((lineno, "suppression lists no codes"))
+                continue
+            if not reason:
+                out.errors.append(
+                    (lineno,
+                     "suppression carries no '-- <reason>' justification")
+                )
+                continue
+            comment_only = text.lstrip().startswith("#")
+            out.by_line[lineno] = (codes, reason, comment_only)
+        return out
+
+    def apply(self, finding: Finding) -> Finding:
+        """Mark the finding suppressed when a matching comment covers its
+        line — same line, or a comment-only line directly above (never
+        for UNSUPPRESSABLE codes)."""
+        if finding.code in UNSUPPRESSABLE:
+            return finding
+        hit = self.by_line.get(finding.line)
+        if hit is None:
+            # Walk up through the contiguous comment block directly
+            # above the finding line; a comment-only disable anywhere in
+            # it covers the statement below.
+            lineno = finding.line - 1
+            while lineno in self.comment_lines:
+                cand = self.by_line.get(lineno)
+                if cand is not None and cand[2]:
+                    hit = cand
+                    break
+                lineno -= 1
+        if hit is None:
+            return finding
+        codes, reason, _comment_only = hit
+        if "all" in codes or finding.code in codes:
+            finding.suppressed = True
+            finding.reason = reason
+        return finding
+
+    def hygiene_findings(self, path: str) -> List[Finding]:
+        return [
+            Finding("VCL002", path, lineno, msg)
+            for lineno, msg in self.errors
+        ]
+
+
+def finish(path: str, source: str,
+           raw: List[Finding]) -> List[Finding]:
+    """Apply the file's suppressions to raw findings and append the
+    suppression-hygiene findings."""
+    sup = Suppressions.scan(source)
+    out = [sup.apply(f) for f in raw]
+    out.extend(sup.hygiene_findings(path))
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out

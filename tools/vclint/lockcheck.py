@@ -1,0 +1,488 @@
+"""Lock-discipline analyzer (VCL1xx).
+
+Annotation convention (docs/static_analysis.md):
+
+- ``# guarded-by: <lock>`` on the line of an attribute assignment
+  declares that every ``self.<attr>`` access must happen with ``<lock>``
+  held.  ``# guarded-by: <lock> (any-receiver)`` extends the check to
+  accesses through ANY receiver expression in the analyzed file set
+  (for attributes with a unique name that other modules reach into).
+- ``# holds: <lock>[, <lock2>]`` on (or directly above) a ``def`` line
+  declares the method runs with those locks already held by its caller
+  (the Clang ``REQUIRES()`` analog).  Callers inside the analyzed file
+  set are checked at every call site (VCL105).
+- ``# vclint: class-holds: <lock>`` anywhere in a class body declares
+  every method of the class runs under the lock (used for ``FastCycle``,
+  whose single entry point ``run_cycle_fast`` wraps the whole cycle in
+  ``with store._lock``).
+- A ``*_locked``-suffixed method is assumed to hold every lock guarding
+  the attributes it touches (the caller-is-responsible convention).
+
+A lock is "held" inside ``with <expr>.<lockname>:`` for any receiver
+expression — ``with self._lock:``, ``with store._lock:`` and
+``with self._store._lock:`` all count for ``_lockname``.
+
+Lock-order inversions (VCL103) are detected over the KNOWN_LOCKS set:
+nested ``with`` acquisitions (including one level of intra-class call
+propagation: a method that acquires B, called while A is held, records
+the edge A->B) must not produce both A->B and B->A.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+# The framework's cross-object locks (ISSUE 2): guarded-by may name one
+# of these even when the annotated class does not create it (the mirror's
+# state is guarded by its owning store's _lock).
+KNOWN_LOCKS = {"_lock", "_events_lock", "_bind_fail_lock",
+               "_record_walk_lock"}
+
+_GUARDED_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_]\w*)\s*(\(any-receiver\))?"
+)
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
+_CLASS_HOLDS_RE = re.compile(r"#\s*vclint:\s*class-holds:\s*([A-Za-z_]\w*)")
+
+EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__repr__"}
+
+
+@dataclass
+class GuardedAttr:
+    lock: str
+    any_receiver: bool
+    line: int
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    guarded: Dict[str, GuardedAttr] = field(default_factory=dict)
+    class_holds: Set[str] = field(default_factory=set)
+    created_locks: Set[str] = field(default_factory=set)
+    # method name -> declared holds set
+    holds: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+@dataclass
+class FileModel:
+    path: str
+    tree: ast.Module
+    lines: List[str]
+    classes: List[ClassInfo] = field(default_factory=list)
+    # module-level function name -> holds set
+    fn_holds: Dict[str, Set[str]] = field(default_factory=dict)
+    annotation_errors: List[Tuple[int, str]] = field(default_factory=list)
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _holds_for_def(lines: List[str], node) -> Set[str]:
+    """Parse ``# holds:`` from the def line, its decorators, or the line
+    directly above."""
+    out: Set[str] = set()
+    candidates = [node.lineno]
+    for dec in getattr(node, "decorator_list", []):
+        candidates.append(dec.lineno)
+    first = min(candidates)
+    candidates.append(first - 1)
+    for lineno in candidates:
+        if 1 <= lineno <= len(lines):
+            m = _HOLDS_RE.search(lines[lineno - 1])
+            if m:
+                out.update(
+                    s.strip() for s in m.group(1).split(",") if s.strip()
+                )
+    return out
+
+
+def _is_lock_factory(value: ast.AST) -> bool:
+    """True for ``threading.Lock()`` / ``RLock()`` / ``Condition()``."""
+    if not isinstance(value, ast.Call):
+        return False
+    name = _attr_chain(value.func) or ""
+    return name.split(".")[-1] in ("Lock", "RLock", "Condition")
+
+
+def build_model(path: str, source: str) -> FileModel:
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    model = FileModel(path=path, tree=tree, lines=lines)
+
+    # guarded-by comment lines (line -> (lock, any_receiver)); each must
+    # attach to an attribute assignment on that line.
+    ann_lines: Dict[int, Tuple[str, bool]] = {}
+    for lineno, text in enumerate(lines, start=1):
+        m = _GUARDED_RE.search(text)
+        if m:
+            ann_lines[lineno] = (m.group(1), bool(m.group(2)))
+
+    consumed: Set[int] = set()
+
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            h = _holds_for_def(lines, node)
+            if h:
+                model.fn_holds[node.name] = h
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = ClassInfo(name=node.name, node=node)
+        # class-holds markers inside the class source range.
+        end = getattr(node, "end_lineno", node.lineno)
+        for lineno in range(node.lineno, end + 1):
+            m = _CLASS_HOLDS_RE.search(lines[lineno - 1])
+            if m:
+                info.class_holds.add(m.group(1))
+        # Attribute annotations + created locks: scan every statement of
+        # the class body and its methods.
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                value = sub.value
+                for tgt in targets:
+                    attr = None
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        attr = tgt.attr
+                    elif isinstance(tgt, ast.Name):
+                        attr = tgt.id
+                    if attr is None:
+                        continue
+                    if value is not None and _is_lock_factory(value):
+                        info.created_locks.add(attr)
+                    # Annotation on the assignment line, or on a
+                    # comment-only line directly above it.
+                    ann_line = sub.lineno
+                    ann = ann_lines.get(ann_line)
+                    if ann is None and sub.lineno >= 2 \
+                            and lines[sub.lineno - 2].lstrip() \
+                            .startswith("#"):
+                        ann_line = sub.lineno - 1
+                        ann = ann_lines.get(ann_line)
+                    if ann is not None:
+                        lock, any_recv = ann
+                        info.guarded[attr] = GuardedAttr(
+                            lock, any_recv, sub.lineno
+                        )
+                        consumed.add(ann_line)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                h = _holds_for_def(lines, sub)
+                if h:
+                    info.holds[sub.name] = h
+        model.classes.append(info)
+
+    for lineno, (lock, _any) in ann_lines.items():
+        if lineno not in consumed:
+            model.annotation_errors.append(
+                (lineno,
+                 f"guarded-by: {lock} does not attach to an attribute "
+                 "assignment on this line")
+            )
+    return model
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one function body tracking the held-lock set."""
+
+    def __init__(self, model: FileModel, cls: Optional[ClassInfo],
+                 base_held: Set[str], guarded: Dict[str, GuardedAttr],
+                 any_recv_guarded: Dict[str, GuardedAttr],
+                 holds_registry: Dict[str, Set[str]],
+                 acquires_of: Dict[str, Set[str]],
+                 findings: List[Finding],
+                 edges: List[Tuple[str, str, int]]):
+        self.model = model
+        self.cls = cls
+        self.held = set(base_held)
+        self.guarded = guarded
+        self.any_recv_guarded = any_recv_guarded
+        self.holds_registry = holds_registry
+        self.acquires_of = acquires_of
+        self.findings = findings
+        self.edges = edges
+
+    # ------------------------------------------------------------ helpers
+
+    def _lock_of_with_item(self, item: ast.withitem) -> Optional[str]:
+        name = _attr_chain(item.context_expr)
+        if name is None:
+            return None
+        leaf = name.split(".")[-1]
+        if leaf in KNOWN_LOCKS or leaf.endswith("lock") \
+                or leaf.endswith("_cv") or leaf.endswith("cond"):
+            return leaf
+        return None
+
+    # ------------------------------------------------------------ visits
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            lock = self._lock_of_with_item(item)
+            if lock is not None:
+                for prior in self.held:
+                    if prior != lock:
+                        self.edges.append((prior, lock, node.lineno))
+                # Re-entrant acquisition of an already-held lock (RLock
+                # under class-holds/holds) must not drop it from the
+                # held set at block exit.
+                if lock not in self.held:
+                    acquired.append(lock)
+        self.held.update(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in acquired:
+            self.held.discard(lock)
+        # context expressions themselves (rare attribute reads)
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    def visit_FunctionDef(self, node) -> None:
+        # Nested defs (closures) inherit the lexical held set only if
+        # called inline; be conservative and skip their bodies (the
+        # enclosing hot registries never nest guarded access in
+        # closures).
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _guard_of(self, node: ast.Attribute):
+        attr = node.attr
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and attr in self.guarded):
+            return self.guarded[attr]
+        if attr in self.any_recv_guarded:
+            return self.any_recv_guarded[attr]
+        return None
+
+    def _flag_access(self, node: ast.Attribute, write: bool) -> None:
+        guard = self._guard_of(node)
+        if guard is not None and guard.lock not in self.held:
+            code = "VCL102" if write else "VCL101"
+            verb = "write to" if write else "read of"
+            self.findings.append(Finding(
+                code, self.model.path, node.lineno,
+                f"{verb} '{node.attr}' (guarded-by {guard.lock}) "
+                f"without holding {guard.lock}",
+            ))
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # ``self.items[k] = v`` loads the attribute AST-wise but mutates
+        # the guarded container: report it as a write.
+        if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and self._guard_of(node.value) is not None:
+            self._flag_access(node.value, write=True)
+            self.visit(node.slice)
+            return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self._flag_access(
+            node, write=isinstance(node.ctx, (ast.Store, ast.Del))
+        )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        callee = None
+        if isinstance(node.func, ast.Attribute):
+            callee = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            callee = node.func.id
+        if callee is not None:
+            required = self.holds_registry.get(callee)
+            if required is not None:
+                missing = required - self.held
+                if missing:
+                    self.findings.append(Finding(
+                        "VCL105", self.model.path, node.lineno,
+                        f"call to {callee}() requires "
+                        f"{', '.join(sorted(required))} but "
+                        f"{', '.join(sorted(missing))} is not held",
+                    ))
+            # one-level intra-class acquisition propagation for ordering
+            # (``self.X()`` receivers only: an attr-name match through an
+            # arbitrary receiver — ``self._sock.close()`` vs our own
+            # ``close`` — is a different object's method)
+            is_self_call = (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            )
+            acq = self.acquires_of.get(callee) if is_self_call else None
+            if acq:
+                for prior in self.held:
+                    for lock in acq:
+                        if prior != lock:
+                            self.edges.append(
+                                (prior, lock, node.lineno)
+                            )
+        self.generic_visit(node)
+
+
+def _method_acquires(cls: ClassInfo) -> Dict[str, Set[str]]:
+    """Locks each method of the class acquires lexically, propagated one
+    fixpoint through intra-class self.X() calls."""
+    direct: Dict[str, Set[str]] = {}
+    calls: Dict[str, Set[str]] = {}
+    for sub in cls.node.body:
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        acq: Set[str] = set()
+        callees: Set[str] = set()
+        for n in ast.walk(sub):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    name = _attr_chain(item.context_expr)
+                    if name is None:
+                        continue
+                    leaf = name.split(".")[-1]
+                    if leaf in KNOWN_LOCKS or leaf.endswith("lock") \
+                            or leaf.endswith("_cv") \
+                            or leaf.endswith("cond"):
+                        acq.add(leaf)
+            elif isinstance(n, ast.Call):
+                if (isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "self"):
+                    callees.add(n.func.attr)
+        direct[sub.name] = acq
+        calls[sub.name] = callees
+    # fixpoint (class method graphs are tiny)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            for c in callees:
+                extra = direct.get(c, set()) - direct[name]
+                if extra:
+                    direct[name] |= extra
+                    changed = True
+    return direct
+
+
+def analyze_files(paths_sources: List[Tuple[str, str]]) -> List[Finding]:
+    """Run the lock-discipline analysis over the file set.  Returns RAW
+    findings (suppressions are applied by the caller per file)."""
+    findings: List[Finding] = []
+    models: List[FileModel] = []
+    for path, source in paths_sources:
+        try:
+            models.append(build_model(path, source))
+        except SyntaxError as err:
+            findings.append(Finding(
+                "VCL001", path, err.lineno or 1,
+                f"file does not parse: {err.msg}",
+            ))
+    # Cross-file registries -------------------------------------------
+    # any-receiver guarded attributes (unique names only).
+    any_recv: Dict[str, GuardedAttr] = {}
+    seen_attr: Dict[str, int] = {}
+    holds_registry: Dict[str, Set[str]] = {}
+    holds_conflict: Set[str] = set()
+    for model in models:
+        for lineno, msg in model.annotation_errors:
+            findings.append(Finding("VCL001", model.path, lineno, msg))
+        for cls in model.classes:
+            for attr, guard in cls.guarded.items():
+                if guard.lock not in cls.created_locks \
+                        and guard.lock not in KNOWN_LOCKS:
+                    findings.append(Finding(
+                        "VCL104", model.path, guard.line,
+                        f"'{attr}' is guarded-by '{guard.lock}' but no "
+                        "such lock is created in the class or listed in "
+                        "KNOWN_LOCKS",
+                    ))
+                if guard.any_receiver:
+                    seen_attr[attr] = seen_attr.get(attr, 0) + 1
+                    any_recv[attr] = guard
+            for name, req in cls.holds.items():
+                if name in holds_registry and holds_registry[name] != req:
+                    holds_conflict.add(name)
+                holds_registry[name] = set(req)
+        for name, req in model.fn_holds.items():
+            if name in holds_registry and holds_registry[name] != req:
+                holds_conflict.add(name)
+            holds_registry[name] = set(req)
+    for name in holds_conflict:
+        holds_registry.pop(name, None)
+    for attr, count in seen_attr.items():
+        if count > 1:
+            any_recv.pop(attr, None)
+
+    edge_paths: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    for model in models:
+        edges: List[Tuple[str, str, int]] = []
+        for cls in model.classes:
+            acquires_of = _method_acquires(cls)
+            for sub in cls.node.body:
+                if not isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if sub.name in EXEMPT_METHODS:
+                    continue
+                base = set(cls.class_holds)
+                base |= cls.holds.get(sub.name, set())
+                if sub.name.endswith("_locked"):
+                    # Caller-is-responsible convention: assumed to hold
+                    # the locks guarding this class's own state.
+                    base |= {g.lock for g in cls.guarded.values()}
+                    base |= cls.created_locks
+                checker = _MethodChecker(
+                    model, cls, base, cls.guarded, any_recv,
+                    holds_registry, acquires_of, findings, edges,
+                )
+                for stmt in sub.body:
+                    checker.visit(stmt)
+        # module-level functions
+        for sub in model.tree.body:
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            base = set(model.fn_holds.get(sub.name, set()))
+            checker = _MethodChecker(
+                model, None, base, {}, any_recv, holds_registry, {},
+                findings, edges,
+            )
+            for stmt in sub.body:
+                checker.visit(stmt)
+        for a, b, lineno in edges:
+            edge_paths.setdefault((a, b), (model.path, lineno))
+
+    # Lock-order inversions over KNOWN_LOCKS -------------------------
+    known_edges = {
+        (a, b) for (a, b) in edge_paths
+        if a in KNOWN_LOCKS and b in KNOWN_LOCKS
+    }
+    reported: Set[Tuple[str, str]] = set()
+    for a, b in sorted(known_edges):
+        if (b, a) in known_edges and (b, a) not in reported:
+            reported.add((a, b))
+            pa, la = edge_paths[(a, b)]
+            pb, lb = edge_paths[(b, a)]
+            findings.append(Finding(
+                "VCL103", pa, la,
+                f"lock-order inversion: {a} -> {b} here but "
+                f"{b} -> {a} at {pb}:{lb}",
+            ))
+    return findings

@@ -1,0 +1,491 @@
+"""Schema <-> C++ ABI cross-checker (VCL3xx).
+
+Four independent comparisons, all static (nothing is imported or run):
+
+- **VCL301 wire dtype table**: ``cache/snapwire.py _DTYPES`` (code =
+  list index) vs ``csrc/vcsnap.cc kVcsnapDtypes`` (code/name/width).
+  Count, order, names and element widths must agree — the u8 dtype code
+  is wire format between the scheduler and the solver process.
+- **VCL302 frame constants**: ``WIRE_MAGIC`` / ``WIRE_VERSION`` /
+  ``WIRE_MAX_DIMS`` in snapwire.py vs ``kVcsnapMagic`` /
+  ``kVcsnapVersion`` / ``kVcsnapMaxDims`` in vcsnap.cc.
+- **VCL303 ctypes bindings**: every ``lib.<fn>.argtypes`` declaration in
+  ``volcano_tpu/native.py _bind`` vs the C prototype in
+  ``csrc/vcsnap.h``.  Arity must match exactly and each position must be
+  type-compatible (``c_void_p`` matches any pointer — the reclaim
+  engine's raw-address hot path).  This is the actual Python<->C++ call
+  ABI; a drifted 47-argument ``vcreclaim_ctx_new`` binding corrupts
+  memory silently.
+- **VCL304 schema column table**: ``arrays/schema.py WIRE_COLUMNS`` vs
+  the NodeArrays/TaskArrays/JobArrays/QueueArrays NamedTuple field lists
+  (1:1, same order), with every declared dtype present in the wire dtype
+  table.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding
+
+# numpy dtype name -> element width (the static mirror of np.dtype(x).
+# itemsize for the wire-transportable set).
+NP_WIDTH = {
+    "float32": 4, "float64": 8, "int8": 1, "int16": 2, "int32": 4,
+    "int64": 8, "uint8": 1, "uint16": 2, "uint32": 4, "uint64": 8,
+    "bool": 1, "bool_": 1,
+}
+
+
+# ------------------------------------------------------------ python side
+
+
+def parse_snapwire(source: str) -> Tuple[
+        List[str], Dict[str, int], Optional[int]]:
+    """(_DTYPES names in order, WIRE_* constants, _DTYPES line)."""
+    tree = ast.parse(source)
+    names: List[str] = []
+    consts: Dict[str, int] = {}
+    line: Optional[int] = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tname = node.targets[0].id
+            if tname == "_DTYPES" and isinstance(node.value, ast.List):
+                line = node.lineno
+                for el in node.value.elts:
+                    # np.dtype(np.float32) -> "float32"
+                    if isinstance(el, ast.Call) and el.args:
+                        inner = el.args[0]
+                        leaf = None
+                        if isinstance(inner, ast.Attribute):
+                            leaf = inner.attr
+                        elif isinstance(inner, ast.Name):
+                            leaf = inner.id
+                        if leaf is not None:
+                            names.append(leaf.rstrip("_"))
+            elif tname.startswith("WIRE_") and isinstance(
+                    node.value, ast.Constant):
+                consts[tname] = int(node.value.value)
+    return names, consts, line
+
+
+def parse_wire_columns(source: str) -> Tuple[
+        List[Tuple[str, str, str, int]], Dict[str, List[str]],
+        Optional[int]]:
+    """(WIRE_COLUMNS rows, NamedTuple class -> ordered ndarray fields,
+    WIRE_COLUMNS line)."""
+    tree = ast.parse(source)
+    rows: List[Tuple[str, str, str, int]] = []
+    line: Optional[int] = None
+    classes: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+            if "NamedTuple" not in bases:
+                continue
+            fields = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) \
+                        and isinstance(stmt.target, ast.Name):
+                    ann = stmt.annotation
+                    leaf = ann.attr if isinstance(ann, ast.Attribute) \
+                        else (ann.id if isinstance(ann, ast.Name) else "")
+                    if leaf == "ndarray":
+                        fields.append(stmt.target.id)
+            classes[node.name] = fields
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not any(isinstance(t, ast.Name)
+                       and t.id == "WIRE_COLUMNS" for t in targets):
+                continue
+            line = node.lineno
+            value = node.value
+            if isinstance(value, (ast.Tuple, ast.List)):
+                for el in value.elts:
+                    if isinstance(el, ast.Tuple) and len(el.elts) == 4:
+                        vals = [
+                            e.value for e in el.elts
+                            if isinstance(e, ast.Constant)
+                        ]
+                        if len(vals) == 4:
+                            rows.append(tuple(vals))  # type: ignore
+    return rows, classes, line
+
+
+# --------------------------------------------------------------- C++ side
+
+
+_CC_DTYPE_ROW = re.compile(
+    r"\{\s*(\d+)\s*,\s*\"(\w+)\"\s*,\s*(\d+)\s*\}"
+)
+_CC_CONST = re.compile(
+    r"constexpr\s+\w+(?:\d+_t)?\s+(kVcsnap\w+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)u?\s*;"
+)
+
+
+def parse_vcsnap_cc(source: str) -> Tuple[
+        List[Tuple[int, str, int]], Dict[str, int], Optional[int]]:
+    """(kVcsnapDtypes rows, kVcsnap* integer constants, table line)."""
+    consts: Dict[str, int] = {}
+    for m in _CC_CONST.finditer(source):
+        consts[m.group(1)] = int(m.group(2), 0)
+    rows: List[Tuple[int, str, int]] = []
+    line: Optional[int] = None
+    m = re.search(r"kVcsnapDtypes\[\]\s*=\s*\{(.*?)\};", source, re.S)
+    if m:
+        line = source[:m.start()].count("\n") + 1
+        for rm in _CC_DTYPE_ROW.finditer(m.group(1)):
+            rows.append((int(rm.group(1)), rm.group(2), int(rm.group(3))))
+    return rows, consts, line
+
+
+_PROTO_RE = re.compile(
+    r"^\s*([A-Za-z_][\w\s\*]*?)\s+(vcsnap_\w+|vcreclaim_\w+)\s*\(([^;]*?)\)\s*;",
+    re.M | re.S,
+)
+
+
+def parse_header_protos(source: str) -> Dict[str, Tuple[str, List[str], int]]:
+    """name -> (return type, [normalized param types], line)."""
+    out: Dict[str, Tuple[str, List[str], int]] = {}
+    for m in _PROTO_RE.finditer(source):
+        ret = " ".join(m.group(1).split())
+        name = m.group(2)
+        argsrc = m.group(3).strip()
+        line = source[:m.start()].count("\n") + 2
+        params: List[str] = []
+        if argsrc and argsrc != "void":
+            for part in argsrc.split(","):
+                part = " ".join(part.split())
+                # strip the parameter name (last identifier not part of
+                # the type when the decl has one beyond the type tokens)
+                part = re.sub(r"\b[A-Za-z_]\w*$", "", part).strip()
+                params.append(_norm_ctype(part))
+        out[name] = (_norm_ctype(ret), params, line)
+    return out
+
+
+def _norm_ctype(t: str) -> str:
+    t = t.replace("const", " ").replace("unsigned long long",
+                                        "uint64").strip()
+    t = " ".join(t.split())
+    t = t.replace("long long", "int64")
+    ptr = t.count("*")
+    base = t.replace("*", "").strip()
+    base = {
+        "int": "int32", "float": "float32", "double": "float64",
+        "char": "int8", "void": "void", "uint8_t": "uint8",
+        "uint16_t": "uint16", "uint32_t": "uint32", "uint64_t": "uint64",
+        "int8_t": "int8", "int16_t": "int16", "int32_t": "int32",
+        "int64_t": "int64", "uint64": "uint64", "int64": "int64",
+    }.get(base, base)
+    return base + "*" * ptr
+
+
+# ctypes expression -> normalized type, for the _bind argtypes lists.
+_NDPTR_DTYPE = {
+    "_i32p": "int32*", "_i64p": "int64*", "_u32p": "uint32*",
+    "_u8p": "uint8*", "_f32p": "float32*", "_f64p": "float64*",
+    "_i16p": "int16*", "_i8p": "int8*",
+}
+_CTYPES_SCALAR = {
+    "c_int": "int32", "c_int32": "int32", "c_int64": "int64",
+    "c_longlong": "int64", "c_uint64": "uint64", "c_double": "float64",
+    "c_float": "float32", "c_void_p": "void*", "c_uint8": "uint8",
+    "c_char_p": "int8*",
+}
+
+
+def _eval_ctype_expr(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Normalized type of one ctypes argtype expression."""
+    if isinstance(node, ast.Name):
+        if node.id in _NDPTR_DTYPE:
+            return _NDPTR_DTYPE[node.id]
+        if node.id in aliases:
+            return aliases[node.id]
+        return None
+    if isinstance(node, ast.Attribute):
+        return _CTYPES_SCALAR.get(node.attr)
+    if isinstance(node, ast.Call):
+        # ctypes.POINTER(inner)
+        leaf = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name) else "")
+        if leaf == "POINTER" and node.args:
+            inner = _eval_ctype_expr(node.args[0], aliases)
+            return None if inner is None else inner + "*"
+        if leaf == "ndpointer" and node.args:
+            a = node.args[0]
+            dn = a.attr if isinstance(a, ast.Attribute) \
+                else (a.id if isinstance(a, ast.Name) else "")
+            return (dn.rstrip("_") + "*") if dn in NP_WIDTH or \
+                dn.rstrip("_") in NP_WIDTH else None
+        return None
+    return None
+
+
+def _eval_argtypes_list(node: ast.AST,
+                        aliases: Dict[str, str]) -> Optional[List[str]]:
+    """Evaluate an argtypes expression: list/tuple literals plus the
+    ``[vp] * 20 + [vp, ll]`` list-arithmetic idiom."""
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out: List[str] = []
+        for el in node.elts:
+            t = _eval_ctype_expr(el, aliases)
+            if t is None:
+                return None
+            out.append(t)
+        return out
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Add):
+            l = _eval_argtypes_list(node.left, aliases)
+            r = _eval_argtypes_list(node.right, aliases)
+            if l is None or r is None:
+                return None
+            return l + r
+        if isinstance(node.op, ast.Mult):
+            l = _eval_argtypes_list(node.left, aliases)
+            if l is not None and isinstance(node.right, ast.Constant):
+                return l * int(node.right.value)
+            if isinstance(node.left, ast.Constant):
+                r = _eval_argtypes_list(node.right, aliases)
+                if r is not None:
+                    return r * int(node.left.value)
+    return None
+
+
+def parse_native_bindings(source: str) -> Tuple[
+        Dict[str, Tuple[Optional[str], Optional[List[str]], int]],
+        List[Tuple[int, str]]]:
+    """From _bind(): fn name -> (restype, argtypes, line); plus parse
+    errors."""
+    tree = ast.parse(source)
+    out: Dict[str, Tuple[Optional[str], Optional[List[str]], int]] = {}
+    errors: List[Tuple[int, str]] = []
+    bind = None
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "_bind":
+            bind = node
+            break
+    if bind is None:
+        return out, [(1, "native.py has no _bind function")]
+    aliases: Dict[str, str] = {}
+    for stmt in bind.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Name):
+                t = _eval_ctype_expr(stmt.value, aliases)
+                if t is not None:
+                    aliases[tgt.id] = t
+                continue
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and isinstance(tgt.value.value, ast.Name)
+                    and tgt.value.value.id == "lib"):
+                continue
+            fn = tgt.value.attr
+            slot = tgt.attr
+            restype, argtypes, line = out.get(fn, (None, None, stmt.lineno))
+            if slot == "restype":
+                restype = _eval_ctype_expr(stmt.value, aliases)
+                if restype is None:
+                    errors.append(
+                        (stmt.lineno,
+                         f"unrecognized restype expression for {fn}")
+                    )
+            elif slot == "argtypes":
+                argtypes = _eval_argtypes_list(stmt.value, aliases)
+                if argtypes is None:
+                    errors.append(
+                        (stmt.lineno,
+                         f"unrecognized argtypes expression for {fn}")
+                    )
+            out[fn] = (restype, argtypes, stmt.lineno)
+    return out, errors
+
+
+def _compatible(py: str, c: str) -> bool:
+    if py == c:
+        return True
+    # raw-address hot path: void* carries any pointer
+    if py == "void*" and c.endswith("*"):
+        return True
+    if c == "void*" and py.endswith("*"):
+        return True
+    # uint8* carries opaque byte buffers on both sides
+    pair = {py, c}
+    if pair == {"uint8*", "int8*"}:
+        return True
+    return False
+
+
+# ----------------------------------------------------------------- driver
+
+
+def analyze(snapwire_path: str, snapwire_src: str,
+            schema_path: str, schema_src: str,
+            cc_path: str, cc_src: str,
+            header_path: str, header_src: str,
+            native_path: str, native_src: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # ---- VCL301: dtype table --------------------------------------
+    py_dtypes, py_consts, py_line = parse_snapwire(snapwire_src)
+    cc_rows, cc_consts, cc_line = parse_vcsnap_cc(cc_src)
+    if not py_dtypes:
+        findings.append(Finding(
+            "VCL301", snapwire_path, 1,
+            "could not parse _DTYPES (wire dtype table missing?)",
+        ))
+    if not cc_rows:
+        findings.append(Finding(
+            "VCL301", cc_path, 1,
+            "could not parse kVcsnapDtypes (wire dtype table missing?)",
+        ))
+    if py_dtypes and cc_rows:
+        if len(py_dtypes) != len(cc_rows):
+            findings.append(Finding(
+                "VCL301", cc_path, cc_line or 1,
+                f"dtype table length drift: python {len(py_dtypes)} "
+                f"codes vs C++ {len(cc_rows)}",
+            ))
+        for i, (code, name, width) in enumerate(cc_rows):
+            if code != i:
+                findings.append(Finding(
+                    "VCL301", cc_path, cc_line or 1,
+                    f"kVcsnapDtypes row {i} declares code {code}: codes "
+                    "must be dense list indexes",
+                ))
+                continue
+            if i >= len(py_dtypes):
+                continue
+            pyname = py_dtypes[i]
+            if pyname != name:
+                findings.append(Finding(
+                    "VCL301", cc_path, cc_line or 1,
+                    f"dtype code {i} is {pyname!r} in python but "
+                    f"{name!r} in C++",
+                ))
+            expect = NP_WIDTH.get(pyname)
+            if expect is not None and expect != width:
+                findings.append(Finding(
+                    "VCL301", cc_path, cc_line or 1,
+                    f"dtype code {i} ({name}) has width {width} in C++ "
+                    f"but numpy itemsize is {expect}",
+                ))
+
+    # ---- VCL302: frame constants ----------------------------------
+    pairs = [
+        ("WIRE_MAGIC", "kVcsnapMagic"),
+        ("WIRE_VERSION", "kVcsnapVersion"),
+        ("WIRE_MAX_DIMS", "kVcsnapMaxDims"),
+    ]
+    for py_name, cc_name in pairs:
+        pv = py_consts.get(py_name)
+        cv = cc_consts.get(cc_name)
+        if pv is None:
+            findings.append(Finding(
+                "VCL302", snapwire_path, 1,
+                f"{py_name} is not declared in the wire codec",
+            ))
+        if cv is None:
+            findings.append(Finding(
+                "VCL302", cc_path, 1,
+                f"{cc_name} is not declared in the frame codec",
+            ))
+        if pv is not None and cv is not None and pv != cv:
+            findings.append(Finding(
+                "VCL302", cc_path, cc_line or 1,
+                f"{py_name}=0x{pv:X} (python) != {cc_name}=0x{cv:X} "
+                "(C++)",
+            ))
+
+    # ---- VCL303: ctypes bindings vs header prototypes --------------
+    protos = parse_header_protos(header_src)
+    bindings, bind_errors = parse_native_bindings(native_src)
+    for lineno, msg in bind_errors:
+        findings.append(Finding("VCL303", native_path, lineno, msg))
+    for fn, (restype, argtypes, line) in sorted(bindings.items()):
+        proto = protos.get(fn)
+        if proto is None:
+            findings.append(Finding(
+                "VCL303", native_path, line,
+                f"{fn} is bound in native.py but has no prototype in "
+                "vcsnap.h",
+            ))
+            continue
+        c_ret, c_params, _hline = proto
+        if argtypes is not None:
+            if len(argtypes) != len(c_params):
+                findings.append(Finding(
+                    "VCL303", native_path, line,
+                    f"{fn} binds {len(argtypes)} argtypes but the C "
+                    f"prototype takes {len(c_params)} parameters",
+                ))
+            else:
+                for i, (py_t, c_t) in enumerate(zip(argtypes, c_params)):
+                    if not _compatible(py_t, c_t):
+                        findings.append(Finding(
+                            "VCL303", native_path, line,
+                            f"{fn} argument {i}: ctypes {py_t} vs C "
+                            f"{c_t}",
+                        ))
+        if restype is not None and c_ret != "void" \
+                and not _compatible(restype, c_ret):
+            findings.append(Finding(
+                "VCL303", native_path, line,
+                f"{fn} restype {restype} vs C return type {c_ret}",
+            ))
+
+    # ---- VCL304: schema column table -------------------------------
+    rows, classes, wc_line = parse_wire_columns(schema_src)
+    if not rows:
+        findings.append(Finding(
+            "VCL304", schema_path, 1,
+            "WIRE_COLUMNS is missing or empty",
+        ))
+    else:
+        declared: Dict[str, List[Tuple[str, str, int]]] = {}
+        max_dims = py_consts.get("WIRE_MAX_DIMS", 8)
+        for group, fieldname, dtype, ndim in rows:
+            declared.setdefault(group, []).append(
+                (fieldname, dtype, ndim)
+            )
+            if not isinstance(ndim, int) or not 1 <= ndim <= max_dims:
+                findings.append(Finding(
+                    "VCL304", schema_path, wc_line or 1,
+                    f"{group}.{fieldname} declares ndim {ndim!r} "
+                    f"outside the wire range 1..{max_dims}",
+                ))
+            if dtype not in NP_WIDTH:
+                findings.append(Finding(
+                    "VCL304", schema_path, wc_line or 1,
+                    f"{group}.{fieldname} declares non-wire dtype "
+                    f"{dtype!r}",
+                ))
+            if py_dtypes and dtype not in py_dtypes:
+                findings.append(Finding(
+                    "VCL304", schema_path, wc_line or 1,
+                    f"{group}.{fieldname} dtype {dtype!r} is not in the "
+                    "wire dtype table (snapwire._DTYPES)",
+                ))
+        for group, fields in classes.items():
+            if group == "ClusterArrays" or not fields:
+                continue
+            got = [f for f, _d, _n in declared.get(group, [])]
+            if got != fields:
+                findings.append(Finding(
+                    "VCL304", schema_path, wc_line or 1,
+                    f"WIRE_COLUMNS for {group} lists {got} but the "
+                    f"NamedTuple declares {fields} (order-sensitive)",
+                ))
+        for group in declared:
+            if group not in classes:
+                findings.append(Finding(
+                    "VCL304", schema_path, wc_line or 1,
+                    f"WIRE_COLUMNS names unknown group {group!r}",
+                ))
+    return findings

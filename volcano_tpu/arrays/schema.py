@@ -194,6 +194,55 @@ class ClusterArrays(NamedTuple):
     scalar_slot: np.ndarray  # [R] bool mask of extended-resource slots
 
 
+# Declarative wire schema of the ClusterArrays leaves: (group, field,
+# dtype, ndim) in declaration order.  tools/vclint's schema
+# cross-checker (VCL304) verifies this table 1:1 against the NamedTuple
+# classes above — same fields, same order — and that every dtype is
+# wire-transportable (cache/snapwire._DTYPES <-> csrc/vcsnap.cc
+# kVcsnapDtypes), so the frame codec can never silently drift from the
+# mirror's column layout.  encode_cluster() is the producing authority;
+# change it and this table together.
+WIRE_COLUMNS: Tuple[Tuple[str, str, str, int], ...] = (
+    ("NodeArrays", "allocatable", "float32", 2),
+    ("NodeArrays", "idle", "float32", 2),
+    ("NodeArrays", "used", "float32", 2),
+    ("NodeArrays", "releasing", "float32", 2),
+    ("NodeArrays", "pipelined", "float32", 2),
+    ("NodeArrays", "ready", "bool", 1),
+    ("NodeArrays", "real", "bool", 1),
+    ("NodeArrays", "max_tasks", "int32", 1),
+    ("NodeArrays", "num_tasks", "int32", 1),
+    ("NodeArrays", "label_bits", "uint32", 2),
+    ("NodeArrays", "taint_bits", "uint32", 2),
+    ("NodeArrays", "port_bits", "uint32", 2),
+    ("TaskArrays", "req", "float32", 2),
+    ("TaskArrays", "init_req", "float32", 2),
+    ("TaskArrays", "job", "int32", 1),
+    ("TaskArrays", "priority", "int32", 1),
+    ("TaskArrays", "real", "bool", 1),
+    ("TaskArrays", "sel_bits", "uint32", 2),
+    ("TaskArrays", "has_selector", "bool", 1),
+    ("TaskArrays", "aff_bits", "uint32", 3),
+    ("TaskArrays", "aff_terms", "int32", 1),
+    ("TaskArrays", "tol_bits", "uint32", 2),
+    ("TaskArrays", "port_bits", "uint32", 2),
+    ("TaskArrays", "pref_bits", "uint32", 3),
+    ("TaskArrays", "pref_w", "float32", 2),
+    ("JobArrays", "min_available", "int32", 1),
+    ("JobArrays", "queue", "int32", 1),
+    ("JobArrays", "priority", "int32", 1),
+    ("JobArrays", "ready_base", "int32", 1),
+    ("JobArrays", "real", "bool", 1),
+    ("QueueArrays", "weight", "float32", 1),
+    ("QueueArrays", "capability", "float32", 2),
+    ("QueueArrays", "has_capability", "bool", 1),
+    ("QueueArrays", "reclaimable", "bool", 1),
+    ("QueueArrays", "deserved", "float32", 2),
+    ("QueueArrays", "allocated", "float32", 2),
+    ("QueueArrays", "real", "bool", 1),
+)
+
+
 @dataclass
 class IndexMaps:
     """Host-side string<->index maps for one encoded snapshot."""

@@ -44,10 +44,11 @@ class BindDispatcher:
         self._on_failure = on_failure
         self._on_success = on_success
         self._materialize = materialize
-        self._q: List[Tuple[Sequence[str], Sequence[str], Sequence[object]]] = []
         self._cv = threading.Condition()
-        self._stopped = False
-        self._inflight = 0
+        # guarded-by: _cv
+        self._q: List[Tuple[Sequence[str], Sequence[str], Sequence[object]]] = []
+        self._stopped = False  # guarded-by: _cv
+        self._inflight = 0  # guarded-by: _cv
         self._thread = threading.Thread(
             target=self._run, name="vc-bind-dispatch", daemon=True
         )

@@ -328,26 +328,27 @@ class StoreMirror:
         # Pods bound to nodes the mirror has not seen yet: name -> uids.
         self._orphans: Dict[str, List[str]] = {}
         # Epoch bumps force full fallback-path consumers to resync if needed.
-        self.epoch = 0
+        self.epoch = 0  # guarded-by: _lock
         # Monotone pod/node mutation counter: the pipelined cycle's
         # staleness guard compares the value captured at solve dispatch
         # against the value at fetch — equality proves NO pod/node state
         # changed during the overlap, so the capacity re-validation can
         # be skipped wholesale (the steady-state case).
-        self.mutation_seq = 0
+        self.mutation_seq = 0  # guarded-by: _lock
         # Bumped when maybe_compact renumbers pod rows: an in-flight
         # solve's row indices are void across a compaction and the whole
         # result must be dropped (rows are otherwise stable for a pod's
         # lifetime — tombstoned rows are never reused).
-        self.compact_gen = 0
+        self.compact_gen = 0  # guarded-by: _lock
         # Node rows touched since the last reset_node_delta(): lets the
         # device-resident snapshot upload per-row deltas instead of the
         # full [N, *] planes on every node-table epoch bump.
-        self._node_dirty_rows: set = set()
-        self._node_dirty_floor = 0
+        self._node_dirty_rows: set = set()  # guarded-by: _lock
+        self._node_dirty_floor = 0  # guarded-by: _lock
 
     # ================================================================ pods
 
+    # holds: _lock
     def _feat(self, pod: Pod) -> _PodFeat:
         feat = getattr(pod, "_mirror_feat", None)
         if feat is not None:
@@ -441,6 +442,7 @@ class StoreMirror:
             pass
         return feat
 
+    # holds: _lock
     def _intern_queried(self, kv: Tuple[str, str]) -> int:
         """Intern a selector-queried label pair; nodes carrying a newly
         queried pair are re-encoded so their bitset row gains the bit."""
@@ -541,6 +543,7 @@ class StoreMirror:
         only for rare term backfills)."""
         self._pods_ref = pods
 
+    # holds: _lock
     def upsert_pod(self, pod: Pod, job_row_of) -> None:
         """Insert or update a pod row.  ``job_row_of(job_id) -> row``."""
         self.mutation_seq += 1
@@ -650,6 +653,7 @@ class StoreMirror:
                 if self._term_matches(e, pod.namespace, pod.labels, juid):
                     self.term_members[e].append(row)
 
+    # holds: _lock
     def remove_pod(self, uid: str) -> None:
         row = self.p_row.pop(uid, None)
         if row is None:
@@ -663,6 +667,7 @@ class StoreMirror:
         self.p_pod[row] = None
         self.n_dead += 1
 
+    # holds: _lock
     def set_pod_state(self, uid: str, status: int, node_row: int) -> None:
         row = self.p_row.get(uid)
         if row is not None:
@@ -675,6 +680,7 @@ class StoreMirror:
 
     # ================================================================ nodes
 
+    # holds: _lock
     def upsert_node(self, node) -> int:
         row = self.n_row.get(node.name)
         new = row is None
@@ -749,6 +755,7 @@ class StoreMirror:
             out[i] = m.get(int(r), int(r))
         return out
 
+    # holds: _lock
     def remove_node(self, name: str) -> None:
         row = self.n_row.get(name)
         if row is not None:
@@ -759,6 +766,7 @@ class StoreMirror:
             self.mutation_seq += 1
             self._node_dirty_rows.add(row)
 
+    # holds: _lock
     def node_delta_rows(self, since_epoch: int) -> Optional[np.ndarray]:
         """Node rows changed since ``since_epoch``, or None when the
         dirty set cannot prove it covers that span (a second consumer
@@ -768,6 +776,7 @@ class StoreMirror:
             return None
         return np.array(sorted(self._node_dirty_rows), np.int64)
 
+    # holds: _lock
     def reset_node_delta(self) -> None:
         self._node_dirty_rows.clear()
         self._node_dirty_floor = self.epoch
@@ -923,6 +932,7 @@ class StoreMirror:
 
     # ========================================================== maintenance
 
+    # holds: _lock
     def maybe_compact(self) -> None:
         """Rebuild the pod table without tombstones (rare, amortized)."""
         total = len(self.p_uid)
@@ -1018,6 +1028,7 @@ class StoreMirror:
         self._node_dirty_rows = dirty
         self._node_dirty_floor = floor
 
+    # holds: _lock
     def resync_status(self, pods: Dict[str, "Pod"]) -> None:
         """Re-derive every live row's dynamic state from the pod records
         (the system of record).  Recovery path: a failed fast cycle may

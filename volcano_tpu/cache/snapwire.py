@@ -22,7 +22,14 @@ import numpy as np
 
 from ..native import lib_or_none
 
-# dtype <-> u8 code (stable wire contract; extend append-only).
+# Wire constants + dtype <-> u8 code table (code = list index; stable
+# wire contract, extend append-only).  MUST mirror csrc/vcsnap.cc
+# (kVcsnapMagic / kVcsnapVersion / kVcsnapMaxDims / kVcsnapDtypes);
+# tools/vclint's schema cross-checker parses both sides and fails the
+# green-gate on any drift (VCL301/VCL302).
+WIRE_MAGIC = 0x4E534356
+WIRE_VERSION = 1
+WIRE_MAX_DIMS = 8
 _DTYPES = [
     np.dtype(np.float32), np.dtype(np.float64), np.dtype(np.int8),
     np.dtype(np.int16), np.dtype(np.int32), np.dtype(np.int64),
@@ -47,7 +54,7 @@ def encode_frame(arrays: List[np.ndarray], manifest: dict) -> bytes:
     for a in arrs:
         if a.dtype not in _DTYPE_CODE:
             raise TypeError(f"unsupported wire dtype {a.dtype}")
-        if a.ndim > 8:
+        if a.ndim > WIRE_MAX_DIMS:
             raise ValueError(f"unsupported wire ndim {a.ndim}")
     n = len(arrs)
     dtypes = np.array([_DTYPE_CODE[a.dtype] for a in arrs], np.uint8)
@@ -71,7 +78,8 @@ def encode_frame(arrays: List[np.ndarray], manifest: dict) -> bytes:
         return out.tobytes()
     # NumPy fallback: byte-identical layout.
     parts = [np.frombuffer(
-        np.array([0x4E534356, 1, n, len(man)], np.uint32).tobytes()
+        np.array([WIRE_MAGIC, WIRE_VERSION, n, len(man)],
+                 np.uint32).tobytes()
         + man, np.uint8
     )]
     pad = _align8(16 + len(man)) - (16 + len(man))
@@ -142,7 +150,7 @@ def decode_frame(buf: bytes) -> Tuple[dict, List[np.ndarray]]:
     if len(buf) < 16:
         raise ValueError("malformed snapshot frame")
     head = np.frombuffer(buf, np.uint32, count=4)
-    if int(head[0]) != 0x4E534356 or int(head[1]) != 1:
+    if int(head[0]) != WIRE_MAGIC or int(head[1]) != WIRE_VERSION:
         raise ValueError("malformed snapshot frame")
     n = int(head[2])
     mlen = int(head[3])
@@ -154,7 +162,7 @@ def decode_frame(buf: bytes) -> Tuple[dict, List[np.ndarray]]:
             raise ValueError("malformed snapshot frame")
         dt_code = buf[off]
         nd = buf[off + 1]
-        if nd > 8 or dt_code >= len(_DTYPES):
+        if nd > WIRE_MAX_DIMS or dt_code >= len(_DTYPES):
             raise ValueError("malformed snapshot frame")
         shape = tuple(np.frombuffer(buf, np.int64, count=nd,
                                     offset=off + 8).tolist())

@@ -76,7 +76,7 @@ class ClusterStore:
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.namespace_weights: Dict[str, int] = {}
         # Raw spec objects (system of record for controllers):
-        self.pods: Dict[str, Pod] = {}
+        self.pods: Dict[str, Pod] = {}  # guarded-by: _lock
         self.pod_groups: Dict[str, PodGroup] = {}
         self.raw_queues: Dict[str, Queue] = {}
         # Controller-plane records (the reference stores these as CRDs /
@@ -93,13 +93,13 @@ class ClusterStore:
         # clusters must skip on an O(1) check that cannot miss a
         # volume-carrying pod (unlike gating on the claim registry,
         # which a custom volume binder need not use).
-        self.n_volume_pods = 0
+        self.n_volume_pods = 0  # guarded-by: _lock
         # ns/name -> persistent-volume-claim record
         # {"spec", "phase" Pending|Bound, "node", "owner_job"} — the PVC
         # store the job controller creates into (initiateJob PVCs,
         # job_controller_actions.go:394-531) and the volume binder
         # allocates/binds against (cache.go:557-564).
-        self.pvcs: Dict[str, Dict[str, object]] = {}
+        self.pvcs: Dict[str, Dict[str, object]] = {}  # guarded-by: _lock
 
         self.binder: Binder = binder or FakeBinder()
         self.evictor: Evictor = evictor or FakeEvictor()
@@ -128,14 +128,14 @@ class ClusterStore:
         # Successful binds whose backoff entries the cycle thread should
         # clear at the next drain (tracked only while bind_backoff is
         # non-empty, so steady-state binds pay nothing).
-        self._succeeded_bind_keys: List[str] = []
+        self._succeeded_bind_keys: List[str] = []  # guarded-by: _bind_fail_lock
         # [(key, pod), ...] reported by the dispatcher thread.
-        self._failed_bind_keys: List[tuple] = []
+        self._failed_bind_keys: List[tuple] = []  # guarded-by: _bind_fail_lock
         # "ns/name" -> (consecutive fails, retry-not-before ts, pod uid).
         # Cycle-thread-owned: mutated only by drain_bind_failures and
         # delete_pod (both under _lock); the dispatcher thread queues
         # clears via _succeeded_bind_keys instead of touching it.
-        self.bind_backoff: Dict[str, tuple] = {}
+        self.bind_backoff: Dict[str, tuple] = {}  # guarded-by: _lock
 
         # Per-object user-visible event trail (the reference records
         # Kubernetes Events for Evict/Scheduled/FailedScheduling/
@@ -149,19 +149,28 @@ class ClusterStore:
         # config-4 close lane.
         import collections as _collections
 
+        # guarded-by: _events_lock
         self._events: "_collections.OrderedDict[str, List[list]]" = (
             _collections.OrderedDict()
         )
         self._events_lock = threading.Lock()
         # Whole batches parked by record_events_deferred, folded into
         # the trails at the next read/record (off the cycle's clock).
-        self._deferred_events: List[tuple] = []
+        self._deferred_events: List[tuple] = []  # guarded-by: _events_lock
 
         # Deferred bind-record walks not yet materialized (see
         # defer_bind_records): registered at commit time so failure
         # paths can force them before reading pod records.
         self._record_walk_lock = threading.Lock()
+        # guarded-by: _record_walk_lock
         self._pending_record_walks: List[list] = []
+
+        # Parked dispatched-but-uncommitted device solve (pipeline.py
+        # InflightSolve): written by the cycle thread at dispatch,
+        # popped at the next cycle's top — but also reachable from
+        # store.close()/Scheduler.stop() on other threads, so the slot
+        # itself is lock-guarded (vclint VCL101/102 enforces this).
+        self._inflight_solve = None  # guarded-by: _lock (any-receiver)
 
         # Create the default queue at startup, weight 1 (cache.go:244-254).
         self.add_queue(Queue(name=default_queue, weight=1))
@@ -354,6 +363,10 @@ class ClusterStore:
         Backoff clears are queued for the cycle thread (``bind_backoff``
         is cycle-thread-owned; popping it here could lose a concurrent
         ``drain_bind_failures`` increment)."""
+        # vclint: disable=VCL101 -- dispatcher-thread truthiness probe
+        # of the cycle-thread-owned dict; a stale read only delays when
+        # clears are queued, and drain_bind_failures reconciles.  Taking
+        # _lock here would block this thread for a whole cycle.
         if self.bind_backoff:
             with self._bind_fail_lock:
                 self._succeeded_bind_keys.extend(keys)
@@ -379,7 +392,7 @@ class ClusterStore:
             self._failed_bind_keys = []
             succeeded = self._succeeded_bind_keys
             self._succeeded_bind_keys = []
-        if succeeded and self.bind_backoff:
+        if succeeded:
             with self._lock:
                 for key in succeeded:
                     self.bind_backoff.pop(key, None)
@@ -823,6 +836,7 @@ class ClusterStore:
 
     # ------------------------------------------------------------ side effects
 
+    # holds: _lock
     def _replace_pod(self, pod, **mutations):
         """Copy-on-write pod replacement: the stored Pod is replaced,
         never mutated, so snapshot TaskInfos holding the old Pod keep
@@ -929,7 +943,8 @@ class ClusterStore:
             ]
 
     def task_in_store(self, uid: str) -> Optional[Pod]:
-        return self.pods.get(uid)
+        with self._lock:
+            return self.pods.get(uid)
 
 
 class StoreVolumeBinder:

@@ -173,6 +173,11 @@ class _JobProxy:
 class FastCycle:
     """One vectorized scheduling cycle over the store mirror."""
 
+    # The single entry point (run_cycle_fast) wraps the whole cycle in
+    # ``with store._lock``, so every method below runs with the store
+    # lock held.
+    # vclint: class-holds: _lock
+
     def __init__(self, store, conf):
         self.store = store
         self.conf = conf
@@ -1020,6 +1025,10 @@ class FastCycle:
     _MIN_BUDGET_SCALE = 1.0 / 64.0
     # Clean affinity cycles before the degraded budget doubles back up.
     _SCALE_RECOVER_AFTER = 8
+    # Consecutive remote-solver fetch failures tolerated as "lost
+    # reply" before the pipelined commit fails the cycle (a child that
+    # keeps replying garbage never fails the send-side probe).
+    REMOTE_FETCH_FAIL_CAP = 3
 
     @classmethod
     def _is_device_crash(cls, e: BaseException) -> bool:
@@ -1275,8 +1284,22 @@ class FastCycle:
                     e, (OSError, ConnectionError, ValueError)):
                 # Lost reply (solver child died, connection dropped):
                 # the pods are still Pending and re-place below; a
-                # persistently dead child surfaces synchronously at
-                # this cycle's own dispatch (solve_async's send).
+                # persistently DEAD child surfaces synchronously at
+                # this cycle's own dispatch (solve_async's send) — but
+                # a child that keeps replying garbage (codec drift)
+                # never fails the send, so consecutive fetch failures
+                # are capped: past the cap the cycle fails loudly and
+                # the scheduler's failure/health accounting takes over
+                # instead of looping forever placing nothing.
+                fails = getattr(
+                    self.store, "_remote_fetch_fails", 0) + 1
+                self.store._remote_fetch_fails = fails
+                if fails >= self.REMOTE_FETCH_FAIL_CAP:
+                    log.error(
+                        "in-flight remote solve fetch failed %d "
+                        "consecutive times; failing the cycle", fails,
+                    )
+                    raise
                 log.warning(
                     "in-flight remote solve reply lost; %d rows "
                     "re-place this cycle",
@@ -1301,6 +1324,7 @@ class FastCycle:
             # from a synchronous solve.
             raise
         t_done = time.perf_counter()
+        self.store._remote_fetch_fails = 0
         lanes["device"] = lanes.get("device", 0.0) + (t_done - t0)
         # The residual wait is the pipeline's health signal: it
         # approaches zero exactly when the overlap works.  The

@@ -58,6 +58,9 @@ class EvictState:
     """Per-cycle state for the eviction actions (lazy, built on first
     preempt/reclaim execution)."""
 
+    # Lives inside FastCycle.run, under run_cycle_fast's store lock.
+    # vclint: class-holds: _lock
+
     def __init__(self, cyc):
         self.cyc = cyc
         m = cyc.m
@@ -362,6 +365,9 @@ class _LazyHeap:
 
 class FastEvictor:
     """Shared machinery for fast preempt + reclaim over one FastCycle."""
+
+    # Lives inside FastCycle.run, under run_cycle_fast's store lock.
+    # vclint: class-holds: _lock
 
     def __init__(self, cyc):
         self.cyc = cyc

@@ -80,6 +80,10 @@ class DeviceSnapshot:
 
     # ------------------------------------------------------------- planes
 
+    # Called only from FastCycle._solve_inputs, inside the cycle's
+    # ``with store._lock`` (holds: _lock) — the mirror delta reads and
+    # resets below mutate store-guarded state.
+    # holds: _lock
     def node_planes(self, m, key: Tuple,
                     build: Dict[str, Callable[[], np.ndarray]]):
         """Return ``{name: device_array}`` for the node-side planes.

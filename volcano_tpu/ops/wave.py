@@ -1767,6 +1767,8 @@ def solve_wave(
         # persistent device plane back just to .any() it would put a
         # tunnel round trip on every dispatch.
         (bool(taint_any) if taint_any is not None
+         # vclint: disable=VCL201 -- numpy fallback; taint_any skips it
+         # (device-resident callers always pass the host-computed hint)
          else bool(_np(nodes.taint_bits).any())),
         bool(_np(nodes.releasing).any() or _np(nodes.pipelined).any()),
         bool((_np(queues.deserved) < 1.0e38).any()),

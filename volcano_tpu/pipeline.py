@@ -97,10 +97,16 @@ class InflightSolve:
 
 
 def take_inflight(store) -> Optional[InflightSolve]:
-    """Pop the store's in-flight solve (None when no dispatch pending)."""
-    inflight = getattr(store, "_inflight_solve", None)
-    if inflight is not None:
-        store._inflight_solve = None
+    """Pop the store's in-flight solve (None when no dispatch pending).
+
+    The slot is lock-guarded: the cycle thread owns it between dispatch
+    and fetch, but ``store.close()`` and ``Scheduler.stop()`` pop it
+    from other threads (the RLock makes the cycle-thread re-entry
+    free)."""
+    with store._lock:
+        inflight = store._inflight_solve
+        if inflight is not None:
+            store._inflight_solve = None
     return inflight
 
 

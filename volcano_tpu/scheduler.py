@@ -113,6 +113,11 @@ class Scheduler:
         # returns False (active/passive HA, see volcano_tpu.ha).
         self.gate = gate
         self._stop = threading.Event()
+        # run()/stop() may race from different operator threads (service
+        # shutdown vs a late start); the lifecycle lock makes the leak
+        # window (two run() calls both spawning loop threads) impossible.
+        self._lifecycle_lock = threading.Lock()
+        # guarded-by: _lifecycle_lock
         self._thread: Optional[threading.Thread] = None
         self._last_conf = None
         self._consecutive_failures = 0
@@ -267,9 +272,19 @@ class Scheduler:
     # ----------------------------------------------------------------- loop
 
     def run(self) -> None:
-        """Start the periodic loop in a background thread."""
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        """Start the periodic loop in a background thread (no-op when
+        it is already running; restartable after ``stop()``)."""
+        with self._lifecycle_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            # A prior stop() left the event set; clear it under the
+            # lifecycle lock (stop() sets it under the same lock) so the
+            # fresh thread actually loops.
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True
+            )
+            self._thread.start()
 
     # Consecutive failed cycles before healthy() reports False (a crashed
     # TPU runtime is unrecoverable in-process; the health signal lets a
@@ -326,18 +341,23 @@ class Scheduler:
         device solve left parked between cycles: the solved pods are
         still Pending store-side, so nothing is lost — a restarted
         scheduler simply re-places them on its first cycle."""
-        self._stop.set()
-        t = self._thread
-        if t is not None:
-            t.join(self.STOP_TIMEOUT if timeout is None else timeout)
-            if t.is_alive():
-                log.error(
-                    "scheduler loop thread did not exit within %.0fs; "
-                    "in-flight state NOT drained",
-                    self.STOP_TIMEOUT if timeout is None else timeout,
-                )
-                return
-            self._thread = None
+        with self._lifecycle_lock:
+            # Set inside the lifecycle lock: a concurrent run() could
+            # otherwise clear the event between our set and the join,
+            # leaving this stop() waiting 30 s on a thread that will
+            # never exit.
+            self._stop.set()
+            t = self._thread
+            if t is not None:
+                t.join(self.STOP_TIMEOUT if timeout is None else timeout)
+                if t.is_alive():
+                    log.error(
+                        "scheduler loop thread did not exit within "
+                        "%.0fs; in-flight state NOT drained",
+                        self.STOP_TIMEOUT if timeout is None else timeout,
+                    )
+                    return
+                self._thread = None
         # Only after the thread is dead: the cycle thread owns the
         # in-flight handle while it runs.
         from .pipeline import abandon_inflight

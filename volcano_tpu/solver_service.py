@@ -226,17 +226,18 @@ class RemoteSolver:
         self.host = host or "127.0.0.1"
         self.port = int(port)
         self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None  # guarded-by: _lock
         # Outstanding pipelined request (solve_async): the wire protocol
         # is strict request/reply, so at most one may be unread.
-        self._pending: Optional["PendingSolve"] = None
+        self._pending: Optional["PendingSolve"] = None  # guarded-by: _lock
         # Round-trip + payload telemetry for the BASELINE overhead table.
         self.requests = 0
         self.bytes_out = 0
         self.bytes_in = 0
         self.last_solve_ms: Optional[float] = None
 
+    # holds: _lock
     def _connect(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection(
