@@ -32,11 +32,16 @@ JSON lines carry the per-lane split in a "lanes" tail.
 
 Env knobs: BENCH_NODES/BENCH_PODS/BENCH_GANG/BENCH_REPEATS override config
 defaults; BENCH_PIPELINE=0 skips the pipelined pass, BENCH_PIPE_CYCLES
-sets the steady-state cycle count (min 5).
+sets the steady-state cycle count (min 5).  Every config additionally
+writes a Perfetto-loadable trace file (flight-recorder cycles,
+BENCH_TRACE_DIR; default /tmp/vtpu_bench_traces) and reports
+staleness-drop totals plus per-lane p50/p95 (steady-state cycles only)
+in the machine-readable JSON tail.
 """
 
 import json
 import os
+import re
 import sys
 import time
 
@@ -44,7 +49,8 @@ NORTH_STAR_MS = 100.0
 NORTH_STAR_PODS = 100000
 
 
-def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None):
+def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
+          records=None):
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
     payload = {
@@ -63,9 +69,56 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None):
             for k, v in sorted(lanes.items(), key=lambda kv: -kv[1])
             if v >= 5e-4
         }
+    if records:
+        # Flight-recorder tail (ISSUE 3): staleness-drop totals by
+        # reason and per-lane p50/p95 over the steady-state cycles, so
+        # BENCH_r*.json captures the distribution, not just the best.
+        drops = {}
+        for rec in records:
+            for reason, n in rec.drop_reasons.items():
+                drops[reason] = drops.get(reason, 0) + n
+        payload["drops"] = drops
+        payload["lane_p50"], payload["lane_p95"] = _lane_pctl(records)
+        _write_trace(metric, records)
     print(json.dumps(payload))
     if extra:
         print(f"# {extra}", file=sys.stderr)
+
+
+def _lane_pctl(records):
+    """Per-lane p50/p95 milliseconds over the given cycle records."""
+    by_lane = {}
+    for rec in records:
+        for lane, sec in rec.lanes.items():
+            by_lane.setdefault(lane, []).append(sec * 1e3)
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        i = min(int(q * (len(vals) - 1) + 0.5), len(vals) - 1)
+        return round(vals[i], 2)
+
+    p50 = {k: pct(v, 0.50) for k, v in by_lane.items()}
+    p95 = {k: pct(v, 0.95) for k, v in by_lane.items()}
+    return p50, p95
+
+
+def _write_trace(metric, records):
+    """One Perfetto trace file per emitted config/mode (chrome://tracing
+    or ui.perfetto.dev; see docs/tracing.md)."""
+    from volcano_tpu.obs import export
+
+    out_dir = os.environ.get("BENCH_TRACE_DIR",
+                             "/tmp/vtpu_bench_traces")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        slug = re.sub(r"[^a-z0-9]+", "-",
+                      metric.lower()).strip("-")[:80]
+        path = export.write_trace(
+            os.path.join(out_dir, f"trace_{slug}.json"), records
+        )
+        print(f"# trace: {path}", file=sys.stderr)
+    except OSError as err:  # trace files are best-effort
+        print(f"# trace write failed: {err}", file=sys.stderr)
 
 
 def _cycle_bench(make_store, conf, repeats, warm_store=None):
@@ -91,6 +144,7 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
 
     times = []
     lanes_best = None
+    records = []
     for r in range(repeats):
         store_r = make_store(r + 1)
         store_r.async_bind = async_bind
@@ -100,13 +154,17 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
         times.append(time.perf_counter() - t0)
         if times[-1] == min(times):
             lanes_best = getattr(store_r, "last_cycle_lanes", None)
+        # Flight-recorder records survive the store close (plain list
+        # of plain records); one timed cycle each -> the repeat set IS
+        # the steady-state distribution.
+        records.extend(store_r.flight.recent())
         store_r.flush_binds()
         # The dispatcher thread's callbacks pin the store; stop it so the
         # repeat's full mirror is actually freed.
         store_r.close()
         del store_r, sched_r
     e2e_ms = min(times) * 1e3 if times else warm_s * 1e3
-    return e2e_ms, bound, evicted, warm_s, times, lanes_best
+    return e2e_ms, bound, evicted, warm_s, times, lanes_best, records
 
 
 def _pipelined_bench(make_store, conf, cycles=None):
@@ -161,14 +219,18 @@ def _pipelined_bench(make_store, conf, cycles=None):
     lanes = {k: v / len(times) for k, v in lane_acc.items()}
     store.flush_binds()
     bound_per_cycle = fed["total"] // max(cycles + 1, 1)
+    # Steady-state flight records only (the two warm-up cycles carry
+    # compile + pipeline-fill time and would skew the percentiles).
+    records = store.flight.recent()[-len(times):]
     store.close()
-    return amortized_ms, bound_per_cycle, warm_s, times, lanes
+    return amortized_ms, bound_per_cycle, warm_s, times, lanes, records
 
 
 def _emit_pipelined(label, mk, conf, n_pods):
     if os.environ.get("BENCH_PIPELINE", "1") == "0":
         return
-    amortized_ms, bound, warm_s, times, lanes = _pipelined_bench(mk, conf)
+    amortized_ms, bound, warm_s, times, lanes, records = _pipelined_bench(
+        mk, conf)
     _emit(
         f"{label} (pipelined steady-state, amortized {len(times)} cycles)",
         amortized_ms, n_pods,
@@ -177,6 +239,7 @@ def _emit_pipelined(label, mk, conf, n_pods):
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
         + _lane_note(lanes),
         lanes=lanes,
+        records=records,
     )
 
 
@@ -278,7 +341,7 @@ def config_2(n_nodes, n_pods, gang, repeats):
     build_t0 = time.perf_counter()
     store = synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods, gang_size=gang)
     build_s = time.perf_counter() - build_t0
-    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(
+    e2e_ms, bound, _, warm_s, times, lanes, recs = _cycle_bench(
         lambda r: synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods,
                                     gang_size=gang, seed=r),
         CONF_BASE, repeats, warm_store=store,
@@ -292,6 +355,7 @@ def config_2(n_nodes, n_pods, gang, repeats):
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
         + _lane_note(lanes),
         lanes=lanes,
+        records=recs,
     )
     _emit_pipelined(
         f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending pods "
@@ -311,7 +375,8 @@ def config_3(repeats):
         n_nodes=n_nodes, n_pods=n_pods, n_queues=4,
         queue_weights=(1, 2, 4, 8), gang_sizes=(2, 4, 8, 16), seed=r,
     )
-    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(mk, CONF_BASE, repeats)
+    e2e_ms, bound, _, warm_s, times, lanes, recs = _cycle_bench(
+        mk, CONF_BASE, repeats)
     _emit(
         f"DRF multi-queue e2e @ {n_nodes} nodes x {n_pods} pods, 4 queues",
         e2e_ms, n_pods,
@@ -319,6 +384,7 @@ def config_3(repeats):
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
         + _lane_note(lanes),
         lanes=lanes,
+        records=recs,
     )
     _emit_pipelined(
         f"DRF multi-queue e2e @ {n_nodes} nodes x {n_pods} pods, 4 queues",
@@ -333,7 +399,7 @@ def config_4(repeats):
     n_pending = int(os.environ.get("BENCH_PODS", 20000))
     mk = lambda r: preempt_cluster(n_nodes=n_nodes, n_pending=n_pending,
                                    seed=r)
-    e2e_ms, bound, evicted, warm_s, times, lanes = _cycle_bench(
+    e2e_ms, bound, evicted, warm_s, times, lanes, recs = _cycle_bench(
         mk, CONF_PREEMPT, repeats)
     # No pipelined row: the preempt/reclaim actions mutate node capacity
     # AFTER the allocate dispatch, so every overlapped commit would hit
@@ -347,6 +413,7 @@ def config_4(repeats):
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
         + _lane_note(lanes),
         lanes=lanes,
+        records=recs,
     )
 
 
@@ -361,7 +428,8 @@ def config_5(repeats):
         affinity_fraction=0.05, anti_affinity_fraction=0.05,
         spread_fraction=0.1, seed=r,
     )
-    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(mk, CONF_BASE, repeats)
+    e2e_ms, bound, _, warm_s, times, lanes, recs = _cycle_bench(
+        mk, CONF_BASE, repeats)
     _emit(
         f"hyperscale binpack+affinity e2e @ {n_nodes} nodes x "
         f"{n_pods} pods",
@@ -370,6 +438,7 @@ def config_5(repeats):
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
         + _lane_note(lanes),
         lanes=lanes,
+        records=recs,
     )
     _emit_pipelined(
         f"hyperscale binpack+affinity e2e @ {n_nodes} nodes x "
@@ -387,7 +456,7 @@ def config_north(repeats):
     mk = lambda r: synthetic_cluster(
         n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16, seed=r,
     )
-    e2e_ms, bound, _, warm_s, times, lanes = _cycle_bench(
+    e2e_ms, bound, _, warm_s, times, lanes, recs = _cycle_bench(
         mk, CONF_BASE, repeats)
     _emit(
         f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending "
@@ -398,6 +467,7 @@ def config_north(repeats):
         f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
         + _lane_note(lanes),
         lanes=lanes,
+        records=recs,
     )
     _emit_pipelined(
         f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending "

@@ -1,0 +1,150 @@
+"""Prometheus exposition correctness (ISSUE 3 satellites).
+
+- Golden-text: ``expose_text`` emits exactly the expected series lines
+  for gauge / counter / histogram with labels (pinning the text format
+  across the bounded-histogram rewrite — bucket counts + sum + count
+  replaced the unbounded per-observation list).
+- Memory bound: a histogram's per-label state stays fixed-size no
+  matter how many observations land.
+- Concurrency: scrapes racing writers must never throw ("dictionary
+  changed size during iteration") nor tear a histogram's bucket/count
+  invariants.
+
+Tier-1, CPU-only: nothing here touches jax.
+"""
+
+import threading
+
+import pytest
+
+from volcano_tpu.metrics.metrics import _DEFAULT_BUCKETS, Metrics
+
+pytestmark = pytest.mark.tier1
+
+
+def _series_lines(text, name):
+    return [l for l in text.splitlines()
+            if l.startswith(name) and not l.startswith("#")]
+
+
+# ---------------------------------------------------------------- golden
+
+
+def test_expose_text_golden_gauge_counter_histogram():
+    m = Metrics()
+    m.queue_share.set(0.25, queue="q1")
+    m.queue_share.set(0.75, queue="q2")
+    m.schedule_attempts.inc(result="ok")
+    m.schedule_attempts.inc(2.0, result="err")
+    m.device_solve_latency.observe(0.004)   # first bucket
+    m.device_solve_latency.observe(3.0)     # le=5 bucket
+    m.device_solve_latency.observe(50000.0)  # beyond every bucket
+    text = m.expose_text()
+
+    assert _series_lines(text, "volcano_queue_share") == [
+        'volcano_queue_share{queue="q1"} 0.25',
+        'volcano_queue_share{queue="q2"} 0.75',
+    ]
+    assert _series_lines(text, "volcano_schedule_attempts_total") == [
+        'volcano_schedule_attempts_total{result="ok"} 1.0',
+        'volcano_schedule_attempts_total{result="err"} 2.0',
+    ]
+    hist = "volcano_device_solve_latency_milliseconds"
+    expected = []
+    for b in _DEFAULT_BUCKETS:
+        cnt = sum(1 for v in (0.004, 3.0, 50000.0) if v <= b)
+        expected.append(f'{hist}_bucket{{le="{b}"}} {cnt}')
+    expected.append(f'{hist}_bucket{{le="+Inf"}} 3')
+    expected.append(f'{hist}_sum{{}} 50003.004')
+    expected.append(f'{hist}_count{{}} 3')
+    assert _series_lines(text, hist) == expected
+    # HELP/TYPE headers precede every family.
+    assert f"# HELP {hist} " in text
+    assert f"# TYPE {hist} histogram" in text
+
+
+def test_histogram_state_is_bounded():
+    m = Metrics()
+    h = m.e2e_scheduling_latency
+    for i in range(10_000):
+        h.observe(float(i % 977))
+    (state,) = h.data.values()
+    counts, total, n = state
+    # Fixed-size state: one slot per bucket + overflow, no raw list.
+    assert len(counts) == len(_DEFAULT_BUCKETS) + 1
+    assert n == 10_000
+    assert sum(counts) == 10_000
+    assert total == sum(float(i % 977) for i in range(10_000))
+
+
+# ----------------------------------------------------------- concurrency
+
+
+def test_concurrent_scrape_while_observing_never_throws():
+    """Writers mutate label dicts while a scraper iterates: without the
+    shared registry lock this raced into RuntimeError (dict changed
+    size during iteration) and torn histogram reads."""
+    m = Metrics()
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        i = 0
+        try:
+            while not stop.is_set():
+                m.e2e_scheduling_latency.observe(
+                    float(i % 100), worker=f"w{tid}-{i % 50}")
+                m.schedule_attempts.inc(result=f"r{tid}-{i % 50}")
+                m.unschedule_task_count.set(i, job_name=f"j{tid}-{i % 50}")
+                i += 1
+        except Exception as err:  # pragma: no cover - the failure mode
+            errors.append(err)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            text = m.expose_text()
+            # Scrape-consistency invariant: within one scrape, every
+            # histogram's +Inf bucket equals its count line.
+            lines = text.splitlines()
+            for i, line in enumerate(lines):
+                if '_bucket{' in line and 'le="+Inf"' in line:
+                    inf_v = line.rsplit(" ", 1)[1]
+                    cnt_line = lines[i + 2]
+                    assert cnt_line.rsplit(" ", 1)[1] == inf_v
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+
+
+def test_batch_and_single_updates_serialize_with_scrapes():
+    m = Metrics()
+    keys = [(("job_name", f"j{i}"),) for i in range(100)]
+    stop = threading.Event()
+    errors = []
+
+    def batcher():
+        try:
+            while not stop.is_set():
+                m.job_retry_counts.inc_many(keys)
+                m.unschedule_task_count.set_many(
+                    (k, 1.0) for k in keys)
+        except Exception as err:  # pragma: no cover
+            errors.append(err)
+
+    t = threading.Thread(target=batcher)
+    t.start()
+    try:
+        for _ in range(200):
+            m.expose_text()
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors, errors
+    total = sum(m.job_retry_counts.data.values())
+    assert total % len(keys) == 0  # whole batches only, never torn

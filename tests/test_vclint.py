@@ -12,7 +12,7 @@ import sys
 import textwrap
 from pathlib import Path
 
-from tools.vclint import hotpath, lockcheck, schemacheck
+from tools.vclint import hotpath, lockcheck, metricscheck, schemacheck
 from tools.vclint.cli import run as vclint_run
 from tools.vclint.findings import finish
 
@@ -343,6 +343,78 @@ def test_wire_columns_match_real_encoder_output():
         for k in declared if declared[k] != produced[k]
     }
     assert not mismatched, mismatched
+
+
+# ------------------------------------------------------- metrics <-> docs
+
+
+METRICS_FIX = textwrap.dedent('''\
+    import threading
+
+
+    class _Histogram:
+        pass
+
+
+    class _Gauge:
+        pass
+
+
+    class _Counter:
+        pass
+
+
+    class Metrics:
+        def __init__(self):
+            ns = "volcano"
+            self.solve_latency = _Histogram(
+                f"{ns}_solve_latency_ms", "solve latency"
+            )
+            self.queue_depth = _Gauge(
+                f"{ns}_queue_depth", "queue depth"
+            )
+            self.undocumented = _Counter(
+                f"{ns}_brand_new_total", "never made it to the docs"
+            )
+''')
+
+DOC_FIX_DRIFTED = textwrap.dedent('''\
+    # Metrics
+
+    | Metric | Kind | Description |
+    |---|---|---|
+    | `volcano_solve_latency_ms` | Histogram | solve latency |
+    | `volcano_queue_depth` | Counter | documented with the wrong kind |
+    | `volcano_ghost_series_total` | Counter | removed from the registry |
+''')
+
+
+def test_metrics_drift_checker_catches_seeded_drift():
+    raw = metricscheck.analyze(
+        "metrics.py", METRICS_FIX, "metrics.md", DOC_FIX_DRIFTED
+    )
+    got = [(f.code, f.path, f.line) for f in raw]
+    msgs = "\n".join(f.message for f in raw)
+    # the registry-only series -> VCL401 at its constructor call
+    assert ("VCL401", "metrics.py", 25) in got
+    assert "volcano_brand_new_total" in msgs
+    # the docs-only series -> VCL402 at its table row
+    assert ("VCL402", "metrics.md", 7) in got
+    assert "volcano_ghost_series_total" in msgs
+    # gauge documented as Counter -> VCL403 at the row
+    assert ("VCL403", "metrics.md", 6) in got
+    # the in-sync series produces nothing
+    assert not any("volcano_solve_latency_ms" in f.message for f in raw)
+
+
+def test_metrics_drift_real_tree_is_clean():
+    raw = metricscheck.analyze(
+        "volcano_tpu/metrics/metrics.py",
+        (REPO_ROOT / "volcano_tpu/metrics/metrics.py").read_text(),
+        "docs/metrics.md",
+        (REPO_ROOT / "docs/metrics.md").read_text(),
+    )
+    assert raw == [], [f.render() for f in raw]
 
 
 # ------------------------------------------------------------- the gate

@@ -13,14 +13,15 @@ import sys
 from pathlib import Path
 from typing import List
 
-from . import hotpath, lockcheck, schemacheck
+from . import hotpath, lockcheck, metricscheck, schemacheck
 from .findings import Finding, finish
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
 # Files under the lock-discipline analysis (the concurrency surface of
 # the pipelined scheduler: shared store state, the mirror, the in-flight
-# solve handle, the remote-solver client).
+# solve handle, the remote-solver client, the flight-recorder ring the
+# HTTP debug handlers read cross-thread).
 LOCK_FILES = [
     "volcano_tpu/cache/store.py",
     "volcano_tpu/cache/mirror.py",
@@ -31,7 +32,15 @@ LOCK_FILES = [
     "volcano_tpu/fastpath.py",
     "volcano_tpu/fastpath_evict.py",
     "volcano_tpu/ops/devsnap.py",
+    "volcano_tpu/obs/recorder.py",
 ]
+
+# Metrics-drift surface: every series in the registry must have a row
+# in the docs table and vice versa (VCL401/402/403).
+METRICS_FILES = {
+    "metrics": "volcano_tpu/metrics/metrics.py",
+    "doc": "docs/metrics.md",
+}
 
 SCHEMA_FILES = {
     "snapwire": "volcano_tpu/cache/snapwire.py",
@@ -106,6 +115,26 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
                 rel, texts[key], by_path.get(rel, [])
             ))
 
+    # ---- metrics <-> docs drift ------------------------------------
+    try:
+        m_src = _read(METRICS_FILES["metrics"], root)
+        d_src = _read(METRICS_FILES["doc"], root)
+    except OSError as err:
+        all_findings.append(Finding(
+            "VCL001", str(err.filename or "?"), 1,
+            f"metrics-drift input unreadable: {err}",
+        ))
+    else:
+        raw4 = metricscheck.analyze(
+            METRICS_FILES["metrics"], m_src, METRICS_FILES["doc"], d_src,
+        )
+        by_path4 = {}
+        for f in raw4:
+            by_path4.setdefault(f.path, []).append(f)
+        for key, rel in METRICS_FILES.items():
+            src4 = m_src if key == "metrics" else d_src
+            all_findings.extend(finish(rel, src4, by_path4.get(rel, [])))
+
     # ---- report -----------------------------------------------------
     open_findings = [f for f in all_findings if not f.suppressed]
     suppressed = [f for f in all_findings if f.suppressed]
@@ -119,7 +148,7 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
         f"{len(suppressed)} suppressed "
         f"({len(sources)} lock files, "
         f"{sum(len(v) for v in hotpath.HOT_REGISTRY.values())} hot "
-        "functions, 1 schema/ABI surface)",
+        "functions, 1 schema/ABI surface, 1 metrics/docs surface)",
         file=out,
     )
     return 1 if open_findings else 0

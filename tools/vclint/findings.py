@@ -6,6 +6,7 @@ Finding codes (see docs/static_analysis.md for the full catalog):
 - VCL1xx  lock discipline (``# guarded-by`` / ``# holds`` contracts)
 - VCL2xx  device hot-path hygiene (host syncs, donation, retrace)
 - VCL3xx  schema <-> C++ ABI drift (wire codec, ctypes bindings)
+- VCL4xx  metrics <-> docs drift (registry vs docs/metrics.md)
 
 Suppression convention: a finding is silenced by a trailing comment on
 the SAME line it is reported at, or by a comment-only line DIRECTLY
@@ -45,6 +46,9 @@ CODE_TITLES = {
     "VCL302": "frame-codec constant drift (python vs C++)",
     "VCL303": "ctypes binding drift vs C prototype",
     "VCL304": "schema column declaration drift",
+    "VCL401": "metric series missing from docs/metrics.md",
+    "VCL402": "documented metric series missing from the registry",
+    "VCL403": "metric kind drift (docs vs registry)",
 }
 
 

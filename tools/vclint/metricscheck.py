@@ -1,0 +1,156 @@
+"""Metrics-drift analyzer (VCL4xx): docs/metrics.md ↔ the registry.
+
+``docs/metrics.md`` documents every Prometheus series ``vtpu-service``
+exposes.  Nothing kept the table honest: a new series added to
+``volcano_tpu/metrics/metrics.py`` (or one renamed/removed) silently
+rotted the docs.  This analyzer cross-checks the two 1:1:
+
+- **VCL401** — a series constructed in the ``Metrics`` registry has no
+  row in docs/metrics.md (reported at the constructor call).
+- **VCL402** — a docs/metrics.md row names a series the registry does
+  not construct (reported at the table row).
+- **VCL403** — the documented kind (Histogram/Gauge/Counter) disagrees
+  with the constructed series type.
+
+Registry extraction is pure AST: every ``_Histogram(...)`` /
+``_Gauge(...)`` / ``_Counter(...)`` call inside ``Metrics.__init__``
+whose first argument is an f-string over the local ``ns`` prefix (or a
+plain string literal) contributes one series.  Docs extraction matches
+the markdown table rows ``| `name` | Kind | ...``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+_SERIES_CTORS = {
+    "_Histogram": "Histogram",
+    "_Gauge": "Gauge",
+    "_Counter": "Counter",
+}
+
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`([A-Za-z_:][A-Za-z0-9_:]*)`\s*\|\s*(\w+)\s*\|"
+)
+
+
+def registry_series(metrics_path: str,
+                    metrics_src: str) -> Tuple[Dict[str, Tuple[str, int]],
+                                               List[Finding]]:
+    """name -> (kind, lineno) for every series the Metrics registry
+    constructs."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(metrics_src)
+    except SyntaxError as err:
+        return {}, [Finding(
+            "VCL001", metrics_path, err.lineno or 1,
+            f"metrics registry does not parse: {err.msg}",
+        )]
+
+    init = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Metrics":
+            for sub in node.body:
+                if (isinstance(sub, ast.FunctionDef)
+                        and sub.name == "__init__"):
+                    init = sub
+            break
+    if init is None:
+        return {}, [Finding(
+            "VCL001", metrics_path, 1,
+            "metrics registry has no Metrics.__init__ to analyze",
+        )]
+
+    # Local string prefixes (``ns = "volcano"``).
+    prefixes: Dict[str, str] = {}
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            prefixes[node.targets[0].id] = node.value.value
+
+    def literal_name(arg) -> str:
+        """Resolve a plain-string or {ns}-f-string series name; '' when
+        the expression is not statically resolvable."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.JoinedStr):
+            parts = []
+            for v in arg.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                elif (isinstance(v, ast.FormattedValue)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id in prefixes):
+                    parts.append(prefixes[v.value.id])
+                else:
+                    return ""
+            return "".join(parts)
+        return ""
+
+    series: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(init):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in _SERIES_CTORS):
+            continue
+        kind = _SERIES_CTORS[node.func.id]
+        if not node.args:
+            findings.append(Finding(
+                "VCL001", metrics_path, node.lineno,
+                f"{node.func.id}() constructed without a name argument",
+            ))
+            continue
+        name = literal_name(node.args[0])
+        if not name:
+            findings.append(Finding(
+                "VCL001", metrics_path, node.lineno,
+                f"{node.func.id}() name is not statically resolvable "
+                "(the metrics-drift check needs a literal)",
+            ))
+            continue
+        series[name] = (kind, node.lineno)
+    return series, findings
+
+
+def documented_series(doc_src: str) -> Dict[str, Tuple[str, int]]:
+    """name -> (kind, lineno) for every docs/metrics.md table row."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for lineno, text in enumerate(doc_src.splitlines(), start=1):
+        m = _DOC_ROW_RE.match(text.strip())
+        if m:
+            out[m.group(1)] = (m.group(2), lineno)
+    return out
+
+
+def analyze(metrics_path: str, metrics_src: str,
+            doc_path: str, doc_src: str) -> List[Finding]:
+    series, findings = registry_series(metrics_path, metrics_src)
+    docs = documented_series(doc_src)
+    for name, (kind, lineno) in sorted(series.items()):
+        doc = docs.get(name)
+        if doc is None:
+            findings.append(Finding(
+                "VCL401", metrics_path, lineno,
+                f"series '{name}' is not documented in {doc_path}",
+            ))
+        elif doc[0] != kind:
+            findings.append(Finding(
+                "VCL403", doc_path, doc[1],
+                f"series '{name}' documented as {doc[0]} but "
+                f"constructed as {kind}",
+            ))
+    for name, (_kind, lineno) in sorted(docs.items()):
+        if name not in series:
+            findings.append(Finding(
+                "VCL402", doc_path, lineno,
+                f"documented series '{name}' does not exist in the "
+                "Metrics registry",
+            ))
+    return findings

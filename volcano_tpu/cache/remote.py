@@ -58,6 +58,15 @@ class _HttpTransport:
     def __init__(self, base_url: str, timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # Span sink (obs/trace.py Tracer): the service wires the store's
+        # tracer in (default: the shared no-op), and every side-effect
+        # RPC lands in the cycle trace as an "rpc" track span.  These
+        # POSTs run on the bind dispatcher / cycle threads, so they go
+        # through the tracer's thread-safe timed_event() — never the
+        # cycle span stack.
+        from ..obs.trace import null_tracer
+
+        self.tracer = null_tracer()
 
     def _post(self, path: str, payload: dict) -> dict:
         req = urllib.request.Request(
@@ -66,8 +75,10 @@ class _HttpTransport:
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return json.loads(resp.read() or b"{}")
+        with self.tracer.timed_event(f"rpc:{path.lstrip('/')}",
+                                     args={"url": self.base_url}):
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
 
     def _get(self, path: str):
         with urllib.request.urlopen(f"{self.base_url}{path}",

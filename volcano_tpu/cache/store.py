@@ -172,6 +172,19 @@ class ClusterStore:
         # itself is lock-guarded (vclint VCL101/102 enforces this).
         self._inflight_solve = None  # guarded-by: _lock (any-receiver)
 
+        # Observability (obs/, ISSUE 3): the per-store span tracer and
+        # the cycle flight recorder.  Both are internally synchronized
+        # (the recorder's ring lock nests strictly inside _lock and is
+        # never taken around store state); stdlib-only, so wiring them
+        # unconditionally costs two small objects per store.
+        from ..obs import FlightRecorder, Tracer
+
+        self.tracer = Tracer()
+        self.flight = FlightRecorder()
+        # Monotonic pipelined solve-id: the flow link between a
+        # dispatch span in cycle N and its commit spans in cycle N+1.
+        self._solve_seq = 0  # guarded-by: _lock
+
         # Create the default queue at startup, weight 1 (cache.go:244-254).
         self.add_queue(Queue(name=default_queue, weight=1))
 

@@ -54,6 +54,7 @@ from .framework.arguments import Arguments, get_action_args
 from .framework.framework import POD_GROUP_UNSCHEDULABLE
 from .framework.session import _session_counter
 from .metrics import metrics
+from .obs.trace import tracer_of
 from .ops.allocate import SolveJobs, SolveNodes, SolveQueues, SolveTasks
 from .ops.scoring import ScoreWeights
 
@@ -199,6 +200,11 @@ class FastCycle:
         if flag is None:
             flag = os.environ.get("VOLCANO_TPU_PIPELINE", "0") == "1"
         self._pipeline_on = bool(flag)
+        # Span tracer (obs/trace.py, ISSUE 3): the cycle's lanes, the
+        # pipelined dispatch→fetch→commit chain, and the staleness
+        # guard all record spans; a null tracer keeps bare test stores
+        # working.
+        self.tracer = tracer_of(store)
 
     # --------------------------------------------------------- eligibility
 
@@ -668,15 +674,45 @@ class FastCycle:
         # published as store.last_cycle_lanes for bench.py / operators:
         # derive (mirror -> cycle arrays), order/pending (job ordering +
         # row prep), encode (solver input build), device (solve dispatch
-        # + device->host fetch), commit, evict actions, close.
+        # + device->host fetch), commit, evict actions, close.  The
+        # trace spans (obs/trace.py) both record the span AND
+        # accumulate these lanes, so disabling tracing keeps the
+        # breakdown.
         self.lanes: Dict[str, float] = {}
+        # Cycle accounting for the flight recorder (obs/recorder.py).
+        self.stats: Dict[str, object] = {
+            "considered": 0, "bound": 0, "dropped": 0,
+            "drop_reasons": {}, "fetch_wait_ms": None,
+            "dispatched_solve_id": None, "committed_solve_id": None,
+            "mut_at_dispatch": None, "mut_at_commit": None,
+            "epoch_at_dispatch": None, "epoch_at_commit": None,
+            "device_events": [],
+        }
         # Clear immediately: a failed cycle (slow-path fallback) must not
         # leave a previous cycle's breakdown masquerading as its own.
         store.last_cycle_lanes = None
-        t0 = time.perf_counter()
-        self.derive()
-        self._proportion()
-        self.lanes["derive"] = time.perf_counter() - t0
+        t_wall = time.time()
+        t_cycle = time.perf_counter()
+        err: Optional[BaseException] = None
+        try:
+            with self.tracer.span("cycle", cat="cycle",
+                                  args={"session": self.uid}):
+                self._run_body()
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            # Failed cycles record too — a flight recorder that only
+            # remembers the good cycles answers no incident question.
+            self._record_cycle(t_wall, time.perf_counter() - t_cycle,
+                               err)
+
+    def _run_body(self) -> None:
+        store = self.store
+        tracer = self.tracer
+        with tracer.span("derive", lanes=self.lanes):
+            self.derive()
+            self._proportion()
         self.new_conditions: Dict[int, PodGroupCondition] = {}
         self._evictor = None
         # Async bind batches commit collects; dispatched at cycle end so
@@ -697,12 +733,16 @@ class FastCycle:
                 # commits session N-1 and dispatches session N.
                 feed = getattr(store, "cycle_feed", None)
                 if feed is not None:
-                    t0 = time.perf_counter()
-                    feed(self)
-                    self.lanes["feed"] = time.perf_counter() - t0
+                    with tracer.span("feed", lanes=self.lanes):
+                        feed(self)
                 for name in self.action_names:
-                    t0 = time.perf_counter()
-                    with metrics.action_timer(name):
+                    lane = (name if name in ("preempt", "reclaim",
+                                             "enqueue", "backfill")
+                            else None)
+                    with metrics.action_timer(name), tracer.span(
+                            f"action:{name}", cat="action",
+                            lanes=(self.lanes if lane else None),
+                            lane=lane):
                         if name == "enqueue":
                             self._enqueue()
                         elif name == "allocate":
@@ -725,12 +765,6 @@ class FastCycle:
                         elif name == "reclaim":
                             self._evict_machinery().reclaim()
                             self.m.mutation_seq += 1
-                    if name in ("preempt", "reclaim", "enqueue",
-                                "backfill"):
-                        self.lanes[name] = (
-                            self.lanes.get(name, 0.0)
-                            + time.perf_counter() - t0
-                        )
             except BaseException:
                 # A failed cycle may leave uncommitted status mutations
                 # in the mirror (evictions mid-statement); re-derive
@@ -746,9 +780,8 @@ class FastCycle:
                 raise
             if self._evictor is not None:
                 self._evictor.st.flush()
-            t0 = time.perf_counter()
-            self._close()
-            self.lanes["close"] = time.perf_counter() - t0
+            with tracer.span("close", lanes=self.lanes):
+                self._close()
             store.last_cycle_lanes = dict(self.lanes)
         except BaseException:
             # Failures AFTER the action loop (evictor flush, close) must
@@ -764,6 +797,48 @@ class FastCycle:
             # idempotent and the commit bookkeeping already happened.
             for keys, hosts, pods, entry in self._bind_batches:
                 store.dispatch_binds(keys, hosts, pods, entry=entry)
+
+    def _record_cycle(self, t_wall: float, duration_s: float,
+                      err: Optional[BaseException]) -> None:
+        """Seal this cycle into the store's flight recorder."""
+        from .obs.recorder import CycleRecord
+
+        st = self.stats
+        flight = getattr(self.store, "flight", None)
+        if flight is None:
+            self.tracer.drain()
+            return
+        flight.record(CycleRecord(
+            session=self.uid, path="fast", t_wall=t_wall,
+            duration_s=duration_s, lanes=dict(self.lanes),
+            pods_considered=int(st["considered"]),
+            pods_bound=int(st["bound"]),
+            pods_dropped=int(st["dropped"]),
+            drop_reasons=dict(st["drop_reasons"]),
+            inflight_fetch_wait_ms=st["fetch_wait_ms"],
+            dispatched_solve_id=st["dispatched_solve_id"],
+            committed_solve_id=st["committed_solve_id"],
+            mutation_seq_at_dispatch=st["mut_at_dispatch"],
+            mutation_seq_at_commit=st["mut_at_commit"],
+            epoch_at_dispatch=st["epoch_at_dispatch"],
+            epoch_at_commit=st["epoch_at_commit"],
+            device_events=list(st["device_events"]),
+            error=type(err).__name__ if err is not None else None,
+            spans=self.tracer.drain(),
+        ))
+
+    def _count_drops(self, reasons: Dict[str, int]) -> None:
+        """Fold staleness-guard drop counts into the cycle stats and the
+        per-reason counter series."""
+        st = self.stats
+        dr = st["drop_reasons"]
+        for reason, n in reasons.items():
+            n = int(n)
+            if n <= 0:
+                continue
+            dr[reason] = dr.get(reason, 0) + n
+            metrics.pipeline_stale_drops.inc(n, reason=reason)
+            st["dropped"] = int(st["dropped"]) + n
 
     def _evict_machinery(self):
         self._flush_aggr()
@@ -1057,6 +1132,12 @@ class FastCycle:
             f"{scale:.3g}x",
         )
         metrics.device_crash_recoveries.inc()
+        stats = getattr(self, "stats", None)
+        if stats is not None:
+            stats["device_events"].append(
+                f"device crash ({type(e).__name__}); "
+                f"chunk budget degraded to {scale:.3g}x"
+            )
         import jax
         import jax.numpy as jnp
 
@@ -1078,6 +1159,7 @@ class FastCycle:
 
         lanes = self.lanes
         store = self.store
+        tracer = self.tracer
         retry = False
         rnd = 0
         crashes = 0
@@ -1086,14 +1168,18 @@ class FastCycle:
             if rnd >= max(rounds, 1) + crashes and not retry:
                 break
             rnd += 1
-            t_ord = time.perf_counter()
-            ordered = self._ordered_jobs()
-            prep = self._pending_rows(ordered)
-            lanes["order"] = (lanes.get("order", 0.0)
-                              + time.perf_counter() - t_ord)
+            with tracer.span("order", lanes=lanes):
+                ordered = self._ordered_jobs()
+                prep = self._pending_rows(ordered)
             if prep is None:
                 break
             solve_jobs, task_rows = prep
+            # Distinct rows entering solves this cycle: retry rounds
+            # re-derive a SUBSET of round 1's pending set (commits only
+            # shrink it), so the max over rounds is the distinct count —
+            # a per-round += would double-count retried rows.
+            self.stats["considered"] = max(
+                int(self.stats["considered"]), len(task_rows))
             progress_any = False
             never_any = False
             try:
@@ -1110,39 +1196,42 @@ class FastCycle:
                         and mesh is None and len(chunks) == 1):
                     cjobs, crows = chunks[0]
                     had_aff_chunks |= self._chunks_had_terms
-                    t_enc = time.perf_counter()
-                    inputs, pid, profiles = self._solve_inputs(
-                        cjobs, crows, slim=True)
-                    lanes["encode"] = (lanes.get("encode", 0.0)
-                                       + time.perf_counter() - t_enc)
-                    t0 = time.perf_counter()
-                    if remote is not None:
-                        payload = remote.solve_async(inputs, pid,
-                                                     profiles)
-                        kind = "remote"
-                    else:
-                        payload = solve_fn(*inputs, pid=pid,
-                                           profiles=profiles,
-                                           taint_any=self._taint_any)
-                        # Start the device->host transfer now; the
-                        # fetch at the next cycle's top only waits for
-                        # whatever is still in flight.
-                        try:
-                            payload.assigned.copy_to_host_async()
-                        except AttributeError:
-                            pass
-                        kind = "local"
-                    self._dispatch_async(cjobs, crows, kind, payload)
-                    lanes["device"] = (lanes.get("device", 0.0)
-                                       + time.perf_counter() - t0)
+                    with tracer.span("encode", lanes=lanes):
+                        inputs, pid, profiles = self._solve_inputs(
+                            cjobs, crows, slim=True)
+                    kind = "remote" if remote is not None else "local"
+                    # The dispatch span opens the solve-id flow; the
+                    # matching fetch/commit spans close it in cycle N+1.
+                    store._solve_seq += 1
+                    solve_id = store._solve_seq
+                    with tracer.span(
+                            "dispatch", cat="pipeline", flow=solve_id,
+                            lanes=lanes, lane="device",
+                            args={"kind": kind, "rows": len(crows),
+                                  "solve_id": solve_id}):
+                        if remote is not None:
+                            payload = remote.solve_async(inputs, pid,
+                                                         profiles)
+                        else:
+                            payload = solve_fn(*inputs, pid=pid,
+                                               profiles=profiles,
+                                               taint_any=self._taint_any)
+                            # Start the device->host transfer now; the
+                            # fetch at the next cycle's top only waits
+                            # for whatever is still in flight.
+                            try:
+                                payload.assigned.copy_to_host_async()
+                            except AttributeError:
+                                pass
+                        self._dispatch_async(cjobs, crows, kind, payload,
+                                             solve_id)
+                    self.stats["dispatched_solve_id"] = solve_id
                     break
                 for cjobs, crows in chunks:
                     had_aff_chunks |= self._chunks_had_terms
-                    t_enc = time.perf_counter()
-                    inputs, pid, profiles = self._solve_inputs(
-                        cjobs, crows, slim=(solver == "wave"))
-                    lanes["encode"] = (lanes.get("encode", 0.0)
-                                       + time.perf_counter() - t_enc)
+                    with tracer.span("encode", lanes=lanes):
+                        inputs, pid, profiles = self._solve_inputs(
+                            cjobs, crows, slim=(solver == "wave"))
                     t0 = time.perf_counter()
                     if solver == "wave" and remote is not None:
                         # Remote-solver split (BASELINE north-star
@@ -1194,13 +1283,16 @@ class FastCycle:
                     dt_dev = time.perf_counter() - t0
                     lanes["device"] = lanes.get("device", 0.0) + dt_dev
                     metrics.device_solve_latency.observe(dt_dev * 1e3)
-                    t_cm = time.perf_counter()
-                    progress = self._commit(
-                        cjobs, crows, assigned, never_ready, fit_failed,
-                        req_gather,
-                    )
-                    lanes["commit"] = (lanes.get("commit", 0.0)
-                                       + time.perf_counter() - t_cm)
+                    tracer.event("device_solve", "device",
+                                 time.perf_counter_ns()
+                                 - int(dt_dev * 1e9),
+                                 int(dt_dev * 1e9), tid="cycle",
+                                 args={"rows": len(crows)})
+                    with tracer.span("commit", lanes=lanes):
+                        progress = self._commit(
+                            cjobs, crows, assigned, never_ready,
+                            fit_failed, req_gather,
+                        )
                     progress_any |= progress
                     never_any |= bool(never_ready.any())
             except Exception as e:
@@ -1232,7 +1324,7 @@ class FastCycle:
     # ------------------------------------------------- pipelined sessions
 
     def _dispatch_async(self, cjobs: List[int], crows: np.ndarray,
-                        kind: str, payload) -> None:
+                        kind: str, payload, solve_id: int = 0) -> None:
         """Park a dispatched-but-unread device solve on the store; the
         device round trip then runs concurrently with this cycle's
         backfill/close/enqueue and the next cycle's derive, and
@@ -1240,7 +1332,8 @@ class FastCycle:
         double-buffered session of ISSUE 1).  ``payload`` is either a
         jax ``AllocResult`` with ``copy_to_host_async`` already issued
         (kind "local") or a ``solver_service.PendingSolve`` (kind
-        "remote")."""
+        "remote"); ``solve_id`` is the trace flow id linking this
+        dispatch to next cycle's fetch/commit spans."""
         from .pipeline import InflightSolve
 
         # Commit prep that needs no assignment overlaps the round trip.
@@ -1248,7 +1341,7 @@ class FastCycle:
         self.store._inflight_solve = InflightSolve(
             kind, payload, list(cjobs), crows, req_gather,
             self.m.mutation_seq, self.m.epoch, self.m.compact_gen,
-            self.Nn,
+            self.Nn, solve_id=solve_id,
         )
 
     def _commit_inflight(self) -> None:
@@ -1266,6 +1359,12 @@ class FastCycle:
             return
         m = self.m
         lanes = self.lanes
+        tracer = self.tracer
+        flow = inflight.solve_id or None
+        # committed_solve_id is set only once the fetch SUCCEEDS: a
+        # record showing a committed id with zero drops for a solve
+        # whose reply was lost would read as a clean commit — exactly
+        # the investigation the recorder exists for.
         if inflight.compact_gen != m.compact_gen:
             # Pod rows were renumbered while the solve was in flight;
             # the whole result is void (rows are otherwise stable for a
@@ -1274,11 +1373,21 @@ class FastCycle:
             log.info("in-flight solve predates a mirror compaction; "
                      "dropped (%d rows re-place this cycle)",
                      len(inflight.task_rows))
+            self._count_drops({"compaction": len(inflight.task_rows)})
+            self.stats["device_events"].append(
+                f"solve {inflight.solve_id} voided by mirror compaction"
+            )
             inflight.abandon()
             return
-        t0 = time.perf_counter()
+        fetch_span = tracer.span(
+            "inflight_fetch", cat="pipeline", flow=flow, lanes=lanes,
+            lane="device",
+            args={"rows": len(inflight.task_rows),
+                  "solve_id": inflight.solve_id},
+        )
         try:
-            assigned = inflight.fetch()
+            with fetch_span:
+                assigned = inflight.fetch()
         except Exception as e:
             if inflight.kind == "remote" and isinstance(
                     e, (OSError, ConnectionError, ValueError)):
@@ -1305,6 +1414,13 @@ class FastCycle:
                     "re-place this cycle",
                     len(inflight.task_rows), exc_info=True,
                 )
+                self._count_drops(
+                    {"lost-reply": len(inflight.task_rows)})
+                self.stats["device_events"].append(
+                    f"solve {inflight.solve_id} reply lost "
+                    f"({type(e).__name__}); fetch failure "
+                    f"{fails}/{self.REMOTE_FETCH_FAIL_CAP}"
+                )
                 return
             if self._is_device_crash(e):
                 # Execution-time crashes surface at the async fetch,
@@ -1318,42 +1434,57 @@ class FastCycle:
                     "rows re-place this cycle",
                     len(inflight.task_rows),
                 )
+                # The crash event itself lands via _on_device_crash.
+                self._count_drops(
+                    {"device-crash": len(inflight.task_rows)})
                 self._on_device_crash(e)
                 return
             # A programming error must propagate, exactly as it would
             # from a synchronous solve.
             raise
-        t_done = time.perf_counter()
         self.store._remote_fetch_fails = 0
-        lanes["device"] = lanes.get("device", 0.0) + (t_done - t0)
+        self.stats["committed_solve_id"] = inflight.solve_id or None
         # The residual wait is the pipeline's health signal: it
         # approaches zero exactly when the overlap works.  The
         # dispatch->available round trip is unobservable here (the
         # solve may have finished during the inter-cycle sleep), so
         # device_solve_latency keeps its synchronous-solve meaning and
         # gets nothing from this path.
-        metrics.inflight_fetch_wait.observe((t_done - t0) * 1e3)
-        t0 = time.perf_counter()
-        task_rows = inflight.task_rows
-        assigned = np.asarray(assigned[:len(task_rows)]).astype(
-            np.int64, copy=False)
-        req_gather = inflight.req_gather
-        if (m.mutation_seq != inflight.mutation_seq
-                or self.Nn != inflight.n_nodes):
-            assigned = self._revalidate_inflight(
-                task_rows, assigned,
-                node_churn=(m.epoch != inflight.epoch),
-            )
-            # Row set changed: let _commit re-gather the committed rows.
-            req_gather = None
-        if (assigned >= 0).any():
-            self._commit(
-                inflight.solve_jobs, task_rows, assigned,
-                np.zeros(len(inflight.solve_jobs), bool),
-                np.zeros(len(task_rows), bool), req_gather,
-            )
-        lanes["commit"] = (lanes.get("commit", 0.0)
-                           + time.perf_counter() - t0)
+        fetch_wait_ms = fetch_span.dur_ns / 1e6
+        metrics.inflight_fetch_wait.observe(fetch_wait_ms)
+        self.stats["fetch_wait_ms"] = round(fetch_wait_ms, 3)
+        # Dispatch-vs-commit delta of the solve LANDING this cycle (how
+        # much the world moved during its overlap); the solve this cycle
+        # dispatches is paired in the NEXT cycle's record.
+        self.stats["mut_at_dispatch"] = int(inflight.mutation_seq)
+        self.stats["epoch_at_dispatch"] = int(inflight.epoch)
+        self.stats["mut_at_commit"] = int(m.mutation_seq)
+        self.stats["epoch_at_commit"] = int(m.epoch)
+        with tracer.span(
+                "inflight_commit", cat="pipeline", flow=flow,
+                lanes=lanes, lane="commit",
+                args={"solve_id": inflight.solve_id,
+                      "dispatch_mutation_seq": inflight.mutation_seq,
+                      "dispatch_epoch": inflight.epoch}):
+            task_rows = inflight.task_rows
+            assigned = np.asarray(assigned[:len(task_rows)]).astype(
+                np.int64, copy=False)
+            req_gather = inflight.req_gather
+            if (m.mutation_seq != inflight.mutation_seq
+                    or self.Nn != inflight.n_nodes):
+                assigned = self._revalidate_inflight(
+                    task_rows, assigned,
+                    node_churn=(m.epoch != inflight.epoch),
+                )
+                # Row set changed: let _commit re-gather the committed
+                # rows.
+                req_gather = None
+            if (assigned >= 0).any():
+                self._commit(
+                    inflight.solve_jobs, task_rows, assigned,
+                    np.zeros(len(inflight.solve_jobs), bool),
+                    np.zeros(len(task_rows), bool), req_gather,
+                )
 
     def _revalidate_inflight(self, task_rows: np.ndarray,
                              assigned: np.ndarray,
@@ -1375,12 +1506,35 @@ class FastCycle:
         (a peer's placement may have moved the affinity landscape), and
         pods with a node selector, node-affinity terms, or tolerations
         when ``node_churn`` says the node table itself changed (labels/
-        taints the solve matched against are stale)."""
+        taints the solve matched against are stale).
+
+        Every dropped row is attributed to exactly ONE reason (first
+        matching check, in the order below), counted into the cycle's
+        flight record and the ``volcano_pipeline_stale_drop_rows_total``
+        series — the per-reason totals sum exactly to the rows dropped:
+
+        - ``deleted``              pod row no longer alive
+        - ``competing-bind``       alive but no longer Pending (bound /
+                                   evicted / resynced elsewhere)
+        - ``constraint-sensitive`` inter-pod terms + any mutation
+        - ``node-epoch-churn``     node-sensitive constraints under
+                                   epoch churn, or the target node row
+                                   gone / not ready
+        - ``capacity-taken``       surviving charge would oversubscribe
+                                   the node's allocatable or task slots
+        """
         m = self.m
         nn = self.Nn
-        ok = assigned >= 0
-        ok &= m.p_alive[task_rows] & (m.p_status[task_rows] == ST_PENDING)
-        ok &= ~m.p_has_ip[task_rows]
+        live = assigned >= 0
+        alive_m = m.p_alive[task_rows]
+        pending_m = alive_m & (m.p_status[task_rows] == ST_PENDING)
+        r_deleted = live & ~alive_m
+        r_competing = live & alive_m & ~pending_m
+        ok = live & pending_m
+        has_ip = m.p_has_ip[task_rows]
+        r_constraint = ok & has_ip
+        ok &= ~has_ip
+        r_churn = np.zeros(len(task_rows), bool)
         if node_churn:
             sensitive = (
                 m.p_has_tol[task_rows]
@@ -1389,41 +1543,56 @@ class FastCycle:
             er, _li = m.c_sel.gather(task_rows)
             has_sel = np.zeros(len(task_rows), bool)
             has_sel[er] = True
+            r_churn |= ok & (sensitive | has_sel)
             ok &= ~(sensitive | has_sel)
-        ok &= assigned < nn
+        # Target node gone (row beyond today's table) or not ready:
+        # the node table moved under the solve — churn.
+        node_gone = assigned >= nn
+        r_churn |= ok & node_gone
+        ok &= ~node_gone
         node = np.clip(assigned, 0, max(nn - 1, 0))
         if nn:
-            ok &= self.n_ready[node]
-        dropped_live = int(np.count_nonzero((assigned >= 0) & ~ok))
-        if not ok.any():
-            if dropped_live:
-                log.info("in-flight solve fully invalidated by "
-                         "concurrent mutations (%d rows)", dropped_live)
-            return np.where(ok, assigned, -1)
-        # Capacity re-check against TODAY's derive: the req gather is
-        # re-read (a pod update may have changed requests in place).
-        rows_ok = task_rows[ok]
-        nodes_ok = assigned[ok]
-        er, si, v = m.c_req.gather(rows_ok)
-        add = np.bincount(
-            nodes_ok[er].astype(np.int64) * self.R + si,
-            weights=v, minlength=nn * self.R,
-        ).reshape(nn, self.R).astype(F)
-        ntasks_add = np.bincount(nodes_ok, minlength=nn).astype(I)
-        bad = (
-            ((self.n_used + add) > self.n_alloc + self.eps[None, :])
-            .any(axis=1)
-            | ((self.n_ntasks + ntasks_add) > self.n_maxtasks)
-        )
-        if bad.any():
-            ok &= ~bad[node]
+            not_ready = ~self.n_ready[node]
+            r_churn |= ok & not_ready
+            ok &= ~not_ready
+        r_capacity = np.zeros(len(task_rows), bool)
+        if ok.any():
+            # Capacity re-check against TODAY's derive: the req gather
+            # is re-read (a pod update may have changed requests in
+            # place).
+            rows_ok = task_rows[ok]
+            nodes_ok = assigned[ok]
+            er, si, v = m.c_req.gather(rows_ok)
+            add = np.bincount(
+                nodes_ok[er].astype(np.int64) * self.R + si,
+                weights=v, minlength=nn * self.R,
+            ).reshape(nn, self.R).astype(F)
+            ntasks_add = np.bincount(nodes_ok, minlength=nn).astype(I)
+            bad = (
+                ((self.n_used + add) > self.n_alloc + self.eps[None, :])
+                .any(axis=1)
+                | ((self.n_ntasks + ntasks_add) > self.n_maxtasks)
+            )
+            if bad.any():
+                r_capacity = ok & bad[node]
+                ok &= ~bad[node]
+        self._count_drops({
+            "deleted": int(np.count_nonzero(r_deleted)),
+            "competing-bind": int(np.count_nonzero(r_competing)),
+            "constraint-sensitive": int(np.count_nonzero(r_constraint)),
+            "node-epoch-churn": int(np.count_nonzero(r_churn)),
+            "capacity-taken": int(np.count_nonzero(r_capacity)),
+        })
         out = np.where(ok, assigned, -1)
-        n_drop = int(np.count_nonzero((assigned >= 0) & (out < 0)))
-        if n_drop:
+        n_drop = int(np.count_nonzero(live & (out < 0)))
+        if n_drop and not ok.any():
+            log.info("in-flight solve fully invalidated by "
+                     "concurrent mutations (%d rows)", n_drop)
+        elif n_drop:
             log.info(
                 "staleness guard dropped %d/%d in-flight rows "
                 "(concurrent store mutations); survivors commit",
-                n_drop, int(np.count_nonzero(assigned >= 0)),
+                n_drop, int(np.count_nonzero(live)),
             )
         return out
 
@@ -2386,6 +2555,9 @@ class FastCycle:
 
         rows = task_rows[committed]
         nodes_c = assigned[committed]
+        stats = getattr(self, "stats", None)
+        if stats is not None:
+            stats["bound"] = int(stats["bound"]) + len(rows)
 
         # Divergence guard (vectorized analog of the replay's re-check):
         # charged capacity must not exceed allocatable.
@@ -2753,6 +2925,9 @@ class FastCycle:
                 if store._watchers:
                     store._notify("Pod", "bind", pod)
             store.mark_objects_stale()
+            stats = getattr(self, "stats", None)
+            if stats is not None:
+                stats["bound"] = int(stats["bound"]) + len(pairs)
         return bool(bound_rows)
 
     def _host_predicate(self, row: int, feat, ni: int) -> bool:

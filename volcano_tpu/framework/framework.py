@@ -69,7 +69,9 @@ def open_session(cache, tiers: Sequence[Tier],
                 plugin = builder(Arguments(opt.arguments))
                 ssn.plugins[opt.name] = plugin
     for name, plugin in ssn.plugins.items():
-        with metrics.plugin_timer(name, "OnSessionOpen"):
+        with metrics.plugin_timer(name, "OnSessionOpen"), \
+                ssn.tracer.span(f"plugin:{name}", cat="plugin",
+                                args={"phase": "OnSessionOpen"}):
             plugin.on_session_open(ssn)
 
     log.debug(
@@ -108,7 +110,9 @@ def _job_status(ssn: Session, job: JobInfo):
 
 def close_session(ssn: Session) -> None:
     for name, plugin in ssn.plugins.items():
-        with metrics.plugin_timer(name, "OnSessionClose"):
+        with metrics.plugin_timer(name, "OnSessionClose"), \
+                ssn.tracer.span(f"plugin:{name}", cat="plugin",
+                                args={"phase": "OnSessionClose"}):
             plugin.on_session_close(ssn)
 
     # jobUpdater.UpdateAll: push PodGroup statuses back to the store.

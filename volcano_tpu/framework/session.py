@@ -62,7 +62,16 @@ class Session:
         self.tiers: List[Tier] = list(tiers)
         self.configurations: List[Configuration] = list(configurations)
 
-        snapshot: ClusterInfo = cache.snapshot()
+        # Observability (obs/, ISSUE 3): the store's span tracer, so the
+        # object path's snapshot / action / plugin boundaries land in
+        # the same per-cycle trace the fast path records (a cache object
+        # without one — bare test doubles — gets the shared no-op).
+        from ..obs.trace import tracer_of
+
+        self.tracer = tracer_of(cache)
+        with self.tracer.span("snapshot", cat="object",
+                              args={"session": self.uid}):
+            snapshot: ClusterInfo = cache.snapshot()
         self.jobs: Dict[str, JobInfo] = snapshot.jobs
         self.nodes: Dict[str, NodeInfo] = snapshot.nodes
         self.queues: Dict[str, QueueInfo] = snapshot.queues

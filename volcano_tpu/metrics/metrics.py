@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
+from bisect import bisect_left
 from contextlib import contextmanager
 from typing import Dict, List, Tuple
 
@@ -19,6 +20,7 @@ _DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100,
     250, 500, 1000, 2500, 5000, 10000,
 )
+_N_BUCKETS = len(_DEFAULT_BUCKETS)
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -27,54 +29,88 @@ def _labels_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted(labels.items()))
 
 
+# Writers and the scrape synchronize on one registry lock (the series
+# of a Metrics instance all share it): unguarded dict inserts from a
+# cycle thread raced expose_text's iteration ("dictionary changed size
+# during iteration" on a scrape mid-cycle).  Series constructed outside
+# a registry (tests) get their own lock.
+
+
 class _Histogram:
-    def __init__(self, name: str, help_: str):
+    """Bounded histogram: per label set, fixed bucket counts + sum +
+    count — NOT the raw observation list (a long-running scheduler
+    observes forever; the list grew without bound)."""
+
+    def __init__(self, name: str, help_: str,
+                 lock: "threading.Lock" = None):
         self.name = name
         self.help = help_
-        self.data: Dict[LabelKey, List[float]] = {}
+        self._lock = lock or threading.Lock()
+        # LabelKey -> [per-bucket counts (+1 overflow slot), sum, count]
+        self.data: Dict[LabelKey, list] = {}
 
     def observe(self, value: float, **labels):
-        self.data.setdefault(_labels_key(labels), []).append(value)
+        key = _labels_key(labels)
+        with self._lock:
+            state = self.data.get(key)
+            if state is None:
+                state = self.data[key] = [[0] * (_N_BUCKETS + 1), 0.0, 0]
+            state[0][bisect_left(_DEFAULT_BUCKETS, value)] += 1
+            state[1] += value
+            state[2] += 1
 
 
 class _Gauge:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str,
+                 lock: "threading.Lock" = None):
         self.name = name
         self.help = help_
+        self._lock = lock or threading.Lock()
         self.data: Dict[LabelKey, float] = {}
 
     def set(self, value: float, **labels):
-        self.data[_labels_key(labels)] = value
+        key = _labels_key(labels)
+        with self._lock:
+            self.data[key] = value
 
     def set_many(self, pairs):
         """Bulk update from prebuilt (label-key-tuple, value) pairs — the
         per-job gauges (25k+ unschedulable jobs at scale) skip the
-        per-call kwargs/sort overhead."""
-        self.data.update(pairs)
+        per-call kwargs/sort overhead, and take the lock once."""
+        with self._lock:
+            self.data.update(pairs)
 
 
 class _Counter:
-    def __init__(self, name: str, help_: str):
+    def __init__(self, name: str, help_: str,
+                 lock: "threading.Lock" = None):
         self.name = name
         self.help = help_
+        self._lock = lock or threading.Lock()
         self.data: Dict[LabelKey, float] = {}
 
     def inc(self, value: float = 1.0, **labels):
         key = _labels_key(labels)
-        self.data[key] = self.data.get(key, 0.0) + value
+        with self._lock:
+            self.data[key] = self.data.get(key, 0.0) + value
 
     def inc_many(self, keys, value: float = 1.0):
-        """Bulk increment from prebuilt label-key tuples."""
-        data = self.data
-        get = data.get
-        for key in keys:
-            data[key] = get(key, 0.0) + value
+        """Bulk increment from prebuilt label-key tuples (one lock
+        acquisition for the batch)."""
+        with self._lock:
+            data = self.data
+            get = data.get
+            for key in keys:
+                data[key] = get(key, 0.0) + value
 
 
 class Metrics:
     """The volcano metric family (thread-safe)."""
 
     def __init__(self):
+        # Shared by every series of this registry AND by expose_text:
+        # one lock means a scrape sees a consistent point-in-time view
+        # and writers can never resize a dict mid-iteration.
         self._lock = threading.Lock()
         ns = "volcano"
         self.e2e_scheduling_latency = _Histogram(
@@ -184,6 +220,20 @@ class Metrics:
             f"{ns}_snapshot_transfer_bytes",
             "Bytes transferred host->device for the session snapshot",
         )
+        self.pipeline_stale_drops = _Counter(
+            f"{ns}_pipeline_stale_drop_rows_total",
+            "In-flight solve rows that did not commit, by reason: the "
+            "staleness guard's per-row drops (deleted, competing-bind, "
+            "capacity-taken, constraint-sensitive, node-epoch-churn) "
+            "plus whole-result voids (compaction, lost-reply, "
+            "device-crash)",
+        )
+        # Registry-wide lock sharing: rebind every series to THIS
+        # registry's lock (done before any concurrent use) so writers
+        # serialize with expose_text's iteration.
+        for attr in vars(self).values():
+            if isinstance(attr, (_Histogram, _Gauge, _Counter)):
+                attr._lock = self._lock
 
     # ------------------------------------------------------------- helpers
 
@@ -227,40 +277,53 @@ class Metrics:
     # ----------------------------------------------------------- exposition
 
     def expose_text(self) -> str:
-        """Prometheus text format 0.0.4."""
-        out: List[str] = []
+        """Prometheus text format 0.0.4.
+
+        Snapshot-then-format: only the cheap data copies happen under
+        the registry lock (the lock the hot-path writers share); the
+        string formatting of a large scrape — 25k+ per-job series at
+        config-4 scale — runs outside it, so a scrape never stalls the
+        scheduling cycle for the formatting's duration."""
+        snap: List[tuple] = []
         with self._lock:
             for attr in vars(self).values():
                 if isinstance(attr, _Gauge):
-                    out.append(f"# HELP {attr.name} {attr.help}")
-                    out.append(f"# TYPE {attr.name} gauge")
-                    for key, v in attr.data.items():
-                        lbl = ",".join(f'{k}="{val}"' for k, val in key)
-                        out.append(f"{attr.name}{{{lbl}}} {v}")
+                    snap.append(("gauge", attr.name, attr.help,
+                                 dict(attr.data)))
                 elif isinstance(attr, _Counter):
-                    out.append(f"# HELP {attr.name} {attr.help}")
-                    out.append(f"# TYPE {attr.name} counter")
-                    for key, v in attr.data.items():
-                        lbl = ",".join(f'{k}="{val}"' for k, val in key)
-                        out.append(f"{attr.name}{{{lbl}}} {v}")
+                    snap.append(("counter", attr.name, attr.help,
+                                 dict(attr.data)))
                 elif isinstance(attr, _Histogram):
-                    out.append(f"# HELP {attr.name} {attr.help}")
-                    out.append(f"# TYPE {attr.name} histogram")
-                    for key, values in attr.data.items():
-                        lbl_items = [f'{k}="{val}"' for k, val in key]
-                        for b in _DEFAULT_BUCKETS:
-                            cnt = sum(1 for v in values if v <= b)
-                            items = lbl_items + [f'le="{b}"']
-                            out.append(
-                                f"{attr.name}_bucket{{{','.join(items)}}} {cnt}"
-                            )
-                        items = lbl_items + ['le="+Inf"']
-                        out.append(
-                            f"{attr.name}_bucket{{{','.join(items)}}} {len(values)}"
-                        )
-                        lbl = ",".join(lbl_items)
-                        out.append(f"{attr.name}_sum{{{lbl}}} {sum(values)}")
-                        out.append(f"{attr.name}_count{{{lbl}}} {len(values)}")
+                    # Bucket-count lists mutate in place under observe;
+                    # copy them so the formatting below reads a
+                    # consistent point-in-time state.
+                    snap.append(("histogram", attr.name, attr.help, {
+                        key: (list(counts), total, n)
+                        for key, (counts, total, n) in attr.data.items()
+                    }))
+        out: List[str] = []
+        for kind, name, help_, data in snap:
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} {kind}")
+            if kind in ("gauge", "counter"):
+                for key, v in data.items():
+                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    out.append(f"{name}{{{lbl}}} {v}")
+                continue
+            for key, (counts, total, n) in data.items():
+                lbl_items = [f'{k}="{val}"' for k, val in key]
+                cnt = 0
+                for i, b in enumerate(_DEFAULT_BUCKETS):
+                    cnt += counts[i]
+                    items = lbl_items + [f'le="{b}"']
+                    out.append(
+                        f"{name}_bucket{{{','.join(items)}}} {cnt}"
+                    )
+                items = lbl_items + ['le="+Inf"']
+                out.append(f"{name}_bucket{{{','.join(items)}}} {n}")
+                lbl = ",".join(lbl_items)
+                out.append(f"{name}_sum{{{lbl}}} {total}")
+                out.append(f"{name}_count{{{lbl}}} {n}")
         return "\n".join(out) + "\n"
 
 

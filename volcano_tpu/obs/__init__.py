@@ -1,0 +1,38 @@
+"""Observability layer: trace spans, cycle flight recorder, Perfetto
+export (ISSUE 3).
+
+Three stdlib-only modules, importable without jax/numpy so the store and
+the HTTP service can wire them unconditionally:
+
+- ``trace``    — the low-overhead span API (``perf_counter_ns``; one
+  small record appended per span, nothing else on the fast path) the
+  cycle lanes, the pipelined dispatch→fetch→commit chain, the object
+  session's action/plugin boundaries, and the remote RPC clients all
+  record into.
+- ``recorder`` — the fixed-size ring buffer (default 256 cycles) of
+  per-cycle ``CycleRecord``s: lane breakdown, pods considered / bound /
+  dropped, staleness-guard drop counts by reason, in-flight fetch wait,
+  device crash events, mirror ``mutation_seq``/``epoch`` at dispatch vs
+  commit, and the cycle's spans.
+- ``export``   — Chrome/Perfetto ``trace_event`` JSON (loadable in
+  ``chrome://tracing`` / https://ui.perfetto.dev), with flow arrows
+  linking a pipelined solve's dispatch span in cycle N to its
+  fetch/commit spans in cycle N+1 via the solve-id.
+
+Consumers: ``service.py`` exposes ``/debug/cycles``,
+``/debug/cycles/<seq>`` and ``/debug/trace?cycles=K``; ``bench.py``
+writes one trace file per config and folds drop-reason totals plus
+per-lane p50/p95 into its machine-readable JSON tail.  docs/tracing.md
+documents all of it.
+"""
+
+from .recorder import CycleRecord, FlightRecorder
+from .trace import SpanRecord, Tracer, null_tracer
+
+__all__ = [
+    "CycleRecord",
+    "FlightRecorder",
+    "SpanRecord",
+    "Tracer",
+    "null_tracer",
+]

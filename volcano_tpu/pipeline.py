@@ -49,13 +49,13 @@ class InflightSolve:
 
     __slots__ = (
         "kind", "payload", "solve_jobs", "task_rows", "req_gather",
-        "mutation_seq", "epoch", "compact_gen", "n_nodes",
+        "mutation_seq", "epoch", "compact_gen", "n_nodes", "solve_id",
     )
 
     def __init__(self, kind: str, payload, solve_jobs: List[int],
                  task_rows: np.ndarray, req_gather: Tuple,
                  mutation_seq: int, epoch: int, compact_gen: int,
-                 n_nodes: int):
+                 n_nodes: int, solve_id: int = 0):
         self.kind = kind
         self.payload = payload
         self.solve_jobs = solve_jobs
@@ -67,6 +67,9 @@ class InflightSolve:
         self.epoch = epoch
         self.compact_gen = compact_gen
         self.n_nodes = n_nodes
+        # Flow id linking this dispatch's trace span (cycle N) to the
+        # fetch/commit spans (cycle N+1); 0 = untracked.
+        self.solve_id = solve_id
 
     # ----------------------------------------------------------- lifecycle
 

@@ -225,17 +225,57 @@ class Scheduler:
             )
             if apply_records is not None:
                 apply_records()
-            ssn = open_session(self.store, conf.tiers, conf.configurations)
-            try:
-                for name in action_names:
-                    action = get_action(name)
-                    if action is None:
-                        log.warning("Unknown action %s", name)
-                        continue
-                    with metrics.action_timer(name):
-                        action.execute(ssn)
-            finally:
-                close_session(ssn)
+            self._run_object_session(conf, action_names)
+
+    def _run_object_session(self, conf, action_names) -> None:
+        """One object-session cycle, traced + flight-recorded (the fast
+        path records its own cycles inside FastCycle.run)."""
+        import time as _time
+
+        from .obs.recorder import CycleRecord
+        from .obs.trace import tracer_of
+
+        tracer = tracer_of(self.store)
+        lanes = {}
+        t_wall = _time.time()
+        t0 = _time.perf_counter()
+        ssn = None
+        err = None
+        try:
+            with tracer.span("cycle", cat="object"):
+                with tracer.span("open", lanes=lanes):
+                    ssn = open_session(
+                        self.store, conf.tiers, conf.configurations
+                    )
+                try:
+                    for name in action_names:
+                        action = get_action(name)
+                        if action is None:
+                            log.warning("Unknown action %s", name)
+                            continue
+                        with metrics.action_timer(name), tracer.span(
+                                f"action:{name}", cat="action",
+                                lanes=lanes, lane=name):
+                            action.execute(ssn)
+                finally:
+                    with tracer.span("close", lanes=lanes):
+                        close_session(ssn)
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            flight = getattr(self.store, "flight", None)
+            if flight is not None:
+                flight.record(CycleRecord(
+                    session=getattr(ssn, "uid", ""), path="object",
+                    t_wall=t_wall,
+                    duration_s=_time.perf_counter() - t0,
+                    lanes=lanes,
+                    error=type(err).__name__ if err is not None else None,
+                    spans=tracer.drain(),
+                ))
+            else:
+                tracer.drain()
 
     @staticmethod
     def _fastpath_enabled() -> bool:

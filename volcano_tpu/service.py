@@ -177,7 +177,13 @@ class Service:
 
             client = RemoteSolver(remote_solver)
             client.ping()  # fail fast on a permanently wrong address
+            client.tracer = self.store.tracer
             self.store.remote_solver = client
+        # Side-effect RPC clients record into the store's cycle trace.
+        for client in (self.store.binder, self.store.evictor,
+                       self.store.status_updater):
+            if hasattr(client, "tracer"):
+                client.tracer = self.store.tracer
         if pipeline is not None:
             # Pipelined sessions (double-buffered cycles, ISSUE 1): the
             # device solve dispatches asynchronously and commits at the
@@ -330,6 +336,32 @@ class Service:
                             self._send(200, "ok", "text/plain")
                     elif url.path == "/metrics":
                         self._send(200, metrics.expose_text(), "text/plain")
+                    elif parts[:2] == ["debug", "cycles"] and len(parts) == 2:
+                        # Recent flight-recorder ring as JSON (newest
+                        # last); ?n=K limits the count.
+                        n_raw = parse_qs(url.query).get("n", [None])[0]
+                        n = int(n_raw) if n_raw is not None else None
+                        self._json(200, [
+                            rec.to_dict()
+                            for rec in service.store.flight.recent(n)
+                        ])
+                    elif parts[:2] == ["debug", "cycles"] and len(parts) == 3:
+                        rec = service.store.flight.get(int(parts[2]))
+                        if rec is None:
+                            self._json(404, {"error": "no such cycle"})
+                        else:
+                            self._json(200, rec.to_dict(include_spans=True))
+                    elif parts[:2] == ["debug", "trace"]:
+                        # Perfetto/chrome://tracing trace of the last K
+                        # cycles (?cycles=K, default the whole ring).
+                        from .obs import export as obs_export
+
+                        k_raw = parse_qs(url.query).get(
+                            "cycles", [None])[0]
+                        k = int(k_raw) if k_raw is not None else None
+                        self._json(200, obs_export.perfetto_trace(
+                            service.store.flight.recent(k)
+                        ))
                     elif parts[:2] == ["apis", "jobs"] and len(parts) == 2:
                         ns = parse_qs(url.query).get("namespace", [None])[0]
                         jobs = [

@@ -236,6 +236,12 @@ class RemoteSolver:
         self.bytes_out = 0
         self.bytes_in = 0
         self.last_solve_ms: Optional[float] = None
+        # Span sink (obs/trace.py Tracer; service.py wires the store's
+        # in, the default is the shared no-op): the pipelined send and
+        # fetch legs then land in the cycle trace as "rpc" track spans.
+        from .obs.trace import null_tracer
+
+        self.tracer = null_tracer()
 
     # holds: _lock
     def _connect(self) -> socket.socket:
@@ -332,7 +338,9 @@ class RemoteSolver:
         payload = self._encode_request(solve_args, pid, profiles, wave)
         self.requests += 1
         self.bytes_out += len(payload) + 8
-        return self._decode_result(self._roundtrip(payload))
+        with self.tracer.timed_event(
+                "rpc:solve", args={"bytes_out": len(payload) + 8}):
+            return self._decode_result(self._roundtrip(payload))
 
     def solve_async(self, solve_args: Sequence, pid, profiles,
                     wave: Optional[int] = None) -> "PendingSolve":
@@ -349,21 +357,23 @@ class RemoteSolver:
         caller's staleness machinery already treats a lost reply as "this
         cycle placed nothing" (the pods stay Pending and re-place)."""
         payload = self._encode_request(solve_args, pid, profiles, wave)
-        with self._lock:
-            if self._pending is not None:
-                raise RuntimeError(
-                    "a remote solve is already in flight; fetch or "
-                    "abandon it before dispatching another"
-                )
-            try:
-                sock = self._connect()
-                send_frame(sock, payload)
-            except (OSError, ConnectionError, ValueError):
-                self._close_locked()
-                sock = self._connect()
-                send_frame(sock, payload)
-            handle = PendingSolve(self)
-            self._pending = handle
+        with self.tracer.timed_event(
+                "rpc:solve_send", args={"bytes_out": len(payload) + 8}):
+            with self._lock:
+                if self._pending is not None:
+                    raise RuntimeError(
+                        "a remote solve is already in flight; fetch or "
+                        "abandon it before dispatching another"
+                    )
+                try:
+                    sock = self._connect()
+                    send_frame(sock, payload)
+                except (OSError, ConnectionError, ValueError):
+                    self._close_locked()
+                    sock = self._connect()
+                    send_frame(sock, payload)
+                handle = PendingSolve(self)
+                self._pending = handle
         self.requests += 1
         self.bytes_out += len(payload) + 8
         return handle
@@ -402,9 +412,10 @@ class PendingSolve:
     def fetch(self):
         """Receive + decode the reply; returns the AllocResult-shaped
         numpy namedtuple ``RemoteSolver.solve`` returns."""
-        return self._client._decode_result(
-            self._client._finish_async(self)
-        )
+        with self._client.tracer.timed_event("rpc:solve_fetch"):
+            return self._client._decode_result(
+                self._client._finish_async(self)
+            )
 
     def abandon(self) -> None:
         self._client._abandon_async(self)
