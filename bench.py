@@ -32,11 +32,16 @@ JSON lines carry the per-lane split in a "lanes" tail.
 
 Env knobs: BENCH_NODES/BENCH_PODS/BENCH_GANG/BENCH_REPEATS override config
 defaults; BENCH_PIPELINE=0 skips the pipelined pass, BENCH_PIPE_CYCLES
-sets the steady-state cycle count (min 5).  Every config additionally
-writes a Perfetto-loadable trace file (flight-recorder cycles,
-BENCH_TRACE_DIR; default /tmp/vtpu_bench_traces) and reports
-staleness-drop totals plus per-lane p50/p95 (steady-state cycles only)
-in the machine-readable JSON tail.
+sets the steady-state cycle count (min 5).  BENCH_TOPK A/Bs the
+two-phase device solve in one run: the selected config executes twice —
+"(shortlist on)" then "(shortlist off)" — emitting both JSON tails (a
+numeric BENCH_TOPK > 1 also pins VOLCANO_TPU_TOPK for the on-pass); the
+device_coarse/device_fine sub-lanes and the shortlist-fallback counts
+ride the lane/fallback tails.  Every config additionally writes a
+Perfetto-loadable trace file (flight-recorder cycles, BENCH_TRACE_DIR;
+default /tmp/vtpu_bench_traces) and reports staleness-drop totals plus
+per-lane p50/p95 (steady-state cycles only) in the machine-readable
+JSON tail.
 """
 
 import json
@@ -44,13 +49,40 @@ import os
 import re
 import sys
 import time
+from contextlib import contextmanager
 
 NORTH_STAR_MS = 100.0
 NORTH_STAR_PODS = 100000
 
+# BENCH_TOPK A/B driver state: suffix appended to every emitted metric
+# name, so one run carries both "(shortlist on)"/"(shortlist off)" JSON
+# tails (see main()).
+_MODE_SUFFIX = ""
+
+
+@contextmanager
+def _twophase_env(on: bool, topk: int = 0):
+    """Pin the two-phase knobs for one A/B pass (ops/wave.py reads them
+    per call, so flipping works within one process; each mode compiles
+    its own jit specialization)."""
+    keys = ("VOLCANO_TPU_TWOPHASE", "VOLCANO_TPU_TOPK")
+    old = {k: os.environ.get(k) for k in keys}
+    os.environ["VOLCANO_TPU_TWOPHASE"] = "1" if on else "0"
+    if on and topk > 1:
+        os.environ["VOLCANO_TPU_TOPK"] = str(topk)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
 
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
-          records=None):
+          records=None, fallbacks=None):
+    metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
     payload = {
@@ -61,6 +93,10 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
             budget_ms / value_ms if value_ms > 0 else 0.0, 4
         ),
     }
+    if fallbacks:
+        # Two-phase shortlist-fallback rescores over the measured
+        # cycles, by reason (docs/metrics.md).
+        payload["shortlist_fallbacks"] = dict(fallbacks)
     if lanes:
         # Lane split rides in the JSON tail so the driver's BENCH_rXX
         # artifacts carry the per-mode breakdown, not just the total.
@@ -207,6 +243,14 @@ def _pipelined_bench(make_store, conf, cycles=None):
     sched.run_once()  # warm-up: compile + first dispatch (no commit yet)
     sched.run_once()  # pipeline fill: first commit lands
     warm_s = time.perf_counter() - t0
+    # Steady-state seam reset: the re-pend feed keeps the backlog
+    # constant, but the two warm-up cycles already accumulated
+    # two-phase shortlist-fallback counts (cold jit, first fill) —
+    # reset the per-store accumulator here so the emitted fallback tail
+    # covers exactly the steady-state cycles and the shortlist-on/off
+    # pipelined rows stay comparable.  (The epoch-keyed class planes
+    # deliberately survive: the feed mutates pods, not nodes.)
+    store._shortlist_fb = {}
     times = []
     lane_acc = {}
     for _ in range(cycles):
@@ -222,15 +266,17 @@ def _pipelined_bench(make_store, conf, cycles=None):
     # Steady-state flight records only (the two warm-up cycles carry
     # compile + pipeline-fill time and would skew the percentiles).
     records = store.flight.recent()[-len(times):]
+    fallbacks = dict(getattr(store, "_shortlist_fb", {}) or {})
     store.close()
-    return amortized_ms, bound_per_cycle, warm_s, times, lanes, records
+    return (amortized_ms, bound_per_cycle, warm_s, times, lanes, records,
+            fallbacks)
 
 
 def _emit_pipelined(label, mk, conf, n_pods):
     if os.environ.get("BENCH_PIPELINE", "1") == "0":
         return
-    amortized_ms, bound, warm_s, times, lanes, records = _pipelined_bench(
-        mk, conf)
+    (amortized_ms, bound, warm_s, times, lanes, records,
+     fallbacks) = _pipelined_bench(mk, conf)
     _emit(
         f"{label} (pipelined steady-state, amortized {len(times)} cycles)",
         amortized_ms, n_pods,
@@ -240,6 +286,7 @@ def _emit_pipelined(label, mk, conf, n_pods):
         + _lane_note(lanes),
         lanes=lanes,
         records=records,
+        fallbacks=fallbacks,
     )
 
 
@@ -476,11 +523,7 @@ def config_north(repeats):
     )
 
 
-def main():
-    raw = os.environ.get("BENCH_CONFIG", "north")
-    # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
-    # between runs, and the minimum is the stable estimator.
-    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+def _run_selected(raw, repeats):
     if raw == "north":
         config_north(repeats)
         return
@@ -502,6 +545,33 @@ def main():
         config_5(repeats)
     else:
         raise SystemExit(f"unknown BENCH_CONFIG={config}")
+
+
+def main():
+    global _MODE_SUFFIX
+    raw = os.environ.get("BENCH_CONFIG", "north")
+    # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
+    # between runs, and the minimum is the stable estimator.
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    ab = os.environ.get("BENCH_TOPK")
+    if ab:
+        # A/B the two-phase solve in ONE run: the selected config runs
+        # twice — shortlist on (BENCH_TOPK > 1 also pins
+        # VOLCANO_TPU_TOPK to it; any other value keeps the adaptive
+        # default) then shortlist off — emitting both JSON tails with a
+        # mode suffix, so one BENCH_r*.json captures the lane-split
+        # delta the two-phase solve buys.
+        try:
+            topk = int(ab)
+        except ValueError:
+            topk = 0
+        for on in (True, False):
+            _MODE_SUFFIX = " (shortlist on)" if on else " (shortlist off)"
+            with _twophase_env(on, topk):
+                _run_selected(raw, repeats)
+        _MODE_SUFFIX = ""
+        return
+    _run_selected(raw, repeats)
 
 
 if __name__ == "__main__":

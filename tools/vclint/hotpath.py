@@ -36,7 +36,7 @@ DEVICE_FNS = {
     "solve_wave", "_solve_wave", "sharded_solve_wave",
     "sharded_solve_wave_cycle", "sharded_solve", "device_put",
     "_scatter_rows", "_scatter_cnt0", "_scatter_profile_tables",
-    "solve_fn", "solve_async",
+    "solve_fn", "solve_async", "_coarse_shortlist",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -80,17 +80,31 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         HotEntry("FastCycle._commit_inflight"),
         HotEntry("FastCycle._commit"),
         HotEntry("FastCycle._solve_inputs"),
+        # Two-phase sub-lane/fallback bookkeeping sits between the
+        # dispatch and the commit on every cycle.
+        HotEntry("FastCycle._record_twophase_lanes"),
+        HotEntry("FastCycle._count_shortlist_fb"),
     ],
     "volcano_tpu/ops/wave.py": [
         # The devsnap planes (allocatable/max_tasks/ready/label_bits/
-        # taint_bits) arrive device-resident from FastCycle._solve_inputs.
+        # taint_bits) and the two-phase class planes arrive
+        # device-resident from FastCycle._solve_inputs.
         HotEntry("solve_wave", device_params=(
             "nodes.allocatable", "nodes.max_tasks", "nodes.ready",
             "nodes.label_bits", "nodes.taint_bits",
+            "node_classes.class_id", "node_classes.label_bits",
+            "node_classes.taint_bits", "node_classes.ready",
         )),
     ],
     "volcano_tpu/ops/devsnap.py": [
         HotEntry("DeviceSnapshot.node_planes"),
+        HotEntry("DeviceSnapshot.class_tables"),
+    ],
+    "volcano_tpu/ops/nodeclass.py": [
+        # Host-only by contract (numpy planes in, numpy planes out);
+        # registered so an accidental device value reaching the class
+        # builder trips VCL201 instead of a silent per-cycle sync.
+        HotEntry("build_node_classes"),
     ],
     "volcano_tpu/parallel/mesh.py": [
         HotEntry("shard_wave_inputs"),

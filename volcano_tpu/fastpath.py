@@ -840,6 +840,57 @@ class FastCycle:
             metrics.pipeline_stale_drops.inc(n, reason=reason)
             st["dropped"] = int(st["dropped"]) + n
 
+    def _count_shortlist_fb(self, exhausted: int, affinity: int) -> None:
+        """Fold the two-phase solve's shortlist-fallback rescore counts
+        into the per-reason counter series, the cycle stats, and a
+        per-store accumulator bench.py resets between A/B passes."""
+        if exhausted <= 0 and affinity <= 0:
+            return
+        acc = getattr(self.store, "_shortlist_fb", None)
+        if acc is None:
+            acc = self.store._shortlist_fb = {}
+        if exhausted > 0:
+            metrics.solve_shortlist_fallback.inc(
+                exhausted, reason="exhausted")
+            acc["exhausted"] = acc.get("exhausted", 0) + exhausted
+        if affinity > 0:
+            metrics.solve_shortlist_fallback.inc(
+                affinity, reason="affinity-required")
+            acc["affinity-required"] = (
+                acc.get("affinity-required", 0) + affinity)
+        self.stats["shortlist_fallbacks"] = (
+            int(self.stats.get("shortlist_fallbacks", 0))
+            + exhausted + affinity)
+
+    def _record_twophase_lanes(self) -> None:
+        """Fold the wave solver's coarse/fine dispatch timings into the
+        cycle's lane split (device_coarse / device_fine sub-lanes of the
+        device lane) and the trace event stream — these are the
+        host-side dispatch legs; the residual device wait stays on the
+        fetch that consumes the result."""
+        from .ops import wave as _wave_mod
+
+        info = _wave_mod.LAST_TWOPHASE
+        if not info.get("enabled"):
+            return
+        lanes = self.lanes
+        coarse = float(info.get("coarse_s", 0.0))
+        fine = float(info.get("fine_s", 0.0))
+        lanes["device_coarse"] = lanes.get("device_coarse", 0.0) + coarse
+        lanes["device_fine"] = lanes.get("device_fine", 0.0) + fine
+        now = time.perf_counter_ns()
+        if coarse > 0:
+            self.tracer.event(
+                "device_coarse", "device",
+                now - int((coarse + fine) * 1e9), int(coarse * 1e9),
+                tid="cycle",
+            )
+        if fine > 0:
+            self.tracer.event(
+                "device_fine", "device", now - int(fine * 1e9),
+                int(fine * 1e9), tid="cycle",
+            )
+
     def _evict_machinery(self):
         self._flush_aggr()
         if self._evictor is None:
@@ -1197,7 +1248,7 @@ class FastCycle:
                     cjobs, crows = chunks[0]
                     had_aff_chunks |= self._chunks_had_terms
                     with tracer.span("encode", lanes=lanes):
-                        inputs, pid, profiles = self._solve_inputs(
+                        inputs, pid, profiles, ncls = self._solve_inputs(
                             cjobs, crows, slim=True)
                     kind = "remote" if remote is not None else "local"
                     # The dispatch span opens the solve-id flow; the
@@ -1210,12 +1261,17 @@ class FastCycle:
                             args={"kind": kind, "rows": len(crows),
                                   "solve_id": solve_id}):
                         if remote is not None:
+                            # The child process rebuilds node classes
+                            # from the numpy frame itself; class planes
+                            # do not cross the wire.
                             payload = remote.solve_async(inputs, pid,
                                                          profiles)
                         else:
                             payload = solve_fn(*inputs, pid=pid,
                                                profiles=profiles,
-                                               taint_any=self._taint_any)
+                                               taint_any=self._taint_any,
+                                               node_classes=ncls)
+                            self._record_twophase_lanes()
                             # Start the device->host transfer now; the
                             # fetch at the next cycle's top only waits
                             # for whatever is still in flight.
@@ -1230,14 +1286,15 @@ class FastCycle:
                 for cjobs, crows in chunks:
                     had_aff_chunks |= self._chunks_had_terms
                     with tracer.span("encode", lanes=lanes):
-                        inputs, pid, profiles = self._solve_inputs(
+                        inputs, pid, profiles, ncls = self._solve_inputs(
                             cjobs, crows, slim=(solver == "wave"))
                     t0 = time.perf_counter()
                     if solver == "wave" and remote is not None:
                         # Remote-solver split (BASELINE north-star
                         # bridge): inputs cross to the device-owning
                         # process as one C++-packed frame; assignment
-                        # vectors come back as numpy.
+                        # vectors come back as numpy.  The child
+                        # rebuilds node classes from the frame itself.
                         result = remote.solve(inputs, pid, profiles)
                     elif solver == "wave" and mesh is not None:
                         # Multi-chip dispatch: node axis + affinity
@@ -1252,11 +1309,15 @@ class FastCycle:
                             plane_cache=store._mesh_plane_cache,
                             epoch=self.m.epoch,
                             taint_any=self._taint_any,
+                            node_classes=ncls,
                         )
+                        self._record_twophase_lanes()
                     elif solver == "wave":
                         result = solve_fn(*inputs, pid=pid,
                                           profiles=profiles,
-                                          taint_any=self._taint_any)
+                                          taint_any=self._taint_any,
+                                          node_classes=ncls)
+                        self._record_twophase_lanes()
                     else:
                         result = solve_fn(*inputs)
                     # One batched device->host fetch: through a
@@ -1275,10 +1336,24 @@ class FastCycle:
                     # overlaps the device solve + transfer wait.
                     req_gather = self.m.c_req.gather(crows)
                     self._obj_arrays()
-                    assigned, never_ready, fit_failed = jax.device_get(
-                        (result.assigned, result.never_ready,
-                         result.fit_failed)
-                    )
+                    if solver == "wave":
+                        # The wave solver always carries the two-phase
+                        # fallback counters (zeros when disabled); ride
+                        # the same batched fetch.
+                        (assigned, never_ready, fit_failed, fb_ex,
+                         fb_aff) = jax.device_get(
+                            (result.assigned, result.never_ready,
+                             result.fit_failed, result.fb_exhausted,
+                             result.fb_affinity)
+                        )
+                        self._count_shortlist_fb(int(fb_ex), int(fb_aff))
+                    else:
+                        assigned, never_ready, fit_failed = (
+                            jax.device_get(
+                                (result.assigned, result.never_ready,
+                                 result.fit_failed)
+                            )
+                        )
                     assigned = assigned[:len(crows)]
                     dt_dev = time.perf_counter() - t0
                     lanes["device"] = lanes.get("device", 0.0) + dt_dev
@@ -1444,6 +1519,7 @@ class FastCycle:
             raise
         self.store._remote_fetch_fails = 0
         self.stats["committed_solve_id"] = inflight.solve_id or None
+        self._count_shortlist_fb(*inflight.fallbacks)
         # The residual wait is the pipeline's health signal: it
         # approaches zero exactly when the overlap works.  The
         # dispatch->available round trip is unobservable here (the
@@ -2134,8 +2210,39 @@ class FastCycle:
         # array back through the tunnel just to compute a static flag).
         self._taint_any = bool(n_taint_bits.any()) if slim else None
         snap = self._device_snapshot() if slim else None
+        # Node-class compaction (two-phase solve, ops/nodeclass.py):
+        # the class grouping is a pure function of the node table, so
+        # it rides the same epoch-keyed mirror cache as the bit planes;
+        # the wave solver gets the planes pre-built (it must never
+        # fetch device-resident node planes back just to group them).
+        node_classes = None
+        cls_id_host = None
+        cls_sig = ""
+        from .ops import wave as _wave_mod
+
+        use_classes = (
+            slim and N and _wave_mod._two_phase_on()
+            and _wave_mod._nodeclass_on()
+        )
+        if use_classes:
+            def _build_classes():
+                from .ops.nodeclass import build_node_classes
+
+                cl, n_real, sig = build_node_classes(
+                    n_label_bits, n_taint_bits, padN(self.n_ready),
+                    padN(self.n_alloc.astype(F)), padN(self.n_maxtasks),
+                )
+                return (cl.class_id, cl.label_bits, cl.taint_bits,
+                        cl.ready, np.array(sig), np.array(n_real))
+
+            (cls_id_host, cls_lb, cls_tb, cls_rd, sig_arr,
+             _n_real) = _epoch_cached(
+                m, "_node_class_cache", (m.epoch, Np, R, LW, TW),
+                _build_classes,
+            )
+            cls_sig = str(sig_arr)
         if snap is not None and N:
-            planes = snap.node_planes(m, (m.epoch, Np, R, LW, TW), {
+            build = {
                 # rows=None -> full padded plane; rows array -> just
                 # those rows (devsnap's delta scatter, so a one-node
                 # change never materializes full [Np, *] host copies).
@@ -2154,18 +2261,57 @@ class FastCycle:
                 "taint_bits": lambda rows: (
                     n_taint_bits if rows is None
                     else n_taint_bits[rows]),
-            })
+            }
+            if use_classes:
+                # class_id is [Np] row-indexed, so it shares the node
+                # planes' dirty-row delta machinery — valid exactly
+                # while the class SET (tables_sig) held still, because
+                # classes order by sorted signature (ops/nodeclass.py).
+                # A changed set returns None for the delta rows, which
+                # devsnap answers with a full upload of THIS plane only
+                # ([Np] int32 — tiny); label/taint/capacity planes keep
+                # their row scatters.
+                prev_sig = getattr(snap, "_last_cls_sig", None)
+                build["class_id"] = lambda rows: (
+                    cls_id_host if rows is None
+                    else (cls_id_host[rows] if prev_sig == cls_sig
+                          else None))
+            planes = snap.node_planes(m, (m.epoch, Np, R, LW, TW), build)
+            if use_classes:
+                snap._last_cls_sig = cls_sig
             alloc_in = planes["allocatable"]
             maxt_in = planes["max_tasks"]
             ready_in = planes["ready"]
             lbits_in = planes["label_bits"]
             tbits_in = planes["taint_bits"]
+            if use_classes:
+                from .ops.nodeclass import NodeClasses
+
+                tables = snap.class_tables(
+                    (cls_sig, cls_lb.shape, cls_tb.shape), {
+                        "label_bits": lambda: cls_lb,
+                        "taint_bits": lambda: cls_tb,
+                        "ready": lambda: cls_rd,
+                    })
+                node_classes = NodeClasses(
+                    class_id=planes["class_id"],
+                    label_bits=tables["label_bits"],
+                    taint_bits=tables["taint_bits"],
+                    ready=tables["ready"],
+                )
         else:
             alloc_in = padN(self.n_alloc.astype(F))
             maxt_in = padN(self.n_maxtasks)
             ready_in = padN(self.n_ready)
             lbits_in = n_label_bits
             tbits_in = n_taint_bits
+            if use_classes:
+                from .ops.nodeclass import NodeClasses
+
+                node_classes = NodeClasses(
+                    class_id=cls_id_host, label_bits=cls_lb,
+                    taint_bits=cls_tb, ready=cls_rd,
+                )
         nodes = SolveNodes(
             idle=padN(self.n_idle.astype(F)),
             allocatable=alloc_in,
@@ -2256,6 +2402,7 @@ class FastCycle:
              self.scalar_slot, aff),
             pid,
             profiles,
+            node_classes,
         )
 
     def _affinity_and_profiles(self, task_rows: np.ndarray, tasks,

@@ -220,6 +220,14 @@ class Metrics:
             f"{ns}_snapshot_transfer_bytes",
             "Bytes transferred host->device for the session snapshot",
         )
+        self.solve_shortlist_fallback = _Counter(
+            f"{ns}_solve_shortlist_fallback_total",
+            "Two-phase solve full-N rescores after a profile's "
+            "candidate shortlist ran dry, by reason: exhausted (every "
+            "candidate claimed by earlier waves) or affinity-required "
+            "(required inter-pod terms drifted from the solve-start "
+            "counts the shortlist was built on)",
+        )
         self.pipeline_stale_drops = _Counter(
             f"{ns}_pipeline_stale_drop_rows_total",
             "In-flight solve rows that did not commit, by reason: the "

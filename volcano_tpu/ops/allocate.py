@@ -134,6 +134,13 @@ class AllocResult(NamedTuple):
     idle: jnp.ndarray  # [N, R] final idle
     q_alloc: jnp.ndarray  # [Q, R] final queue allocated (incl. pipelines)
     iters: jnp.ndarray = None  # [] total attempt iterations (diagnostics)
+    # Two-phase wave solve only (ops/wave.py): shortlist-fallback
+    # rescore counts by reason — profiles whose candidate shortlist ran
+    # dry (exhausted) vs required-(anti)affinity profiles whose live
+    # domain landscape drifted from the solve-start counts the
+    # shortlist was built on.  None from the sequential solver.
+    fb_exhausted: jnp.ndarray = None  # [] int32
+    fb_affinity: jnp.ndarray = None  # [] int32
 
 
 def _subset(bits_row, table):

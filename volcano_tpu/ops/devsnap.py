@@ -73,10 +73,15 @@ class DeviceSnapshot:
         # name -> device array, all planes sharing self._key.
         self._planes: Dict[str, object] = {}
         self._key: Optional[Tuple] = None
+        # Two-phase class tables ([C, *], tiny), content-addressed.
+        self._cls_planes: Dict[str, object] = {}
+        self._cls_key: Optional[Tuple] = None
         # Telemetry for tests/bench: full vs delta vs hit counts.
         self.full_uploads = 0
         self.delta_uploads = 0
         self.hits = 0
+        self.class_uploads = 0
+        self.class_hits = 0
 
     # ------------------------------------------------------------- planes
 
@@ -119,9 +124,19 @@ class DeviceSnapshot:
             return self._planes
         if delta_rows is not None:
             for name, fn in build.items():
-                rows, vals = _pad_delta(
-                    delta_rows, np.asarray(fn(delta_rows))
-                )
+                dvals = fn(delta_rows)
+                if dvals is None:
+                    # Plane-level delta unprovable — a build fn returns
+                    # None when its rows cannot be patched in place
+                    # (class ids after the class SET changed: unrelated
+                    # rows' ids shift under the sorted-signature
+                    # ordering).  Re-upload just this plane; the others
+                    # keep the scatter path.
+                    self._planes[name] = jax.device_put(
+                        np.asarray(fn(None))
+                    )
+                    continue
+                rows, vals = _pad_delta(delta_rows, np.asarray(dvals))
                 self._planes[name] = _scatter_rows(
                     self._planes[name], rows, vals
                 )
@@ -137,6 +152,32 @@ class DeviceSnapshot:
         self._key = key
         self.full_uploads += 1
         return self._planes
+
+    def class_tables(self, key: Tuple,
+                     build: Dict[str, Callable[[], np.ndarray]]):
+        """Device-resident node-class tables for the two-phase solve
+        ([C, *] rows — tiny next to the node planes).
+
+        ``key`` is content-addressed (the nodeclass tables_sig digest +
+        shape components), so epoch churn that leaves the class SET
+        intact re-uploads nothing; a changed signature set re-uploads
+        the tables wholesale.  The [N] ``class_id`` plane is NOT here:
+        it rides ``node_planes``' dirty-row delta machinery, whose
+        build fn answers None (-> single-plane full upload) whenever
+        the signature set moved — the condition under which per-row
+        class_id deltas would be unsound (see ops/nodeclass.py on the
+        sorted-signature class ordering)."""
+        if self._cls_key == key:
+            self.class_hits += 1
+            return self._cls_planes
+        self._cls_planes = {
+            name: jax.device_put(np.asarray(fn()))
+            for name, fn in build.items()
+        }
+        self._cls_key = key
+        self.class_uploads += 1
+        return self._cls_planes
+
 
 def for_store(store) -> DeviceSnapshot:
     """The store's snapshot, created on first use."""

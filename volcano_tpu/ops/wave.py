@@ -81,10 +81,12 @@ from .allocate import (
     SolveQueues,
     SolveTasks,
 )
+from .nodeclass import NodeClasses
 from .resreq import less_equal
 from .scoring import ScoreWeights, node_score
 
 import os as _os
+import time as _time
 
 
 def _env_int(name: str, default: int) -> int:
@@ -130,6 +132,63 @@ AFF_ACACHE = _env_int("VOLCANO_TPU_AFF_ACACHE", 1)
 # exactly).  Above it (hyperscale D ~ 50k) the gather path remains.
 DOM_MM_MAX_MB = _env_int("VOLCANO_TPU_DOM_MM_MB", 1024)
 
+# ---- two-phase device solve (node-class compaction + shortlists) -----
+# Phase 1 (coarse) collapses the node table into node classes and
+# evaluates the static predicate planes once per (profile x class) in
+# bf16, then ranks every node ONCE per solve on the initial state and
+# keeps each profile's top-S candidates as a shortlist.  Phase 2 (fine)
+# runs the attempt/sub-round wave machinery on the [UM, S] shortlist
+# planes instead of [UM, N]; a profile whose shortlist has no live
+# feasible candidate falls back to a full-N rescore for that attempt
+# (counted per reason), so binding is never lost to pruning — the
+# TPU-native analog of the reference's percentageOfNodesToFind sampling
+# (scheduler_helper.go:37-62).  Knobs are read per call so bench.py can
+# A/B both modes inside one process.
+def _two_phase_on() -> bool:
+    return _os.environ.get("VOLCANO_TPU_TWOPHASE", "1") != "0"
+
+
+def _nodeclass_on() -> bool:
+    return _os.environ.get("VOLCANO_TPU_NODECLASS", "1") != "0"
+
+
+def _fallback_cap() -> int:
+    """Max shortlist-fallback rescores per solve (0 = unlimited)."""
+    try:
+        return max(0, int(_os.environ.get("VOLCANO_TPU_FB_CAP", 0)))
+    except ValueError:
+        return 0
+
+
+def shortlist_size(n: int) -> int:
+    """Phase-2 shortlist length per profile.  VOLCANO_TPU_TOPK pins it
+    explicitly; the default mirrors the reference's adaptive
+    percentageOfNodesToFind (50 - N/125 percent, floor 5%, at least 100
+    nodes — scheduler_helper.go:37-62) and never drops below the walk
+    ranking depth TOPK, so attempt-1 rankings keep their full prefix."""
+    raw = _os.environ.get("VOLCANO_TPU_TOPK")
+    if raw:
+        try:
+            return max(1, min(n, int(raw)))
+        except ValueError:
+            pass
+    pct = max(5, 50 - n // 125)
+    return min(n, max(100, TOPK, n * pct // 100))
+
+
+# Coarse phase profile-chunk size: bounds the [chunk, N, R] fit
+# broadcast (the only [*, N, R] tensor of the coarse pass) so hyperscale
+# profile counts stream through lax.map instead of materializing
+# [U, N, R] at once.
+COARSE_CHUNK = _env_int("VOLCANO_TPU_COARSE_CHUNK", 256)
+
+# Telemetry of the most recent two-phase solve on this host (the cycle
+# driver folds it into the device_coarse/device_fine sub-lanes and the
+# flight recorder; tests read the shortlist shape).  Keys: enabled,
+# coarse_s, fine_s, shortlist ((U, S) or None), n_nodes,
+# compacted_classes (bool: real class planes vs per-node identity).
+LAST_TWOPHASE: dict = {"enabled": False}
+
 
 class SolveProfiles(NamedTuple):
     """Distinct task profiles ([U] rows): every per-task input that shapes
@@ -171,6 +230,9 @@ class GState(NamedTuple):
     assigned: jnp.ndarray  # [P] int32
     pipelined: jnp.ndarray  # [P] int32
     iters: jnp.ndarray  # [] int32 total attempt iterations
+    fb_exhausted: jnp.ndarray  # [] int32 shortlist-fallback rescores
+    fb_affinity: jnp.ndarray  # [] int32 ... for required-affinity profiles
+    fb_rounds: jnp.ndarray  # [] int32 fallback rescore ROUNDS (cap unit)
 
 
 def _unpack_bits(words):
@@ -190,8 +252,183 @@ def _subset_mm(rows_bits, table_missing_f):
     return viol == 0
 
 
+def _subset_mm_bf(rows_bits, table_missing_bf):
+    """bf16 variant of ``_subset_mm`` for the coarse class planes: the
+    products are 0/1 and the verdict reads ==0 vs >=1 — a bf16-rounded
+    sum of non-negative integers can never land in (0, 0.5), so the
+    classification is exact (the _aff_parts indicator argument) at ~4x
+    the MXU rate."""
+    viol = jnp.matmul(
+        rows_bits.astype(jnp.bfloat16), table_missing_bf.T
+    )
+    return viol < 0.5
+
+
+def _class_static(cls: NodeClasses, sel_bits, aff_bits, aff_terms,
+                  tol_bits, pref_bits, pref_w, naff_weight,
+                  has_taints: bool):
+    """Phase-1 coarse planes: static (label/taint/ready) feasibility and
+    preferred-affinity score once per (profile-row x node CLASS).
+
+    Inputs are packed word rows for ``Ub`` profiles; result is
+    ``(ok [Ub, C] bool, score [Ub, C] f32)``.  Class members share the
+    static node planes byte-for-byte (nodeclass.build_node_classes), so
+    expanding through ``class_id`` reproduces the node-level masks
+    exactly; the bf16 indicator matmuls are exact for the ==0 / >=1
+    classification and the score sums the exact booleans in f32, so the
+    expanded score matches the node-level computation bit-for-bit."""
+    bf = jnp.bfloat16
+    f32 = jnp.float32
+    Ub = sel_bits.shape[0]
+    A = aff_bits.shape[1]
+    AP = pref_bits.shape[1]
+    C = cls.ready.shape[0]
+    missing_bf = (~_unpack_bits(cls.label_bits)).astype(bf)  # [C, B]
+    ok = cls.ready[None, :] & _subset_mm_bf(
+        _unpack_bits(sel_bits), missing_bf
+    )
+    term_ok = _subset_mm_bf(
+        _unpack_bits(aff_bits).reshape(Ub * A, -1), missing_bf
+    ).reshape(Ub, A, C)
+    term_real = jnp.arange(A)[None, :] < aff_terms[:, None]  # [Ub, A]
+    ok &= (
+        jnp.any(term_ok & term_real[:, :, None], axis=1)
+        | (aff_terms == 0)[:, None]
+    )
+    if has_taints:
+        untol = jnp.matmul(
+            _unpack_bits(cls.taint_bits).astype(bf),
+            (~_unpack_bits(tol_bits)).astype(bf).T,
+        )  # [C, Ub]
+        ok &= untol.T < 0.5
+    pref_match = _subset_mm_bf(
+        _unpack_bits(pref_bits).reshape(Ub * AP, -1), missing_bf
+    ).reshape(Ub, AP, C)
+    score = naff_weight * jnp.sum(
+        pref_match.astype(f32) * pref_w[:, :, None], axis=1
+    )
+    return ok, score
+
+
+@partial(jax.jit, static_argnames=("sl_k", "chunk", "features",
+                                   "cnt0_any", "cls_identity"))
+def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
+                      score_prof, cls: NodeClasses, aff: AffinityArgs,
+                      weights: ScoreWeights, eps, scalar_slot,
+                      sl_k: int, chunk: int, features: tuple,
+                      cnt0_any: bool, cls_identity: bool):
+    """Phase 1 + shortlist selection of the two-phase solve.
+
+    Evaluates the wave-0-attempt-1 live mask + score for every profile
+    row over all N nodes ONCE (class-compacted statics, initial dynamic
+    state) and keeps each profile's top-``sl_k`` candidates, returned as
+    ``[U, sl_k]`` int32 node ids sorted ASCENDING — in-shortlist
+    rankings then break score ties by node index exactly like the full
+    path's top_k.  The masks are evaluated at solve-start state, which
+    within a solve only loses capacity/ports/pod slots and only gains
+    affinity counts — so a node pruned here stays infeasible for every
+    non-required-affinity feature, and required-affinity drift is what
+    the fine phase's fallback rescore exists for.
+
+    When ``cnt0_any`` is False the inter-pod planes are skipped: with
+    all-zero counts both the required/anti verdicts and the soft score
+    are uniform per profile, and per-profile-uniform components cannot
+    change top-k membership (a uniformly infeasible profile exhausts its
+    shortlist on attempt 1 and resolves through the fallback rescore,
+    reaching the identical no-node outcome).
+
+    Profiles stream through ``lax.map`` in ``chunk`` rows so the
+    [chunk, N, R] fit broadcast — the pass's only [*, N, R] tensor —
+    bounds device memory at hyperscale profile counts.
+    """
+    (has_ports, has_aff, has_taints, has_future, _has_overuse,
+     has_extra, has_extra_score) = features
+    f32 = jnp.float32
+    bf = jnp.bfloat16
+    N = nodes.idle.shape[0]
+    U = prof.req.shape[0]
+    if cls_identity:
+        cls = NodeClasses(
+            class_id=jnp.arange(N, dtype=jnp.int32),
+            label_bits=nodes.label_bits,
+            taint_bits=nodes.taint_bits,
+            ready=nodes.ready,
+        )
+    # Initial dynamic node state, shared by every chunk.
+    if has_future:
+        fi0 = nodes.idle + nodes.releasing - nodes.pipelined
+    else:
+        fi0 = nodes.idle
+    pods_ok0 = (nodes.max_tasks <= 0) | (nodes.ntasks < nodes.max_tasks)
+    if has_ports:
+        nport_bf = _unpack_bits(nodes.ports).astype(bf)  # [N, B]
+    if has_aff and cnt0_any:
+        E = aff.cnt0.shape[0]
+        nd_e = jnp.take(aff.node_dom, aff.term_key, axis=1)  # [N, E]
+        cv0 = aff.cnt0[jnp.arange(E)[None, :], jnp.maximum(nd_e, 0)]
+        cv0 = jnp.where(nd_e >= 0, cv0, 0)  # [N, E]
+        total0 = jnp.sum(aff.cnt0, axis=-1)  # [E]
+        cv0_zero_bf = (cv0 == 0).astype(bf)
+        cv0_pos_bf = (cv0 > 0).astype(bf)
+        cv0_f = cv0.astype(f32)
+
+    def body(rowset):
+        (req, init_req, ports, sel_bits, aff_bits, aff_terms, tol_bits,
+         pref_bits, pref_w, t_req_aff, t_req_anti, t_matches, t_soft,
+         e_ok, e_score) = rowset
+        ok_c, score_c = _class_static(
+            cls, sel_bits, aff_bits, aff_terms, tol_bits, pref_bits,
+            pref_w, weights.node_affinity_weight, has_taints,
+        )
+        feas = ok_c[:, cls.class_id]  # [u, N] expand
+        static_score = score_c[:, cls.class_id]
+        if has_extra:
+            feas &= e_ok
+        if has_extra_score:
+            static_score = static_score + e_score
+        fit = less_equal(
+            init_req[:, None, :], fi0[None, :, :], eps, scalar_slot
+        )
+        feas &= fit & pods_ok0[None, :]
+        if has_ports:
+            p_bits = _unpack_bits(ports)
+            clash = jnp.matmul(p_bits.astype(bf), nport_bf.T)
+            feas &= ~jnp.any(p_bits, axis=-1)[:, None] | (clash < 0.5)
+        score = jax.vmap(node_score, in_axes=(0, None, None, None))(
+            req, nodes.allocatable, nodes.idle, weights
+        ) + static_score
+        if has_aff and cnt0_any:
+            selfok = (total0 == 0)[None, :] & t_matches  # [u, E]
+            need = (t_req_aff & ~selfok).astype(bf)
+            aff_viol = jnp.matmul(need, cv0_zero_bf.T)
+            anti_viol = jnp.matmul(t_req_anti.astype(bf), cv0_pos_bf.T)
+            feas &= (aff_viol < 0.5) & (anti_viol < 0.5)
+            score = score + jnp.matmul(t_soft, cv0_f.T)
+        masked = jnp.where(feas, score, NEG)
+        _scores, idx = jax.lax.top_k(masked, sl_k)
+        return jnp.sort(idx, axis=1).astype(jnp.int32)
+
+    ones_u = jnp.ones((U, 1), bool)
+    zeros_u = jnp.zeros((U, 1), f32)
+    cols = (
+        prof.req, prof.init_req, prof.ports, prof.sel_bits,
+        prof.aff_bits, prof.aff_terms, prof.tol_bits, prof.pref_bits,
+        prof.pref_w, prof.t_req_aff, prof.t_req_anti, prof.t_matches,
+        prof.t_soft,
+        extra_prof if has_extra else ones_u,
+        score_prof if has_extra_score else zeros_u,
+    )
+    if chunk >= U:
+        return body(cols)
+    resh = tuple(
+        a.reshape(U // chunk, chunk, *a.shape[1:]) for a in cols
+    )
+    return jax.lax.map(body, resh).reshape(U, sl_k)
+
+
 @partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features",
-                                   "terms_disjoint"))
+                                   "terms_disjoint", "two_phase",
+                                   "cls_identity", "fb_cap"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -207,11 +444,16 @@ def _solve_wave(
     pid: jnp.ndarray,  # [P] int32 global profile id per task
     wave_prof: jnp.ndarray,  # [NW, U_MAX] int32 profile ids present per wave
     wave_terms: jnp.ndarray,  # [NW, EW] int32 term ids per wave (pad=dummy)
+    cls: NodeClasses,  # class planes ([1]-dummies unless compacted)
+    shortlists: jnp.ndarray,  # [U, S] int32 ([1, 1] unless two_phase)
     wave: int,
     n_waves: int,
     ew: int,
     features: tuple = (True, True, True, True, True, False, False),
     terms_disjoint: bool = False,
+    two_phase: bool = False,
+    cls_identity: bool = False,
+    fb_cap: int = 0,
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
@@ -239,7 +481,8 @@ def _solve_wave(
     NW = n_waves
     UM = wave_prof.shape[1]
     EW = ew
-    K = min(TOPK, N)
+    S = shortlists.shape[1] if two_phase else N
+    K = min(TOPK, S)
     JP = J + W  # job axis padded so any wave's window slice stays in range
     f32 = jnp.float32
     BIG = jnp.float32(1.0e9)
@@ -257,10 +500,24 @@ def _solve_wave(
     #    bit-identical, so the loop exits; the unresolved tasks stay
     #    Pending for the cycle (see attempt_cond).
 
-    # Unpacked-bit tables (f32 complements feed the matmul subset checks).
-    label_missing_f = (~_unpack_bits(nodes.label_bits)).astype(f32)
-    node_taint_bits_f = _unpack_bits(nodes.taint_bits).astype(f32)
     node_ready = nodes.ready
+    if two_phase:
+        if cls_identity:
+            # No compacted classes supplied (knob off, or device-resident
+            # nodes without caller-built planes): every node is its own
+            # class — the shortlist machinery still applies, the static
+            # matmuls just stay at node granularity.
+            cls = NodeClasses(
+                class_id=jnp.arange(N, dtype=jnp.int32),
+                label_bits=nodes.label_bits,
+                taint_bits=nodes.taint_bits,
+                ready=node_ready,
+            )
+    else:
+        # Unpacked-bit tables (f32 complements feed the matmul subset
+        # checks) — the two-phase path evaluates these per CLASS instead.
+        label_missing_f = (~_unpack_bits(nodes.label_bits)).astype(f32)
+        node_taint_bits_f = _unpack_bits(nodes.taint_bits).astype(f32)
 
     # Padded-row job sentinel J keeps wave windows ([jlo, jlo+W)) in the
     # padded job range without branching.
@@ -299,6 +556,9 @@ def _solve_wave(
         assigned=jnp.full((P,), -1, jnp.int32),
         pipelined=jnp.full((P,), -1, jnp.int32),
         iters=jnp.int32(0),
+        fb_exhausted=jnp.int32(0),
+        fb_affinity=jnp.int32(0),
+        fb_rounds=jnp.int32(0),
     )
 
     tril = jnp.tril(jnp.ones((W, W), bool), k=-1)  # strictly-earlier mask
@@ -401,42 +661,73 @@ def _solve_wave(
 
 
         # ---- static predicate masks, hoisted out of the attempt loop ----
-        p_ok = node_ready[None, :] & _subset_mm(
-            _unpack_bits(prof.sel_bits[pids]), label_missing_f
-        )
-        if has_extra:
-            # Custom-plugin verdicts, per profile (tasks sharing a
-            # profile share a mask row by construction).
-            p_ok &= extra_prof[pids]
-        aff_bits_p = _unpack_bits(prof.aff_bits[pids])  # [UM, A, B]
-        term_ok = _subset_mm(
-            aff_bits_p.reshape(UM * A, -1), label_missing_f
-        ).reshape(UM, A, N)
-        n_terms = prof.aff_terms[pids]
-        term_real = jnp.arange(A)[None, :] < n_terms[:, None]  # [UM, A]
-        p_ok &= (
-            jnp.any(term_ok & term_real[:, :, None], axis=1)
-            | (n_terms == 0)[:, None]
-        )
-        if has_taints:
-            # Taints: any node taint bit not tolerated kills the pair.
-            untol = jnp.matmul(
-                node_taint_bits_f,
-                (~_unpack_bits(prof.tol_bits[pids])).astype(f32).T,
-            )  # [N, UM]
-            p_ok &= untol.T == 0
+        if two_phase:
+            # Phase-1 coarse: one bf16 evaluation per (profile x CLASS),
+            # expanded to nodes through the class_id gather.  Class
+            # members share the static planes byte-for-byte, so the
+            # expanded masks/scores equal the node-level computation
+            # exactly; the [UM, B] x [B, C] matmuls replace [UM, B] x
+            # [B, N] — the N/C compaction of the static fan-out.
+            cls_ok, cls_pref = _class_static(
+                cls, prof.sel_bits[pids], prof.aff_bits[pids],
+                prof.aff_terms[pids], prof.tol_bits[pids],
+                prof.pref_bits[pids], prof.pref_w[pids],
+                weights.node_affinity_weight, has_taints,
+            )
+            p_ok = cls_ok[:, cls.class_id]  # [UM, N]
+            if has_extra:
+                p_ok &= extra_prof[pids]
+            p_static_score = cls_pref[:, cls.class_id]
+            if has_extra_score:
+                p_static_score = p_static_score + score_prof[pids]
+        else:
+            p_ok = node_ready[None, :] & _subset_mm(
+                _unpack_bits(prof.sel_bits[pids]), label_missing_f
+            )
+            if has_extra:
+                # Custom-plugin verdicts, per profile (tasks sharing a
+                # profile share a mask row by construction).
+                p_ok &= extra_prof[pids]
+            aff_bits_p = _unpack_bits(prof.aff_bits[pids])  # [UM, A, B]
+            term_ok = _subset_mm(
+                aff_bits_p.reshape(UM * A, -1), label_missing_f
+            ).reshape(UM, A, N)
+            n_terms = prof.aff_terms[pids]
+            term_real = jnp.arange(A)[None, :] < n_terms[:, None]  # [UM, A]
+            p_ok &= (
+                jnp.any(term_ok & term_real[:, :, None], axis=1)
+                | (n_terms == 0)[:, None]
+            )
+            if has_taints:
+                # Taints: any node taint bit not tolerated kills the pair.
+                untol = jnp.matmul(
+                    node_taint_bits_f,
+                    (~_unpack_bits(prof.tol_bits[pids])).astype(f32).T,
+                )  # [N, UM]
+                p_ok &= untol.T == 0
 
-        pref_bits_p = _unpack_bits(prof.pref_bits[pids])  # [UM, AP, B]
-        pref_match = _subset_mm(
-            pref_bits_p.reshape(UM * AP, -1), label_missing_f
-        ).reshape(UM, AP, N)
-        p_static_score = weights.node_affinity_weight * jnp.sum(
-            pref_match * prof.pref_w[pids][:, :, None], axis=1
-        )  # [UM, N]
-        if has_extra_score:
-            # Attempt-invariant: hoisted out of the attempt loop (XLA
-            # does not hoist out of while_loops).
-            p_static_score = p_static_score + score_prof[pids]
+            pref_bits_p = _unpack_bits(prof.pref_bits[pids])  # [UM, AP, B]
+            pref_match = _subset_mm(
+                pref_bits_p.reshape(UM * AP, -1), label_missing_f
+            ).reshape(UM, AP, N)
+            p_static_score = weights.node_affinity_weight * jnp.sum(
+                pref_match * prof.pref_w[pids][:, :, None], axis=1
+            )  # [UM, N]
+            if has_extra_score:
+                # Attempt-invariant: hoisted out of the attempt loop (XLA
+                # does not hoist out of while_loops).
+                p_static_score = p_static_score + score_prof[pids]
+
+        if two_phase:
+            # Phase-2 hoists: the wave's shortlist window and every
+            # static plane gathered down to it.  sl rows are ascending
+            # node ids, so in-shortlist top_k tie-breaks by node index
+            # exactly like the full path.
+            sl_w = shortlists[pids]  # [UM, S]
+            p_ok_sl = jnp.take_along_axis(p_ok, sl_w, axis=1)
+            static_sl = jnp.take_along_axis(p_static_score, sl_w, axis=1)
+            mt_sl = nodes.max_tasks[sl_w]  # [UM, S]
+            alloc_sl = nodes.allocatable[sl_w]  # [UM, S, R]
 
         def live_parts(s: GState, cw_a, cw_p, aff_ok_c, aff_soft_c,
                        aff_dirty_a):
@@ -545,11 +836,104 @@ def _solve_wave(
             _scores, order = jax.lax.top_k(p_score, K)
             return order.astype(jnp.int32)
 
+        def live_parts_sl(s: GState, cw_a, cw_p, aff_ok_c, aff_soft_c,
+                          aff_dirty_a):
+            """Phase-2 fine ``live_parts``: per-attempt dynamic
+            feasibility on the [UM, S] shortlist planes.
+
+            Same formulas as ``live_parts`` evaluated only at each
+            profile's candidate nodes — the fit broadcast, the port
+            clash, and the affinity violation contractions all shrink by
+            N/S.  The count-vector gather/matmul over [N, EW] stays
+            shared (it is profile-independent); only the per-profile
+            planes compact.  Values at shortlist nodes are bit-identical
+            to the full computation's."""
+            if has_future:
+                future_idle = (
+                    s.idle + nodes.releasing - nodes.pipelined - s.pip_extra
+                )
+                walk_idle = future_idle
+            else:
+                future_idle = s.idle
+                walk_idle = s.idle
+            fi_sl = future_idle[sl_w]  # [UM, S, R] row gather
+            fit_sl = less_equal(
+                p_init_req[:, None, :], fi_sl, eps, scalar_slot
+            )
+            nt_sl = (s.ntasks + s.pip_ntasks)[sl_w]
+            pods_ok = (mt_sl <= 0) | (nt_sl < mt_sl)
+            feas = p_ok_sl & fit_sl & pods_ok
+            if has_ports:
+                used = (s.nport_bits | s.pip_nport_bits)[sl_w]  # [UM,S,B]
+                clash = jnp.einsum(
+                    "ub,usb->us", p_ports.astype(f32), used.astype(f32)
+                )
+                feas &= ~p_has_ports[:, None] | (clash == 0)
+            aff_ok, aff_soft = aff_ok_c, aff_soft_c
+            if has_aff:
+                def _aff_parts_sl(cnt):
+                    if dom_mm:
+                        cv = jax.lax.dot_general(
+                            cnt.astype(f32), dom_ohT,
+                            (((1,), (1,)), ((), ())),
+                        ).T
+                    else:
+                        cv = cnt[
+                            term_arange[None, :],
+                            jnp.maximum(node_dom_t, 0)
+                        ]
+                        cv = jnp.where(node_dom_t >= 0, cv, 0)  # [N, EW]
+                    cv_sl = cv[sl_w]  # [UM, S, EW] row gather
+                    total = jnp.sum(cnt, axis=-1)  # [EW]
+                    selfok = (total == 0)[None, :] & p_t_matches
+                    bfl = jnp.bfloat16
+                    need = (p_t_req_aff & ~selfok).astype(bfl)
+                    aff_viol = jnp.einsum(
+                        "ue,use->us", need, (cv_sl == 0).astype(bfl)
+                    )
+                    anti_viol = jnp.einsum(
+                        "ue,use->us", p_t_req_anti.astype(bfl),
+                        (cv_sl > 0).astype(bfl),
+                    )
+                    soft = jnp.einsum(
+                        "ue,use->us", p_t_soft, cv_sl.astype(f32)
+                    )
+                    return (
+                        (aff_viol < 0.5) & (anti_viol < 0.5), soft
+                    )
+
+                gate = aff_dirty_a if AFF_ACACHE else wave_live
+                aff_ok, aff_soft = jax.lax.cond(
+                    gate, _aff_parts_sl,
+                    lambda cnt: (aff_ok_c, aff_soft_c), cw_a + cw_p
+                )
+                feas &= aff_ok
+            return feas, future_idle, walk_idle, aff_ok, aff_soft
+
+        def rank_shortlist(s: GState, feas_sl, aff_soft):
+            """In-shortlist ranking: [UM, K] global node ids + their
+            feasibility.  sl rows are ascending node ids, so top_k ties
+            resolve to the lowest node index — the full path's
+            tie-break."""
+            p_score = jax.vmap(node_score, in_axes=(0, 0, 0, None))(
+                p_req, alloc_sl, s.idle[sl_w], weights
+            )
+            p_score = p_score + static_sl
+            if has_aff:
+                p_score = p_score + aff_soft
+            p_score = jnp.where(feas_sl, p_score, NEG)
+            _scores, pos = jax.lax.top_k(p_score, K)
+            ranked = jnp.take_along_axis(sl_w, pos, axis=1).astype(
+                jnp.int32
+            )
+            feas_k = jnp.take_along_axis(feas_sl, pos, axis=1)
+            return ranked, feas_k
+
         done0 = ~real_w
 
         def attempt_cond(carry):
             (_s, _cwa, _cwp, done, _al, _ff, skip_l, _ov, _aw, _pw, it,
-             stalled, _aok, _asoft, _adirty) = carry
+             stalled, _aok, _asoft, _adirty, _fbe, _fba, _fbr) = carry
             skip_t = (
                 jnp.matmul(onehot_j, skip_l.astype(f32)[:, None])[:, 0] > 0
             )
@@ -567,7 +951,8 @@ def _solve_wave(
         def attempt_body(carry):
             (s, cw_a, cw_p, done, alloc_l, fitf_l, skip_l, over_l,
              assigned_w, pipelined_w, it, _stalled,
-             aff_ok_c, aff_soft_c, aff_dirty_a) = carry
+             aff_ok_c, aff_soft_c, aff_dirty_a, fb_e, fb_a,
+             fb_r) = carry
             skip_l0 = skip_l
 
             if has_overuse:
@@ -590,13 +975,89 @@ def _solve_wave(
             )
             cand = ~done & ~skip_t
 
-            p_feasible, future_idle, walk_idle, aff_ok_c, aff_soft_c = (
-                live_parts(s, cw_a, cw_p, aff_ok_c, aff_soft_c,
-                           aff_dirty_a)
-            )
-            ranked = rank_nodes(s, p_feasible, aff_soft_c)
+            if two_phase:
+                (feas_sl, future_idle, walk_idle, aff_ok_c,
+                 aff_soft_c) = live_parts_sl(
+                    s, cw_a, cw_p, aff_ok_c, aff_soft_c, aff_dirty_a
+                )
+                ranked, feas_k_att = rank_shortlist(s, feas_sl,
+                                                    aff_soft_c)
+                p_any = jnp.any(feas_sl, axis=1)
+                # Shortlist exhaustion -> full-N rescore for the affected
+                # profiles only (lax.cond: the [UM, N] planes are only
+                # materialized when a live profile actually ran dry), so
+                # binding is never lost to pruning.  Counted per reason:
+                # required-affinity profiles exhaust when the live
+                # domain landscape drifted from the solve-start counts
+                # the shortlist was built on; everything else exhausts
+                # when earlier waves claimed all S candidates.
+                cand_u = (
+                    jnp.matmul(
+                        onehot_u.T, cand.astype(f32)[:, None]
+                    )[:, 0] > 0
+                )
+                exhausted = cand_u & ~p_any
+                if has_aff:
+                    prof_req_terms = jnp.any(
+                        p_t_req_aff | p_t_req_anti, axis=1
+                    )
+                else:
+                    prof_req_terms = jnp.zeros((UM,), bool)
+                need_fb = jnp.any(exhausted)
+                if fb_cap:
+                    # The cap counts rescore ROUNDS (one per attempt
+                    # that fired); a round rescores every profile
+                    # exhausting in that attempt, and the per-reason
+                    # counters tally those profiles.
+                    need_fb &= (s.fb_rounds + fb_r) < fb_cap
 
-            p_any = jnp.any(p_feasible, axis=1)
+                def _fb_rescore(_):
+                    # Fresh [UM, N] planes (the attempt-level affinity
+                    # cache stays shortlist-shaped; the fallback
+                    # recomputes — exact, just uncached).
+                    aff_ok_d = jnp.ones((UM, N), bool)
+                    aff_soft_d = jnp.zeros((UM, N), f32)
+                    dirty = wave_live if has_aff else jnp.bool_(False)
+                    p_full, _fi, _wi, _ao, soft_full = live_parts(
+                        s, cw_a, cw_p, aff_ok_d, aff_soft_d, dirty
+                    )
+                    ranked_f = rank_nodes(s, p_full, soft_full)
+                    feask_f = jnp.take_along_axis(p_full, ranked_f,
+                                                  axis=1)
+                    pany_f = jnp.any(p_full, axis=1)
+                    mex = exhausted
+                    return (
+                        jnp.where(mex[:, None], ranked_f, ranked),
+                        jnp.where(mex[:, None], feask_f, feas_k_att),
+                        jnp.where(mex, pany_f, p_any),
+                        jnp.sum(
+                            (mex & ~prof_req_terms).astype(jnp.int32)
+                        ),
+                        jnp.sum(
+                            (mex & prof_req_terms).astype(jnp.int32)
+                        ),
+                    )
+
+                def _fb_skip(_):
+                    return (ranked, feas_k_att, p_any, jnp.int32(0),
+                            jnp.int32(0))
+
+                ranked, feas_k_att, p_any, fbe_i, fba_i = jax.lax.cond(
+                    need_fb, _fb_rescore, _fb_skip, None
+                )
+                fb_e = fb_e + fbe_i
+                fb_a = fb_a + fba_i
+                fb_r = fb_r + need_fb.astype(jnp.int32)
+            else:
+                (p_feasible, future_idle, walk_idle, aff_ok_c,
+                 aff_soft_c) = live_parts(
+                    s, cw_a, cw_p, aff_ok_c, aff_soft_c, aff_dirty_a
+                )
+                ranked = rank_nodes(s, p_feasible, aff_soft_c)
+                p_any = jnp.any(p_feasible, axis=1)
+                feas_k_att = jnp.take_along_axis(p_feasible, ranked,
+                                                 axis=1)
+
             any_feasible = (
                 jnp.matmul(onehot_u, p_any.astype(f32)[:, None])[:, 0] > 0
             )
@@ -608,7 +1069,6 @@ def _solve_wave(
             aborted = jnp.any(same_job & tril & no_node[None, :], axis=1)
 
             # Hoisted per-attempt constants for the sub-round loop.
-            feas_k_att = jnp.take_along_axis(p_feasible, ranked, axis=1)
             mt_k = nodes.max_tasks[ranked]
             rows_rk = jnp.matmul(onehot_u, ranked.astype(f32))  # [W, K]
 
@@ -678,10 +1138,6 @@ def _solve_wave(
                     # activity skip the [N, EW] work entirely.
                     def steer(_):
                         cnt_live_n = cw_a_ + cw_p_  # [EW, D]
-                        cval_live = cnt_live_n[
-                            term_arange[None, :], jnp.maximum(node_dom_t, 0)
-                        ]
-                        cval_live = jnp.where(node_dom_t >= 0, cval_live, 0)
                         total_live_n = jnp.sum(cnt_live_n, axis=-1)
                         selfok_p = (
                             (total_live_n == 0)[None, :] & p_t_matches
@@ -689,6 +1145,30 @@ def _solve_wave(
                         # bf16 indicator matmuls: see _aff_parts.
                         bf_ = jnp.bfloat16
                         need_l = (p_t_req_aff & ~selfok_p).astype(bf_)
+                        if two_phase:
+                            # Steer directly at the ranked candidates:
+                            # [UM, K, EW] window instead of [UM, N].
+                            dw_r = node_dom_t[ranked]  # [UM, K, EW]
+                            cval_r = cnt_live_n[
+                                term_arange[None, None, :],
+                                jnp.maximum(dw_r, 0),
+                            ]
+                            cval_r = jnp.where(dw_r >= 0, cval_r, 0)
+                            aff_viol_l = jnp.einsum(
+                                "ue,uke->uk", need_l,
+                                (cval_r == 0).astype(bf_),
+                            )
+                            anti_viol_l = jnp.einsum(
+                                "ue,uke->uk", p_t_req_anti.astype(bf_),
+                                (cval_r > 0).astype(bf_),
+                            )
+                            return feas_k_att & (aff_viol_l < 0.5) & (
+                                anti_viol_l < 0.5
+                            )
+                        cval_live = cnt_live_n[
+                            term_arange[None, :], jnp.maximum(node_dom_t, 0)
+                        ]
+                        cval_live = jnp.where(node_dom_t >= 0, cval_live, 0)
                         aff_viol_l = jnp.matmul(
                             need_l, (cval_live == 0).astype(bf_).T
                         )
@@ -1166,7 +1646,7 @@ def _solve_wave(
             return (
                 s, cw_a, cw_p, done, alloc_l, fitf_l, skip_l, over_l,
                 assigned_w, pipelined_w, it + jnp.maximum(subs, 1), stalled,
-                aff_ok_c, aff_soft_c, cnt_changed_out,
+                aff_ok_c, aff_soft_c, cnt_changed_out, fb_e, fb_a, fb_r,
             )
 
         # Per-wave count windows (the wave only touches its own term rows).
@@ -1179,9 +1659,10 @@ def _solve_wave(
                 cw_p0 = state.cnt_pip[wterms]
             # Affinity attempt-cache init: all-feasible/zero-score with
             # the dirty flag at wave_live, so live waves compute on the
-            # first attempt and term-free waves never do.
-            aff_ok0 = jnp.ones((UM, N), bool)
-            aff_soft0 = jnp.zeros((UM, N), f32)
+            # first attempt and term-free waves never do.  Two-phase
+            # carries the cache at shortlist width.
+            aff_ok0 = jnp.ones((UM, S if two_phase else N), bool)
+            aff_soft0 = jnp.zeros((UM, S if two_phase else N), f32)
             aff_dirty0 = wave_live
         else:
             cw_a0 = jnp.zeros((1, 1), jnp.int32)
@@ -1206,9 +1687,13 @@ def _solve_wave(
             aff_ok0,
             aff_soft0,
             aff_dirty0,
+            jnp.int32(0),
+            jnp.int32(0),
+            jnp.int32(0),
         )
         (s, cw_a, cw_p, _done, alloc_l, fitf_l, skip_l, over_l, assigned_w,
-         pipelined_w, _it, _stalled, _aok, _asoft, _adirty) = (
+         pipelined_w, _it, _stalled, _aok, _asoft, _adirty, _fbe, _fba,
+         _fbr) = (
             jax.lax.while_loop(attempt_cond, attempt_body, init)
         )
         if has_aff and not terms_disjoint:
@@ -1225,7 +1710,12 @@ def _solve_wave(
         jupd_back = lambda g, l: jax.lax.dynamic_update_slice_in_dim(
             g, l, jlo, axis=0
         )
-        s = s._replace(iters=s.iters + _it)
+        s = s._replace(
+            iters=s.iters + _it,
+            fb_exhausted=s.fb_exhausted + _fbe,
+            fb_affinity=s.fb_affinity + _fba,
+            fb_rounds=s.fb_rounds + _fbr,
+        )
         return s._replace(
             alloc_cnt=jupd_back(s.alloc_cnt, alloc_l),
             fit_failed=jupd_back(s.fit_failed, fitf_l),
@@ -1270,6 +1760,8 @@ def _solve_wave(
         idle=idle,
         q_alloc=q_alloc + state.q_pip,
         iters=state.iters,
+        fb_exhausted=state.fb_exhausted,
+        fb_affinity=state.fb_affinity,
     )
 
 
@@ -1611,6 +2103,46 @@ def _pad_aff(aff: AffinityArgs, pad: int) -> AffinityArgs:
     )
 
 
+def _host_node_classes(nodes: SolveNodes):
+    """Compact the node table into classes from HOST arrays.
+
+    Only called when ``nodes.label_bits`` is numpy (direct callers, the
+    remote solver child); device-resident callers (devsnap, mesh) build
+    classes from their own host copies and pass ``node_classes`` in —
+    this helper is deliberately outside the vclint hot registry because
+    by contract it never sees a device array.
+
+    The grouping is memoized on a content digest of the static planes
+    (one entry): the remote solver child has no mirror epoch to key on,
+    but its node table is just as epoch-stable cycle-to-cycle, and the
+    digest (a linear byte hash) is an order of magnitude cheaper than
+    re-running the structured-row unique sort every solve."""
+    import hashlib
+
+    from .nodeclass import build_node_classes
+
+    h = hashlib.blake2b(digest_size=16)
+    planes = (
+        nodes.label_bits, nodes.taint_bits, np.asarray(nodes.ready),
+        np.asarray(nodes.allocatable, np.float32),
+        np.asarray(nodes.max_tasks, np.int32),
+    )
+    for a in planes:
+        a = np.ascontiguousarray(a)
+        h.update(repr((a.shape, a.dtype.str)).encode())
+        h.update(memoryview(a).cast("B"))
+    key = h.hexdigest()
+    cached = _host_node_classes._cache
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    classes, _n, _sig = build_node_classes(*planes)
+    _host_node_classes._cache = (key, classes)
+    return classes
+
+
+_host_node_classes._cache = None
+
+
 def solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -1626,6 +2158,7 @@ def solve_wave(
     extra_ok=None,
     extra_score=None,
     taint_any=None,
+    node_classes: NodeClasses = None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -1865,19 +2398,74 @@ def solve_wave(
             # arrays on incompatible device sets.
             cnt0_dev = jax.device_put(cnt0_dev, in_sharding)
         aff = aff._replace(cnt0=cnt0_dev)
+    # ---- two-phase solve prep (node classes + shortlists) ------------
+    N_in = int(nodes.idle.shape[0])
+    two_phase = _two_phase_on() and N_in > 0
+    if two_phase and node_classes is None and _nodeclass_on() \
+            and isinstance(nodes.label_bits, np.ndarray):
+        node_classes = _host_node_classes(nodes)
+    cls_identity = node_classes is None
+    if two_phase and not cls_identity:
+        cls_arg = node_classes
+    else:
+        # Inert dummies; the kernel derives identity classes from the
+        # node planes when two_phase & cls_identity.
+        cls_arg = NodeClasses(
+            class_id=z1((1,), np.int32),
+            label_bits=z1((1, 1), np.uint32),
+            taint_bits=z1((1, 1), np.uint32),
+            ready=z1((1,), bool),
+        )
+    sl_k = shortlist_size(N_in) if two_phase else 1
+    U_rows = int(profiles.req.shape[0])
+    # Largest power of two <= COARSE_CHUNK: the profile axis is
+    # pow2-padded, so a pow2 chunk always divides it (lax.map needs an
+    # exact reshape).
+    chunk = 1
+    while chunk * 2 <= max(1, min(COARSE_CHUNK, U_rows)):
+        chunk *= 2
     # Exact f32 matmuls are load-bearing: the one-hot matmuls carry node
     # indices, resource sums, and 0/1 predicate counts that are compared
     # with == / <=; the TPU default (bf16 MXU passes) rounds node ids above
     # 256 and capacity sums, mis-routing placements and stalling the
     # attempt loop.
+    t_coarse = 0.0
     with jax.default_matmul_precision("float32"):
+        if two_phase:
+            t0 = _time.perf_counter()
+            sl = _coarse_shortlist(
+                nodes, profiles, extra_prof, score_prof, cls_arg, aff,
+                weights, eps, scalar_slot,
+                sl_k=sl_k, chunk=chunk,
+                features=features, cnt0_any=bool(cnt0_any),
+                cls_identity=cls_identity,
+            )
+            t_coarse = _time.perf_counter() - t0
+        else:
+            sl = z1((1, 1), np.int32)
+        t0 = _time.perf_counter()
         res = _solve_wave(
             nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff,
             profiles, extra_prof, score_prof, pid, wave_prof,
-            wave_terms,
+            wave_terms, cls_arg, sl,
             wave=wave, n_waves=n_waves, ew=ew, features=features,
-            terms_disjoint=terms_disjoint,
+            terms_disjoint=terms_disjoint, two_phase=two_phase,
+            cls_identity=cls_identity, fb_cap=_fallback_cap(),
         )
+        t_fine = _time.perf_counter() - t0
+    # Dispatch-side sub-lane telemetry (the cycle driver folds it into
+    # the device_coarse/device_fine lanes; with async device dispatch
+    # these measure the host-side dispatch legs, the residual device
+    # wait stays on the caller's fetch).
+    LAST_TWOPHASE.clear()
+    LAST_TWOPHASE.update({
+        "enabled": two_phase,
+        "coarse_s": t_coarse,
+        "fine_s": t_fine,
+        "shortlist": (U_rows, sl_k) if two_phase else None,
+        "n_nodes": N_in,
+        "compacted_classes": two_phase and not cls_identity,
+    })
     if pad:
         res = res._replace(
             assigned=res.assigned[:P], pipelined=res.pipelined[:P]

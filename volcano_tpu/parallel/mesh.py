@@ -123,7 +123,8 @@ _EPOCH_STABLE_NODE_FIELDS = frozenset(
 def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
                       axis: str = NODES_AXIS,
                       plane_cache: Optional[dict] = None,
-                      epoch: Optional[int] = None):
+                      epoch: Optional[int] = None,
+                      node_classes=None):
     """Mesh placement for the fast path's pre-profiled wave inputs.
 
     Beyond the node-axis sharding of ``shard_solve_args``, the affinity
@@ -236,7 +237,23 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
         aff,
     )
     pid = jax.device_put(np.asarray(pid), replicated)
-    return args, pid, profiles
+    if node_classes is not None:
+        # Two-phase planes: the [N] class_id shards with the node axis
+        # (it IS a node column); the [C, *] class tables and the [U, S]
+        # shortlists the solver derives from them stay replicated —
+        # they are the COMPACTED representations (C, S << N), which is
+        # exactly why the mesh no longer has to move full [UM, N]
+        # planes between chips per attempt.  class_id is epoch-stable,
+        # so it rides the persistent plane cache.
+        node_classes = type(node_classes)(
+            class_id=put_node_cached("class_id", node_classes.class_id),
+            label_bits=put_node_cached("cls_label_bits",
+                                       node_classes.label_bits),
+            taint_bits=put_node_cached("cls_taint_bits",
+                                       node_classes.taint_bits),
+            ready=put_node_cached("cls_ready", node_classes.ready),
+        )
+    return args, pid, profiles, node_classes
 
 
 def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
@@ -244,17 +261,20 @@ def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
                              wave: Optional[int] = None,
                              plane_cache: Optional[dict] = None,
                              epoch: Optional[int] = None,
-                             taint_any=None):
+                             taint_any=None,
+                             node_classes=None):
     """The fast path's solve dispatch on a mesh (FastCycle._allocate when
     ``store.solve_mesh`` is set): pre-profiled inputs, node axis + count
-    tensors sharded per ``shard_wave_inputs``; epoch-stable planes stay
-    mesh-resident across cycles via ``plane_cache``."""
+    tensors sharded per ``shard_wave_inputs``; epoch-stable planes
+    (including the two-phase class planes) stay mesh-resident across
+    cycles via ``plane_cache``."""
     from ..ops.wave import solve_wave
 
-    args, pid, profiles = shard_wave_inputs(
+    args, pid, profiles, node_classes = shard_wave_inputs(
         mesh, solve_args, pid, profiles, axis,
-        plane_cache=plane_cache, epoch=epoch,
+        plane_cache=plane_cache, epoch=epoch, node_classes=node_classes,
     )
     kw = {} if wave is None else {"wave": wave}
     return solve_wave(*args, pid=pid, profiles=profiles,
-                      taint_any=taint_any, **kw)
+                      taint_any=taint_any, node_classes=node_classes,
+                      **kw)

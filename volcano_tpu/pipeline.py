@@ -50,6 +50,7 @@ class InflightSolve:
     __slots__ = (
         "kind", "payload", "solve_jobs", "task_rows", "req_gather",
         "mutation_seq", "epoch", "compact_gen", "n_nodes", "solve_id",
+        "fallbacks",
     )
 
     def __init__(self, kind: str, payload, solve_jobs: List[int],
@@ -70,18 +71,34 @@ class InflightSolve:
         # Flow id linking this dispatch's trace span (cycle N) to the
         # fetch/commit spans (cycle N+1); 0 = untracked.
         self.solve_id = solve_id
+        # (exhausted, affinity-required) shortlist-fallback rescore
+        # counts of the solve, populated by fetch(); the commit folds
+        # them into the per-reason counter series.
+        self.fallbacks = (0, 0)
 
     # ----------------------------------------------------------- lifecycle
 
     def fetch(self) -> np.ndarray:
         """Block on the remaining device/remote round trip; return the
-        assignment vector ([P] int32, node row or -1) as numpy."""
+        assignment vector ([P] int32, node row or -1) as numpy.  The
+        two-phase shortlist-fallback counters ride the same batched
+        fetch into ``self.fallbacks``."""
         if self.kind == "remote":
             res = self.payload.fetch()
+            if res.fb_exhausted is not None:
+                self.fallbacks = (int(res.fb_exhausted),
+                                  int(res.fb_affinity))
             return np.asarray(res.assigned)
         import jax
 
-        (assigned,) = jax.device_get((self.payload.assigned,))
+        if self.payload.fb_exhausted is not None:
+            assigned, fb_ex, fb_aff = jax.device_get(
+                (self.payload.assigned, self.payload.fb_exhausted,
+                 self.payload.fb_affinity)
+            )
+            self.fallbacks = (int(fb_ex), int(fb_aff))
+        else:
+            (assigned,) = jax.device_get((self.payload.assigned,))
         return np.asarray(assigned)
 
     def abandon(self) -> None:

@@ -17,7 +17,9 @@ Request manifest: ``{"op": "solve", "tree": <spec>, "wave": int|None}``
 (``tree`` is the ``snapwire.flatten_tree`` spec of
 ``(solve_args, pid, profiles)``), or ``{"op": "ping"}``.
 Response manifest: ``{"op": "result", "tree": ...}`` with
-``(assigned, pipelined, never_ready, fit_failed, iters)``, or
+``(assigned, pipelined, never_ready, fit_failed, iters, fb_exhausted,
+fb_affinity)`` — the trailing two are the two-phase shortlist-fallback
+counters (decoders accept the pre-two-phase 5-tuple as zeros) — or
 ``{"op": "error", "message": ...}``.
 
 Run the solver:  ``vtpu-solver --port 18477``  (or
@@ -196,7 +198,11 @@ class SolverServer:
         res = solve_wave(*solve_args, pid=pid, profiles=profiles, **kw)
         out = jax.device_get(
             (res.assigned, res.pipelined, res.never_ready, res.fit_failed,
-             res.iters if res.iters is not None else np.int32(0))
+             res.iters if res.iters is not None else np.int32(0),
+             res.fb_exhausted if res.fb_exhausted is not None
+             else np.int32(0),
+             res.fb_affinity if res.fb_affinity is not None
+             else np.int32(0))
         )
         solve_ms = (_time.perf_counter() - t0) * 1e3
         self.solves += 1
@@ -320,13 +326,19 @@ class RemoteSolver:
                 f"remote solver failed: {manifest.get('message')}"
             )
         self.last_solve_ms = manifest.get("solve_ms")
-        assigned, pipelined, never_ready, fit_failed, iters = (
-            sw.unflatten_tree(manifest["tree"], rarrays, _registry())
-        )
+        vals = sw.unflatten_tree(manifest["tree"], rarrays, _registry())
+        assigned, pipelined, never_ready, fit_failed, iters = vals[:5]
+        # Replies predating the two-phase solve carry 5 entries; the
+        # shortlist-fallback counters then read as zero.
+        if len(vals) >= 7:
+            fb_exhausted, fb_affinity = vals[5], vals[6]
+        else:
+            fb_exhausted = fb_affinity = np.int32(0)
         return AllocResult(
             assigned=assigned, pipelined=pipelined,
             never_ready=never_ready, fit_failed=fit_failed,
             idle=None, q_alloc=None, iters=iters,
+            fb_exhausted=fb_exhausted, fb_affinity=fb_affinity,
         )
 
     def solve(self, solve_args: Sequence, pid, profiles,
