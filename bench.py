@@ -81,7 +81,7 @@ def _twophase_env(on: bool, topk: int = 0):
 
 
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
-          records=None, fallbacks=None):
+          records=None, fallbacks=None, rebalance=None):
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
@@ -93,6 +93,10 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
             budget_ms / value_ms if value_ms > 0 else 0.0, 4
         ),
     }
+    if rebalance:
+        # BENCH_REBALANCE tail: frag-score before/after + plan stats
+        # (docs/rebalance.md).
+        payload["rebalance"] = dict(rebalance)
     if fallbacks:
         # Two-phase shortlist-fallback rescores over the measured
         # cycles, by reason (docs/metrics.md).
@@ -523,6 +527,129 @@ def config_north(repeats):
     )
 
 
+def config_rebalance():
+    """BENCH_REBALANCE: fragmented-cluster defragmentation (ISSUE 5).
+
+    BENCH_NODES worker nodes (4 cpu) each stranded by a 3-cpu filler,
+    an equal count of 3-cpu spill nodes, and a high-priority gang of
+    BENCH_NODES/2 whole-node tasks that allocate+backfill alone can
+    never place.  Measures the planning+commit cycle and the cycles to
+    full convergence (gang bound, every filler re-bound), and emits a
+    frag-score-before/after tail (docs/rebalance.md)."""
+    import time as _t
+
+    from volcano_tpu.api import (
+        GROUP_NAME_ANNOTATION,
+        Node,
+        Pod,
+        PodGroup,
+        PriorityClass,
+    )
+    from volcano_tpu.cache import ClusterStore, FakeBinder
+    from volcano_tpu.framework import (
+        REBALANCE_SCHEDULER_CONF,
+        parse_scheduler_conf,
+    )
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.sim import ClusterSimulator
+
+    workers = int(os.environ.get("BENCH_NODES", 64))
+    gang = max(workers // 2, 1)
+    os.environ["VOLCANO_TPU_REBALANCE_DRAIN_CAP"] = str(workers)
+
+    store = ClusterStore(binder=FakeBinder())
+    store.add_priority_class(PriorityClass(name="bench-high", value=100))
+    for i in range(workers):
+        store.add_node(Node(name=f"w{i}", allocatable={
+            "cpu": "4", "memory": "16Gi", "pods": 110}))
+        store.add_node(Node(name=f"s{i}", allocatable={
+            "cpu": "3", "memory": "16Gi", "pods": 110}))
+    for i in range(workers):
+        store.add_pod_group(PodGroup(name=f"bf{i}", min_member=1))
+        store.add_pod(Pod(
+            name=f"bfill{i}",
+            annotations={GROUP_NAME_ANNOTATION: f"bf{i}"},
+            containers=[{"cpu": "3", "memory": "1Gi"}],
+        ))
+    sched = Scheduler(store, conf_str=REBALANCE_SCHEDULER_CONF)
+    sim = ClusterSimulator(store, grace_steps=2)
+    sched.run_once()
+    sim.step()
+    store.add_pod_group(PodGroup(
+        name="benchgang", min_member=gang, priority_class="bench-high"))
+    for i in range(gang):
+        store.add_pod(Pod(
+            name=f"bg{i}",
+            annotations={GROUP_NAME_ANNOTATION: "benchgang"},
+            containers=[{"cpu": "4", "memory": "1Gi"}],
+        ))
+
+    def frag_now():
+        """Mean frag score vs the gang's whole-node profile on live
+        planes (one FastCycle derive + the planner kernel)."""
+        import jax
+        import numpy as np
+
+        from volcano_tpu.fastpath import FastCycle
+        from volcano_tpu.ops.rebalance import frag_scores
+
+        cyc = FastCycle(store, parse_scheduler_conf(
+            REBALANCE_SCHEDULER_CONF))
+        with store._lock:
+            cyc.derive()
+        prof = np.zeros((1, cyc.R), np.float32)
+        prof[0, 0] = 4000.0  # the gang task: 4 cpu (milli)
+        prof[0, 1] = float(1 << 30)  # 1Gi
+        fs = frag_scores(cyc.n_idle.astype(np.float32),
+                         cyc.n_alloc.astype(np.float32), cyc.n_ready,
+                         np.zeros_like(cyc.n_idle), prof, cyc.eps)
+        (frag,) = jax.device_get((fs.frag,))
+        alive = cyc.n_alive
+        return float(frag[alive].mean()) if alive.any() else 0.0
+
+    from volcano_tpu.metrics import metrics as _metrics
+
+    def _evictions_total():
+        return sum(_metrics.rebalance_evictions.data.values())
+
+    ev_before = _evictions_total()
+    frag_before = frag_now()
+    t0 = _t.perf_counter()
+    sched.run_once()  # plans + commits the migration wave
+    plan_cycle_ms = (_t.perf_counter() - t0) * 1e3
+    converged_cycles = 0
+    for _ in range(24):
+        converged_cycles += 1
+        sim.step()
+        sched.run_once()
+        bound = sum(1 for p in store.pods.values()
+                    if p.name.startswith("bg") and p.node_name)
+        if bound >= gang:
+            break
+    frag_after = frag_now()
+    ledger = store.migrations
+    _emit(
+        f"Rebalance plan+commit cycle @ {2 * workers} nodes, "
+        f"{gang}-task gang",
+        plan_cycle_ms, gang,
+        f"converged_in={converged_cycles} cycles "
+        f"plans={ledger.committed_plans if ledger else 0} "
+        f"frag {frag_before:.3f} -> {frag_after:.3f}",
+        budget_ms=NORTH_STAR_MS,
+        lanes=store.last_cycle_lanes,
+        rebalance={
+            "frag_before": round(frag_before, 4),
+            "frag_after": round(frag_after, 4),
+            "gang": gang,
+            "evictions": int(_evictions_total() - ev_before),
+            "committed_plans": (ledger.committed_plans
+                                if ledger else 0),
+            "converged_cycles": converged_cycles,
+        },
+    )
+    store.close()
+
+
 def _run_selected(raw, repeats):
     if raw == "north":
         config_north(repeats)
@@ -553,6 +680,11 @@ def main():
     # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
     # between runs, and the minimum is the stable estimator.
     repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    if os.environ.get("BENCH_REBALANCE"):
+        # Fragmented-cluster defragmentation lane (ISSUE 5): its own
+        # scenario, not a mode of the five configs.
+        config_rebalance()
+        return
     ab = os.environ.get("BENCH_TOPK")
     if ab:
         # A/B the two-phase solve in ONE run: the selected config runs

@@ -36,7 +36,7 @@ DEVICE_FNS = {
     "solve_wave", "_solve_wave", "sharded_solve_wave",
     "sharded_solve_wave_cycle", "sharded_solve", "device_put",
     "_scatter_rows", "_scatter_cnt0", "_scatter_profile_tables",
-    "solve_fn", "solve_async", "_coarse_shortlist",
+    "solve_fn", "solve_async", "_coarse_shortlist", "frag_scores",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -84,6 +84,14 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         # dispatch and the commit on every cycle.
         HotEntry("FastCycle._record_twophase_lanes"),
         HotEntry("FastCycle._count_shortlist_fb"),
+        # Rebalance lane (ISSUE 5): the frag-score kernel dispatch, the
+        # what-if solve dispatch, and the pipelined plan commit all sit
+        # on the cycle thread; an implicit sync here stalls every cycle
+        # the lane runs.
+        HotEntry("FastCycle._rebalance"),
+        HotEntry("FastCycle._plan_rebalance"),
+        HotEntry("FastCycle._dispatch_plan"),
+        HotEntry("FastCycle._commit_inflight_plan"),
     ],
     "volcano_tpu/ops/wave.py": [
         # The devsnap planes (allocatable/max_tasks/ready/label_bits/
@@ -106,12 +114,20 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         # builder trips VCL201 instead of a silent per-cycle sync.
         HotEntry("build_node_classes"),
     ],
+    "volcano_tpu/ops/rebalance.py": [
+        # The jitted frag-score kernel and the host-only greedy drain
+        # selection (fetched numpy in by contract, like the class
+        # builder above).
+        HotEntry("frag_scores"),
+        HotEntry("select_drain_set"),
+    ],
     "volcano_tpu/parallel/mesh.py": [
         HotEntry("shard_wave_inputs"),
         HotEntry("sharded_solve_wave_cycle"),
     ],
     "volcano_tpu/pipeline.py": [
         HotEntry("InflightSolve.fetch"),
+        HotEntry("InflightPlan.fetch"),
     ],
 }
 

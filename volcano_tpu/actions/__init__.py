@@ -6,6 +6,7 @@ from .allocate import AllocateAction
 from .backfill import BackfillAction
 from .enqueue import EnqueueAction
 from .preempt import PreemptAction
+from .rebalance import RebalanceAction
 from .reclaim import ReclaimAction
 
 register_action(EnqueueAction())
@@ -13,11 +14,13 @@ register_action(AllocateAction())
 register_action(BackfillAction())
 register_action(PreemptAction())
 register_action(ReclaimAction())
+register_action(RebalanceAction())
 
 __all__ = [
     "AllocateAction",
     "BackfillAction",
     "EnqueueAction",
     "PreemptAction",
+    "RebalanceAction",
     "ReclaimAction",
 ]

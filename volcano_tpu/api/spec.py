@@ -245,6 +245,10 @@ class PodGroup:
     status: PodGroupStatus = field(default_factory=PodGroupStatus)
     creation_timestamp: float = 0.0
     owner_job: str = ""
+    # Disruption budget for the rebalance lane (PDB max_unavailable
+    # equivalent): max members a migration wave may evict at once.
+    # None -> the VOLCANO_TPU_REBALANCE_MAX_UNAVAIL default.
+    max_unavailable: Optional[int] = None
 
     def __post_init__(self):
         if not self.creation_timestamp:

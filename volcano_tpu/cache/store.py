@@ -171,6 +171,14 @@ class ClusterStore:
         # store.close()/Scheduler.stop() on other threads, so the slot
         # itself is lock-guarded (vclint VCL101/102 enforces this).
         self._inflight_solve = None  # guarded-by: _lock (any-receiver)
+        # Parked dispatched-but-uncommitted rebalance plan (pipeline.py
+        # InflightPlan): same ownership/locking contract as the solve
+        # slot above.
+        self._inflight_plan = None  # guarded-by: _lock (any-receiver)
+        # Migration ledger (actions/rebalance.py MigrationLedger),
+        # attached by the rebalance lane's first committed plan; the
+        # delete_pod hook below restores terminating victims through it.
+        self.migrations = None
 
         # Observability (obs/, ISSUE 3): the per-store span tracer and
         # the cycle flight recorder.  Both are internally synchronized
@@ -357,11 +365,13 @@ class ClusterStore:
         """Stop background machinery (the bind dispatcher thread).  The
         dispatcher's callbacks pin this store, so long-lived processes
         creating many stores (benchmarks) must close them."""
-        from ..pipeline import abandon_inflight
+        from ..pipeline import abandon_inflight, abandon_inflight_plan
 
         # A parked pipelined solve holds device buffers (or a remote
-        # solver's reply slot); drop it with the store.
+        # solver's reply slot); drop it with the store.  A parked
+        # rebalance plan mutates nothing until committed — drop it too.
         abandon_inflight(self)
+        abandon_inflight_plan(self)
         if self._bind_dispatcher is not None:
             self._bind_dispatcher.stop()
             self._bind_dispatcher = None
@@ -602,6 +612,10 @@ class ClusterStore:
             self.mirror.remove_pod(pod.uid)
             self.mirror.maybe_compact()
             self._notify("Pod", "delete", pod)
+            if self.migrations is not None and old is not None:
+                # A terminating rebalance victim restores as a fresh
+                # Pending pod (add_pod re-enters the re-entrant lock).
+                self.migrations.pod_deleted(self, old)
 
     # -------------------------------------------------------- node handlers
 

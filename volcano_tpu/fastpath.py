@@ -63,7 +63,8 @@ log = logging.getLogger(__name__)
 F = np.float32
 I = np.int32
 
-FAST_ACTIONS = {"enqueue", "allocate", "backfill", "preempt", "reclaim"}
+FAST_ACTIONS = {"enqueue", "allocate", "backfill", "preempt", "reclaim",
+                "rebalance"}
 FAST_PLUGINS = {
     "priority", "gang", "conformance", "drf", "proportion",
     "predicates", "nodeorder", "binpack",
@@ -727,6 +728,10 @@ class FastCycle:
                 # device round trip ran concurrently with that cycle's
                 # close/enqueue and this cycle's derive (pipeline.py).
                 self._commit_inflight()
+                # A rebalance plan dispatched last cycle commits (or
+                # voids) right after the solve, against the freshest
+                # state this cycle will see (actions/rebalance.py).
+                self._commit_inflight_plan()
                 # Workload-injection seam (bench.py steady state, loop
                 # tests): new work "arrives" after the commit and before
                 # this cycle's actions, so every pipelined cycle both
@@ -737,7 +742,8 @@ class FastCycle:
                         feed(self)
                 for name in self.action_names:
                     lane = (name if name in ("preempt", "reclaim",
-                                             "enqueue", "backfill")
+                                             "enqueue", "backfill",
+                                             "rebalance")
                             else None)
                     with metrics.action_timer(name), tracer.span(
                             f"action:{name}", cat="action",
@@ -765,6 +771,12 @@ class FastCycle:
                         elif name == "reclaim":
                             self._evict_machinery().reclaim()
                             self.m.mutation_seq += 1
+                        elif name == "rebalance":
+                            # Defragmentation planner (ISSUE 5): a
+                            # committed plan evicts through the same
+                            # machinery as preempt/reclaim and stamps
+                            # the mutation counter itself.
+                            self._rebalance()
             except BaseException:
                 # A failed cycle may leave uncommitted status mutations
                 # in the mirror (evictions mid-statement); re-derive
@@ -825,6 +837,7 @@ class FastCycle:
             device_events=list(st["device_events"]),
             error=type(err).__name__ if err is not None else None,
             spans=self.tracer.drain(),
+            rebalance=st.get("rebalance"),
         ))
 
     def _count_drops(self, reasons: Dict[str, int]) -> None:
@@ -3126,6 +3139,526 @@ class FastCycle:
             if used & my:
                 return False
         return True
+
+    # ----------------------------------------------------------- rebalance
+
+    # Pipelined cycles see starvation one commit behind, so a gang must
+    # stay starved this many consecutive rebalance passes before a plan
+    # forms (gives the in-flight allocate dispatch its chance to bind).
+    REBALANCE_STREAK_PIPELINED = 2
+    # Cooldown (in rebalance passes) after a gang's plan is rejected:
+    # a persistently starved gang whose what-if keeps failing must not
+    # re-pay the frag kernel + what-if solve every cycle.  The world
+    # changing enough to help (pods finishing, nodes joining) takes
+    # many cycles anyway; a commit or leaving the starved set clears it.
+    REBALANCE_REJECT_BACKOFF = 8
+
+    def _rebalance(self) -> None:
+        """Gang-aware defragmentation lane (ISSUE 5, docs/rebalance.md).
+
+        Picks the most-starved schedulable gang, scores per-node
+        fragmentation against its profile table (ops/rebalance.py — one
+        kernel over the same planes the wave solver reads), selects a
+        bounded drain set under per-PodGroup disruption budgets, and
+        proves the migration with a what-if ``solve_wave`` over the
+        hypothetically drained cluster (victims re-entered as pending
+        alongside the gang, riding the exact allocate jit).  The plan
+        commits — victims evicted through the ``fastpath_evict``
+        machinery, restores registered with the migration ledger — only
+        when the what-if shows strict improvement: the gang reaches
+        ready AND every victim re-places.  Pipelined stores park the
+        what-if as ``pipeline.InflightPlan`` and commit next cycle
+        behind the staleness guard."""
+        from .actions.rebalance import rebalance_enabled
+
+        store = self.store
+        if not rebalance_enabled():
+            return
+        if (getattr(store, "remote_solver", None) is not None
+                or getattr(store, "solve_mesh", None) is not None):
+            # The what-if solve runs on the local backend; remote-solver
+            # and mesh deployments keep the lane off until those paths
+            # carry it.
+            return
+        ledger = store.migrations
+        if ledger is not None and ledger.active(store):
+            # One migration wave at a time: budgets stay trivially
+            # honest and a half-done wave never compounds.
+            return
+        if store._inflight_plan is not None:
+            return
+        jrow = self._find_starved_gang()
+        if jrow is None:
+            return
+        plan = self._plan_rebalance(jrow)
+        if plan is None:
+            return
+        self._dispatch_plan(plan)
+
+    def _find_starved_gang(self) -> Optional[int]:
+        """Most-starved schedulable gang (largest min_available
+        shortfall, lowest row tie-break) whose starvation has persisted
+        long enough (see REBALANCE_STREAK_PIPELINED)."""
+        m = self.m
+        store = self.store
+        srows = np.asarray(self.session_jobs, np.int64)
+        streaks = getattr(store, "_rebalance_streaks", None)
+        if streaks is None:
+            streaks = store._rebalance_streaks = {}
+        if not len(srows):
+            streaks.clear()
+            return None
+        mask = (
+            (self.j_phase[srows] != 1)  # Inqueue gate, as _schedulable_rows
+            & (self.j_cnt_pending[srows] > 0)
+            & (self.j_ready_base[srows] < m.j_minav[srows])
+            & (self.j_valid[srows] >= m.j_minav[srows])
+            & (self.q_of_job[srows] >= 0)
+        )
+        cand = srows[mask]
+        uids = {m.j_uid[int(r)] for r in cand}
+        for uid in list(streaks):
+            if uid not in uids:
+                del streaks[uid]
+        for uid in uids:
+            streaks[uid] = streaks.get(uid, 0) + 1
+        # Rejection cooldown: gangs whose last plan was rejected sit
+        # out REBALANCE_REJECT_BACKOFF passes; leaving the starved set
+        # clears the slate.
+        backoff = getattr(store, "_rebalance_backoff", None)
+        if backoff is None:
+            backoff = store._rebalance_backoff = {}
+        for uid in list(backoff):
+            if uid not in uids:
+                del backoff[uid]
+            elif backoff[uid] > 0:
+                backoff[uid] -= 1
+        if not len(cand):
+            return None
+        need_streak = (self.REBALANCE_STREAK_PIPELINED
+                       if self._pipeline_on else 1)
+        need = (m.j_minav[cand] - self.j_ready_base[cand]).astype(np.int64)
+        for r in cand[np.lexsort((cand, -need))]:
+            uid = m.j_uid[int(r)]
+            if streaks.get(uid, 0) >= need_streak \
+                    and backoff.get(uid, 0) <= 0:
+                return int(r)
+        return None
+
+    def _rebalance_backoff_set(self, gang_uid: str) -> None:
+        backoff = getattr(self.store, "_rebalance_backoff", None)
+        if backoff is None:
+            backoff = self.store._rebalance_backoff = {}
+        backoff[gang_uid] = self.REBALANCE_REJECT_BACKOFF
+
+    def _plan_rebalance(self, jrow: int):
+        """Score fragmentation and select a drain set for one starved
+        gang; returns an ``ops.rebalance.RebalancePlan`` or None."""
+        import jax
+
+        from .actions.rebalance import drain_cap, max_unavailable_of
+        from .ops.rebalance import (
+            RebalancePlan,
+            frag_scores,
+            select_drain_set,
+        )
+
+        m = self.m
+        store = self.store
+        Pn = self.Pn
+        with self.tracer.span("rebalance_plan", cat="rebalance",
+                              args={"gang": m.j_uid[jrow]}):
+            need = int(m.j_minav[jrow] - self.j_ready_base[jrow])
+            if need <= 0:
+                return None
+            pend = np.flatnonzero(
+                m.p_alive[:Pn] & (m.p_status[:Pn] == ST_PENDING)
+                & ~m.p_be[:Pn] & (self.jobr == jrow)
+            )
+            if not len(pend):
+                return None
+            gang_rows = pend[np.argsort(m.p_create[pend], kind="stable")]
+            # Distinct profiles of the gang's pending tasks -> dense
+            # [U, R] init-request table (the planner's notion of "a
+            # gang task"; same profile interning _profile_tasks keys
+            # on).
+            _, first = np.unique(m.p_prof[gang_rows], return_index=True)
+            urows = gang_rows[np.sort(first)]
+            # Pad the profile axis to a pow2 bucket (all-zero rows are
+            # inert: no requested slot -> fit 0) so gangs with varying
+            # distinct-profile counts share one compiled kernel.
+            Up = _pow2(max(len(urows), 1), 4)
+            prof_req = np.zeros((Up, self.R), F)
+            er, si, v = m.c_init_req.gather(urows)
+            prof_req[er, si] = v
+            # Migratable victims: Running residents with requests, not
+            # critical (conformance-exempt), without inter-pod terms
+            # (their what-if re-placement would need live term-count
+            # surgery), and never the starved gang itself.
+            vict = np.flatnonzero(
+                self.resident[:Pn]
+                & (m.p_status[:Pn] == ST_RUNNING)
+                & ~m.p_critical[:Pn]
+                & ~m.p_has_ip[:Pn]
+                & (self.jobr >= 0)
+                & (self.jobr != jrow)
+            )
+            if len(vict):
+                vict = vict[m.c_req.lens(vict) > 0]
+            # Node axis padded to the same pow2 bucket _solve_inputs
+            # uses, so node churn (9999 -> 10000 nodes) does not
+            # recompile the kernel on the cycle thread.  Padded rows
+            # are not-ready (frag 0) and zero-capacity (fit 0).
+            Np = _pow2(max(self.Nn, 1))
+            evictable = np.zeros((Np, self.R), F)
+            vnode = np.zeros(0, np.int64)
+            if len(vict):
+                vnode = m.p_node[:Pn][vict].astype(np.int64)
+                er, si, v = m.c_req.gather(vict)
+                np.add.at(evictable, (vnode[er], si), v)
+
+            def padN(a, fill=0):
+                out = np.full((Np, *a.shape[1:]), fill, a.dtype)
+                out[:len(a)] = a
+                return out
+
+            fs = frag_scores(
+                padN(self.n_idle.astype(F)),
+                padN(self.n_alloc.astype(F)),
+                padN(self.n_ready), evictable, prof_req, self.eps,
+            )
+            frag, fit_now, fit_freed = jax.device_get(
+                (fs.frag, fs.fit_now, fs.fit_freed)
+            )
+            frag = frag[:self.Nn]
+            fit_now = fit_now[:self.Nn]
+            fit_freed = fit_freed[:self.Nn]
+            alive = self.n_alive
+            frag_mean = (float(frag[alive].mean())
+                         if alive.any() else 0.0)
+            metrics.rebalance_frag_score.set(frag_mean)
+            # Per-node victim lists only for DRAIN CANDIDATES (frag-
+            # positive nodes whose drain gains capacity): the Python
+            # walk is then bounded by the fragmentation hotspots, not
+            # the cluster's whole Running population.
+            victims_by_node: List[List[int]] = [
+                [] for _ in range(self.Nn)
+            ]
+            victim_group: Dict[int, str] = {}
+            if len(vict):
+                cand_mask = (fit_freed > fit_now) & (frag > 0.0)
+                on_cand = cand_mask[vnode]
+                for row, n in zip(vict[on_cand].tolist(),
+                                  vnode[on_cand].tolist()):
+                    victims_by_node[n].append(row)
+                    victim_group[row] = m.j_uid[int(self.jobr[row])]
+            # Remaining per-group disruption budget after waves already
+            # in flight (PDB max_unavailable equivalent).
+            ledger = store.migrations
+            budget_left: Dict[str, int] = {}
+            for uid in set(victim_group.values()):
+                row = m.j_row.get(uid, -1)
+                pg = m.j_pg[row] if row >= 0 else None
+                used = (ledger.disrupted(store, uid)
+                        if ledger is not None else 0)
+                budget_left[uid] = max_unavailable_of(pg) - used
+            nodes, budget_blocked = select_drain_set(
+                frag, fit_now, fit_freed, need, victims_by_node,
+                victim_group, budget_left, drain_cap(),
+            )
+            if not nodes:
+                if budget_blocked:
+                    self._count_rebalance(
+                        "rejected-budget", gang=m.j_uid[jrow],
+                        need=need, frag=round(frag_mean, 4),
+                    )
+                # Cooldown either way: no drain set can form until the
+                # cluster moves, so re-scoring every cycle is waste.
+                self._rebalance_backoff_set(m.j_uid[jrow])
+                return None
+            victim_rows = np.asarray(
+                [r for n in nodes for r in victims_by_node[n]],
+                np.int64,
+            )
+            budgets: Dict[str, int] = {}
+            for r in victim_rows.tolist():
+                g = victim_group[r]
+                budgets[g] = budgets.get(g, 0) + 1
+            return RebalancePlan(
+                gang_job=int(jrow), gang_uid=m.j_uid[jrow],
+                gang_rows=gang_rows, victim_rows=victim_rows,
+                victim_jobs=self.jobr[victim_rows].astype(np.int64),
+                drain_nodes=np.asarray(nodes, np.int64), need=need,
+                frag_before=frag_mean, budgets=budgets,
+            )
+
+    def _plan_task_order(self, plan):
+        """(solve_jobs, task_rows, victims-in-solve-order) for a plan's
+        what-if solve: the starved gang's pending rows first (it is the
+        point of the migration), then the victims job-contiguously —
+        the order the assignment vector is aligned to."""
+        vorder = np.argsort(plan.victim_jobs, kind="stable")
+        vr = plan.victim_rows[vorder]
+        task_rows = np.concatenate(
+            [plan.gang_rows, vr]).astype(np.int64)
+        solve_jobs = [plan.gang_job]
+        seen = {plan.gang_job}
+        for j in plan.victim_jobs[vorder].tolist():
+            if j not in seen:
+                seen.add(j)
+                solve_jobs.append(int(j))
+        return solve_jobs, task_rows, vr
+
+    def _whatif_inputs(self, plan):
+        """Solver inputs for the hypothetically drained cluster: the
+        drained victims' capacity returns to idle, their rows leave the
+        resident set (ports / affinity counts / task slots), their
+        jobs' ready counts drop and their queues' allocations shrink by
+        the drained members, and queue-deserved gating is lifted for
+        the VICTIM queues only — a victim's re-placement frees exactly
+        what it claims, so re-arbitrating its share would veto a
+        capacity-neutral move, but the starved gang's placement is a
+        genuinely new allocation and keeps the live lane's gating (a
+        share-capped gang must not trigger an eviction wave the live
+        allocate would then veto anyway).  Everything else (devsnap
+        planes, two-phase shortlists, profile dedup) rides
+        ``_solve_inputs`` unchanged, so the plan solve hits the same
+        jit as the live allocate lane."""
+        m = self.m
+        # Deferred aggregate scatters must land on the REAL q_alloc
+        # before it is copied, or they would be lost to the patch.
+        self._flush_aggr()
+        solve_jobs, task_rows, vr = self._plan_task_order(plan)
+        vnode = m.p_node[:self.Pn][plan.victim_rows].astype(np.int64)
+        er, si, v = m.c_req.gather(plan.victim_rows)
+        idle_patch = self.n_idle.copy()
+        np.add.at(idle_patch, (vnode[er], si), v)
+        ntasks_patch = self.n_ntasks - np.bincount(
+            vnode, minlength=self.Nn).astype(I)
+        ready_patch = self.j_ready_base.copy()
+        np.add.at(ready_patch, plan.victim_jobs, -1)
+        resident_patch = self.resident.copy()
+        resident_patch[plan.victim_rows] = False
+        deserved_patch = self.q_deserved.copy()
+        q_alloc_patch = self.q_alloc.copy()
+        vq = self.q_of_job[plan.victim_jobs]
+        vq_ok = vq >= 0
+        if vq_ok.any():
+            deserved_patch[np.unique(vq[vq_ok])] = 3.0e38
+            # Un-charge the drained victims so a gang sharing a
+            # victim's queue is not double-gated against allocations
+            # the solve itself will re-charge on re-placement.
+            er_q = vq_ok[er]
+            np.add.at(q_alloc_patch,
+                      (vq[er][er_q], si[er_q]), -v[er_q])
+        saved = (self.n_idle, self.n_ntasks, self.j_ready_base,
+                 self.resident, self.q_deserved, self.q_alloc)
+        (self.n_idle, self.n_ntasks, self.j_ready_base, self.resident,
+         self.q_deserved, self.q_alloc) = (
+            idle_patch, ntasks_patch, ready_patch, resident_patch,
+            deserved_patch, q_alloc_patch)
+        try:
+            inputs, pid, profiles, ncls = self._solve_inputs(
+                solve_jobs, task_rows, slim=True)
+        finally:
+            (self.n_idle, self.n_ntasks, self.j_ready_base,
+             self.resident, self.q_deserved, self.q_alloc) = saved
+        return inputs, pid, profiles, ncls
+
+    def _dispatch_plan(self, plan) -> None:
+        """Run (or pipeline) the plan's what-if solve."""
+        from .ops.wave import solve_wave
+
+        m = self.m
+        store = self.store
+        # No lanes= here: the action:rebalance span already accumulates
+        # the lane seconds; a second accumulation would double-count.
+        with self.tracer.span(
+                "rebalance_whatif", cat="rebalance",
+                args={"gang": plan.gang_uid,
+                      "victims": len(plan.victim_rows),
+                      "drain_nodes": len(plan.drain_nodes)}):
+            inputs, pid, profiles, ncls = self._whatif_inputs(plan)
+            payload = solve_wave(*inputs, pid=pid, profiles=profiles,
+                                 taint_any=self._taint_any,
+                                 node_classes=ncls)
+            if self._pipeline_on:
+                from .pipeline import InflightPlan
+
+                for arr in (payload.assigned, payload.never_ready):
+                    try:
+                        arr.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                store._solve_seq += 1
+                store._inflight_plan = InflightPlan(
+                    payload, plan, m.mutation_seq, m.epoch,
+                    m.compact_gen, self.Nn, plan_id=store._solve_seq,
+                )
+                return
+            import jax
+
+            assigned, never_ready = jax.device_get(
+                (payload.assigned, payload.never_ready)
+            )
+        self._apply_plan(plan, np.asarray(assigned),
+                         np.asarray(never_ready))
+
+    def _commit_inflight_plan(self) -> None:
+        """Land (or void) the previous cycle's pipelined rebalance plan.
+        A whole-cluster what-if has no per-row salvage, so ANY drift —
+        mutation counter, node-table epoch, compaction generation, node
+        count — voids the plan wholesale (it mutated nothing; the
+        planner re-forms against fresh state)."""
+        from .pipeline import take_inflight_plan
+
+        inflight = take_inflight_plan(self.store)
+        if inflight is None:
+            return
+        m = self.m
+        plan = inflight.plan
+        with self.tracer.span(
+                "rebalance_commit", cat="rebalance", lanes=self.lanes,
+                lane="rebalance",
+                args={"plan_id": inflight.plan_id,
+                      "gang": plan.gang_uid,
+                      "victims": len(plan.victim_rows)}):
+            if (m.mutation_seq != inflight.mutation_seq
+                    or m.epoch != inflight.epoch
+                    or m.compact_gen != inflight.compact_gen
+                    or self.Nn != inflight.n_nodes):
+                inflight.abandon()
+                self._count_rebalance(
+                    "stale-voided", gang=plan.gang_uid,
+                    victims=len(plan.victim_rows))
+                return
+            assigned, never_ready = inflight.fetch()
+            self._apply_plan(plan, assigned, never_ready)
+
+    def _apply_plan(self, plan, assigned: np.ndarray,
+                    never_ready: np.ndarray) -> None:
+        """Judge the what-if verdict and commit iff it strictly improves
+        binds: the gang reaches ready, every victim re-places, and the
+        gain clears the threshold."""
+        from .actions.rebalance import min_gain
+
+        m = self.m
+        _, task_rows, vr_sorted = self._plan_task_order(plan)
+        assigned = assigned[:len(task_rows)].astype(np.int64)
+        G = len(plan.gang_rows)
+        # The gang must still be the pending work the plan targeted
+        # (a pipelined solve landing just above may have bound or a
+        # delete removed rows during the overlap).
+        gr = plan.gang_rows
+        if not bool((m.p_alive[gr]
+                     & (m.p_status[gr] == ST_PENDING)).all()):
+            self._count_rebalance(
+                "stale-voided", gang=plan.gang_uid,
+                victims=len(plan.victim_rows))
+            return
+        gang_assigned = int((assigned[:G] >= 0).sum())
+        victims_ok = (bool((assigned[G:] >= 0).all())
+                      if len(assigned) > G else True)
+        gang_ready = (
+            not bool(never_ready[0])
+            and self.j_ready_base[plan.gang_job] + gang_assigned
+            >= int(m.j_minav[plan.gang_job])
+        )
+        if not (victims_ok and gang_ready
+                and gang_assigned >= min_gain()):
+            self._count_rebalance(
+                "rejected-no-gain", gang=plan.gang_uid,
+                need=plan.need, victims=len(plan.victim_rows),
+                gang_placed=gang_assigned,
+                frag=round(plan.frag_before, 4),
+            )
+            # The identical plan would re-form (and re-fail) next
+            # cycle; cool down until the cluster has had time to move.
+            self._rebalance_backoff_set(plan.gang_uid)
+            return
+        self._commit_rebalance(plan, vr_sorted, assigned[G:])
+
+    def _commit_rebalance(self, plan, victim_rows: np.ndarray,
+                          victim_nodes: np.ndarray) -> None:
+        """Execute a proven plan: evict every victim through the
+        fastpath_evict machinery (flushed to the store at cycle end,
+        exactly as preempt/reclaim evictions are) and register each
+        restore with the migration ledger so no pod is ever lost."""
+        from .actions.rebalance import ledger_of, max_unavailable_of
+
+        m = self.m
+        store = self.store
+        # Exact commit re-check behind the staleness guard: victims
+        # must still be the Running residents the plan drained.
+        ok = (m.p_alive[victim_rows]
+              & (m.p_status[victim_rows] == ST_RUNNING))
+        if not bool(ok.all()):
+            self._count_rebalance(
+                "stale-voided", gang=plan.gang_uid,
+                victims=len(victim_rows))
+            return
+        ledger = ledger_of(store)
+        # Budget re-check at commit time.  Under today's one-wave-at-a-
+        # time gate ``disrupted()`` is structurally 0 here (no plan
+        # forms while entries are live, and nothing else creates
+        # entries), so this reduces to the per-plan victims-per-group
+        # cap — the ledger charge is kept anyway so the invariant
+        # survives a future relaxation of the single-wave gate without
+        # anyone having to remember to add it back.
+        for uid, n_new in plan.budgets.items():
+            row = m.j_row.get(uid, -1)
+            pg = m.j_pg[row] if row >= 0 else None
+            if (ledger.disrupted(store, uid) + n_new
+                    > max_unavailable_of(pg)):
+                self._count_rebalance(
+                    "rejected-budget", gang=plan.gang_uid,
+                    victims=len(victim_rows))
+                return
+        ev = self._evict_machinery()
+        st = ev.st
+        events = []
+        for row, tgt in zip(victim_rows.tolist(),
+                            victim_nodes.tolist()):
+            st.evict(int(row), None)
+            st.evicted_rows.append(int(row))
+            tgt_name = (m.n_name[int(tgt)]
+                        if 0 <= int(tgt) < self.Nn else "")
+            ledger.register(m.p_uid[row],
+                            m.j_uid[int(self.jobr[row])], tgt_name)
+            events.append((
+                f"Pod/{m.p_key[row]}", "Rebalance",
+                f"migrating for gang {plan.gang_uid} "
+                f"(planned node {tgt_name})",
+            ))
+        ledger.committed_plans += 1
+        # Evictions moved mirror state: an overlapping solve dispatch
+        # must re-validate (same stamp preempt/reclaim apply).
+        # volcano_rebalance_evictions_total is counted at the cycle-end
+        # evictor DISPATCH (EvictState.flush), not here — a failed
+        # dispatch reverts the victim, and a counter bumped at commit
+        # would overstate evictions that never happened.
+        m.mutation_seq += 1
+        store.record_events_deferred(events)
+        self._count_rebalance(
+            "committed", gang=plan.gang_uid, need=plan.need,
+            victims=len(victim_rows),
+            drain_nodes=len(plan.drain_nodes),
+            frag=round(plan.frag_before, 4),
+        )
+
+    def _count_rebalance(self, outcome: str, **info) -> None:
+        """Fold a plan outcome into the counter series and the cycle's
+        flight-recorder accounting.  A cycle can see TWO outcomes — a
+        pipelined plan voiding at the top AND the lane's same-cycle
+        re-plan — so earlier outcomes are preserved under ``prior``
+        (the record and the Prometheus counter must agree on totals)."""
+        metrics.rebalance_plans.inc(outcome=outcome)
+        d = {"outcome": outcome}
+        d.update(info)
+        existing = self.stats.get("rebalance")
+        if existing is not None:
+            d["prior"] = existing.pop("prior", []) + [existing]
+        self.stats["rebalance"] = d
 
     # --------------------------------------------------------------- close
 

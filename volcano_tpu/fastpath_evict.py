@@ -311,6 +311,7 @@ class EvictState:
                 except Exception:
                     failed.add(key)
         events = []
+        ledger = getattr(store, "migrations", None)
         for row, key, pod in entries:
             if key in failed:
                 # The pod is NOT terminating.  unevict restores the
@@ -318,6 +319,14 @@ class EvictState:
                 # the session-close status write-back matches reality.
                 pod.deleting = False
                 self.unevict(row, int(m.p_node[row]), int(m.p_job[row]))
+                if ledger is not None:
+                    # A rebalance victim whose eviction never dispatched
+                    # must leave the migration ledger too: a stranded
+                    # entry would pin its group's disruption budget and
+                    # block every future plan (ledger.active), and the
+                    # pod's EVENTUAL normal deletion would wrongly
+                    # "restore" (resurrect) it.
+                    ledger.cancel(pod.uid)
                 events.append((f"Pod/{key}", "EvictFailed",
                                "evict dispatch failed; will retry"))
             else:
@@ -328,6 +337,19 @@ class EvictState:
         if failed:
             log.warning("%d evictions failed; pods revert to Running",
                         len(failed))
+        if ledger is not None:
+            # Rebalance victims whose eviction actually dispatched
+            # (failed ones were cancelled above): the counter must
+            # reflect evictions that happened, not plans that intended
+            # them.
+            n_migrated = sum(
+                1 for _row, key, pod in entries
+                if key not in failed and pod.uid in ledger.entries
+            )
+            if n_migrated:
+                from .metrics import metrics
+
+                metrics.rebalance_evictions.inc(n_migrated)
         store.record_events_deferred(events)
         store.mark_objects_stale()
 

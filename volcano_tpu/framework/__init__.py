@@ -4,6 +4,7 @@ from .arguments import Arguments, get_action_args
 from .conf import (
     DEFAULT_SCHEDULER_CONF,
     DEPLOYED_SCHEDULER_CONF,
+    REBALANCE_SCHEDULER_CONF,
     Configuration,
     PluginOption,
     SchedulerConfiguration,
@@ -25,6 +26,7 @@ __all__ = [
     "get_action_args",
     "DEFAULT_SCHEDULER_CONF",
     "DEPLOYED_SCHEDULER_CONF",
+    "REBALANCE_SCHEDULER_CONF",
     "Configuration",
     "PluginOption",
     "SchedulerConfiguration",

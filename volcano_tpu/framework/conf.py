@@ -129,6 +129,26 @@ tiers:
   - name: nodeorder
 """
 
+# Deployed default plus the device-native rebalance lane (ISSUE 5,
+# docs/rebalance.md): gang-aware defragmentation with disruption
+# budgets.  Separate from DEPLOYED_SCHEDULER_CONF because rebalance
+# evicts running pods — an operator opt-in, as the reference family's
+# descheduler is a separate deployment.
+REBALANCE_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill, rebalance"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
 # Shipped deployment default (installer helm chart config
 # volcano-scheduler.conf: adds conformance + binpack).
 DEPLOYED_SCHEDULER_CONF = """

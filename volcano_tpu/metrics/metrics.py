@@ -236,6 +236,29 @@ class Metrics:
             "plus whole-result voids (compaction, lost-reply, "
             "device-crash)",
         )
+        self.rebalance_plans = _Counter(
+            f"{ns}_rebalance_plans_total",
+            "Rebalance migration plans by outcome: committed (what-if "
+            "solve proved the starved gang places AND every victim "
+            "re-places; evictions dispatched), rejected-no-gain (plan "
+            "solve failed the strict-improvement bar), rejected-budget "
+            "(per-PodGroup disruption budgets blocked an otherwise "
+            "sufficient drain set), stale-voided (store mutated "
+            "between the pipelined plan dispatch and its commit)",
+        )
+        self.rebalance_evictions = _Counter(
+            f"{ns}_rebalance_evictions_total",
+            "Pods evicted by committed rebalance plans (each is "
+            "restored as Pending when its termination completes and "
+            "re-places through the allocate lane)",
+        )
+        self.rebalance_frag_score = _Gauge(
+            f"{ns}_rebalance_frag_score",
+            "Mean per-node fragmentation score at the last rebalance "
+            "planning pass: fraction of idle stranded on nodes unable "
+            "to host any task of the starved gang's profiles (0 = no "
+            "stranded idle, 1 = fully idle yet useless)",
+        )
         # Registry-wide lock sharing: rebind every series to THIS
         # registry's lock (done before any concurrent use) so writers
         # serialize with expose_text's iteration.

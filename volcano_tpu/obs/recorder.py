@@ -45,7 +45,7 @@ class CycleRecord:
         "inflight_fetch_wait_ms", "dispatched_solve_id",
         "committed_solve_id", "mutation_seq_at_dispatch",
         "mutation_seq_at_commit", "epoch_at_dispatch", "epoch_at_commit",
-        "device_events", "error", "spans",
+        "device_events", "error", "spans", "rebalance",
     )
 
     def __init__(self, session: str = "", path: str = "fast",
@@ -63,7 +63,8 @@ class CycleRecord:
                  epoch_at_commit: Optional[int] = None,
                  device_events: Optional[List[str]] = None,
                  error: Optional[str] = None,
-                 spans: Optional[list] = None):
+                 spans: Optional[list] = None,
+                 rebalance: Optional[dict] = None):
         self.seq = -1  # assigned by FlightRecorder.record
         self.session = session
         self.path = path
@@ -84,6 +85,10 @@ class CycleRecord:
         self.device_events = device_events or []
         self.error = error
         self.spans = spans or []
+        # Rebalance lane accounting for the cycle, when the lane ran:
+        # outcome, gang uid, need, drain/victim counts, frag score
+        # (fastpath.FastCycle._rebalance).  None when the lane was idle.
+        self.rebalance = rebalance
 
     def to_dict(self, include_spans: bool = False) -> dict:
         d = {
@@ -108,6 +113,8 @@ class CycleRecord:
             "epoch_at_commit": self.epoch_at_commit,
             "device_events": list(self.device_events),
             "error": self.error,
+            "rebalance": (dict(self.rebalance)
+                          if self.rebalance is not None else None),
         }
         if include_spans:
             d["spans"] = [s.to_dict() for s in self.spans]

@@ -1,0 +1,188 @@
+"""Rebalance planner kernels: gang-aware defragmentation scoring.
+
+The forward-packing lanes (allocate/backfill) and the priority-triggered
+eviction lanes (preempt/reclaim) never *un*-fragment a cluster: once
+small pods are sprinkled across every node, a large gang can be starved
+forever even though the cluster-wide idle sum would cover it many times
+over.  The reference family solves this with a descheduler; Gavel
+(PAPERS.md, arXiv:2008.09213) recomputes whole-cluster placements each
+round and treats the implied migrations as first-class.  This module is
+the TPU-native version of that lever's *scoring* half:
+
+- ``frag_scores`` — one jitted pass over the node planes producing, per
+  node: a fragmentation score (idle-rich but unable to host any task of
+  the starved gang's profiles), the gang-task capacity of the node's
+  idle as-is, and the capacity after hypothetically draining the node's
+  migratable pods.  Runs on the same device-resident planes the wave
+  solver consumes (idle / allocatable and the evictable plane built
+  from the mirror), so scoring 50k nodes is one kernel dispatch, not a
+  host walk.
+- ``select_drain_set`` — the deterministic host-side greedy over the
+  fetched score vectors: cheapest-to-drain nodes first, per-PodGroup
+  disruption budgets charged as nodes are taken, stopping as soon as
+  the freed capacity covers the gang's outstanding need (or the drain
+  cap is hit).
+
+The *placement* half of the plan is not re-derived here: the fast path
+runs a what-if ``solve_wave`` over the hypothetically drained cluster
+(``fastpath.FastCycle._rebalance``), so the plan solve rides the exact
+jit (two-phase shortlists included) the live allocate lane uses.
+
+``oracle.oracle_rebalance`` is the deliberately naive Go-shaped
+re-implementation of both halves; tests require agreement.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+F = np.float32
+I = np.int32
+
+
+class FragScores(NamedTuple):
+    """Per-node planner vectors (device arrays until fetched)."""
+
+    frag: jnp.ndarray       # [N] f32 fragmentation score in [0, 1]
+    fit_now: jnp.ndarray    # [N] i32 gang tasks the node's idle holds now
+    fit_freed: jnp.ndarray  # [N] i32 gang tasks after draining evictables
+
+
+class RebalancePlan(NamedTuple):
+    """A drain set plus the what-if solve's bookkeeping, built host-side
+    by ``FastCycle._rebalance`` and either committed synchronously or
+    parked as ``pipeline.InflightPlan`` for the next cycle."""
+
+    gang_job: int                # mirror job row of the starved gang
+    gang_uid: str                # its PodGroup uid (events / ledger)
+    gang_rows: np.ndarray        # [G] pending mirror rows entering the solve
+    victim_rows: np.ndarray      # [V] running mirror rows to migrate
+    victim_jobs: np.ndarray      # [V] mirror job rows of the victims
+    drain_nodes: np.ndarray      # [K] node rows hypothetically drained
+    need: int                    # gang tasks outstanding at plan time
+    frag_before: float           # mean frag score over alive nodes
+    budgets: Dict[str, int]      # group uid -> victims this plan takes
+
+
+@partial(jax.jit, static_argnames=())
+def frag_scores(idle, allocatable, ready, evictable, prof_req, eps):
+    """Fragmentation planes for one starved gang.
+
+    ``idle``/``allocatable``/``evictable``: [N, R] f32 node planes
+    (evictable = summed requests of the node's migratable Running pods);
+    ``ready``: [N] bool; ``prof_req``: [U, R] f32 per-profile init
+    requests of the gang's pending tasks; ``eps``: [R] f32 tolerance.
+
+    Returns ``FragScores``.  Definitions (mirrored exactly by
+    ``oracle.oracle_rebalance``):
+
+    - per (node, profile) fit count = min over requested slots of
+      ``floor((plane + eps) / req)``, 0 when any requested slot is
+      absent; ``fit_*`` takes the MAX over profiles (the planner frees
+      whole nodes, so "how many of the easiest profile fit" is the
+      capacity that matters).
+    - ``frag`` = mean idle fraction over provisioned slots, zeroed on
+      nodes that are not ready, hold no idle, or can already host a
+      gang task (their idle is not stranded).
+    """
+    idle = idle.astype(jnp.float32)
+    alloc = allocatable.astype(jnp.float32)
+    ev = evictable.astype(jnp.float32)
+    req = prof_req.astype(jnp.float32)
+    eps = eps.astype(jnp.float32)
+
+    requested = req > eps[None, :]  # [U, R]
+
+    def fit_of(plane):
+        # [N, U, R] per-slot counts; non-requested slots are inert.
+        per = jnp.floor(
+            (plane[:, None, :] + eps[None, None, :])
+            / jnp.maximum(req[None, :, :], 1e-9)
+        )
+        per = jnp.where(requested[None, :, :], per, jnp.float32(2 ** 30))
+        cnt = jnp.min(per, axis=-1)  # [N, U]
+        cnt = jnp.where(jnp.any(requested, axis=-1)[None, :], cnt, 0.0)
+        return jnp.max(jnp.maximum(cnt, 0.0), axis=-1).astype(jnp.int32)
+
+    fit_now = fit_of(idle)
+    fit_freed = fit_of(idle + ev)
+
+    provisioned = alloc > eps[None, :]
+    frac = jnp.where(provisioned,
+                     jnp.clip(idle / jnp.maximum(alloc, 1e-9), 0.0, 1.0),
+                     0.0)
+    nprov = jnp.maximum(provisioned.sum(axis=-1), 1)
+    idle_frac = frac.sum(axis=-1) / nprov
+    has_idle = jnp.any(idle > eps[None, :], axis=-1)
+    frag = jnp.where(ready & has_idle & (fit_now == 0), idle_frac, 0.0)
+    return FragScores(frag=frag.astype(jnp.float32),
+                      fit_now=fit_now, fit_freed=fit_freed)
+
+
+def select_drain_set(
+    frag: np.ndarray,
+    fit_now: np.ndarray,
+    fit_freed: np.ndarray,
+    need: int,
+    victims_by_node: Sequence[Sequence[int]],
+    victim_group: Dict[int, str],
+    budget_left: Dict[str, int],
+    drain_cap: int,
+) -> Tuple[List[int], bool]:
+    """Deterministic greedy drain-set selection over fetched planes.
+
+    ``victims_by_node[n]``: migratable Running rows resident on node n;
+    ``victim_group[row]``: PodGroup uid of a victim row;
+    ``budget_left[uid]``: remaining disruption budget per group (plans
+    in flight already subtracted).  Mutates nothing.
+
+    A node is a candidate iff draining it gains gang capacity
+    (``fit_freed > fit_now``) and it holds at least one victim.
+    Candidates are taken cheapest-first — key ``(len(victims), -gain,
+    node)`` — each charged against its victims' group budgets; a node
+    whose victims would overdraw any budget is skipped.  Selection
+    stops when the accumulated gain covers ``need`` or ``drain_cap``
+    nodes are taken.
+
+    Returns ``(nodes, budget_blocked)``: the chosen node list (empty
+    when the need cannot be covered) and whether budget exhaustion —
+    rather than capacity or the drain cap — is what blocked an
+    otherwise sufficient plan (i.e. the same greedy with unlimited
+    budgets, under the same cap, would have covered the need).
+    """
+    gain = fit_freed.astype(np.int64) - fit_now.astype(np.int64)
+    cand = [
+        int(n) for n in np.flatnonzero((gain > 0) & (frag > 0.0))
+        if victims_by_node[int(n)]
+    ]
+    cand.sort(key=lambda n: (len(victims_by_node[n]), -int(gain[n]), n))
+    left = dict(budget_left)
+    chosen: List[int] = []
+    acc = 0
+    skipped_for_budget = False
+    for n in cand:
+        if acc >= need or len(chosen) >= drain_cap:
+            break
+        charges: Dict[str, int] = {}
+        for row in victims_by_node[n]:
+            g = victim_group[row]
+            charges[g] = charges.get(g, 0) + 1
+        if any(left.get(g, 0) < c for g, c in charges.items()):
+            skipped_for_budget = True
+            continue
+        for g, c in charges.items():
+            left[g] = left.get(g, 0) - c
+        chosen.append(n)
+        acc += int(gain[n])
+    if acc < need:
+        # Distinguish "budgets blocked it" from "capacity / drain cap
+        # cannot cover" for the plans_total outcome label: re-run the
+        # same greedy with unlimited budgets under the same cap.
+        unbudgeted = int(sum(int(gain[n]) for n in cand[:drain_cap]))
+        return [], bool(skipped_for_budget and unbudgeted >= need)
+    return chosen, False

@@ -397,6 +397,153 @@ def oracle_enqueue(min_res, queue_of_group, group_order, idle_budget,
     return inqueue
 
 
+class RebalanceVerdict(NamedTuple):
+    frag: np.ndarray          # [N] f32 fragmentation score
+    fit_now: np.ndarray       # [N] i64 gang tasks idle holds now
+    fit_freed: np.ndarray     # [N] i64 gang tasks after draining
+    drain_nodes: np.ndarray   # [K] chosen node indices (selection order)
+    feasible: bool            # drain set covers the need within budgets
+    budget_blocked: bool      # budgets (not capacity) blocked the plan
+
+
+def oracle_rebalance(idle, allocatable, ready, evictable, prof_req, eps,
+                     need, victims_by_node, victim_group, budget_left,
+                     drain_cap) -> RebalanceVerdict:
+    """Go-shaped reference for the rebalance planner's scoring +
+    drain-set selection (``ops/rebalance.py``): object-at-a-time loops
+    over nodes, profiles and victims, no vectorization.  The fast
+    planner must agree exactly on ``frag``/``fit_*`` and on the chosen
+    drain set (tests/test_rebalance.py parity).
+
+    Definitions (shared spec with ``ops.rebalance.frag_scores`` /
+    ``select_drain_set``):
+
+    - per (node, profile) fit = min over requested slots of
+      ``floor((plane + eps) / req)``; a profile requesting nothing fits
+      0; the node's fit is the max over profiles.
+    - frag = mean idle fraction over provisioned slots, zero unless the
+      node is ready, holds idle, and fits no gang task as-is.
+    - selection: candidates (gain > 0, frag > 0, has victims) sorted by
+      ``(victim count, -gain, node)``; each charged against per-group
+      budgets; stop at ``need`` covered or ``drain_cap`` taken; an
+      uncoverable need returns an empty set.
+    """
+    idle = np.asarray(idle, np.float32)
+    alloc = np.asarray(allocatable, np.float32)
+    ev = np.asarray(evictable, np.float32)
+    req = np.asarray(prof_req, np.float32)
+    eps = np.asarray(eps, np.float32)
+    ready = np.asarray(ready, bool)
+    N, R = idle.shape
+    U = req.shape[0]
+
+    def fit_one(plane_row, req_row):
+        cnt = None
+        any_req = False
+        for r in range(R):
+            if req_row[r] <= eps[r]:
+                continue
+            any_req = True
+            c = int(np.floor((plane_row[r] + eps[r]) / max(req_row[r], 1e-9)))
+            cnt = c if cnt is None else min(cnt, c)
+        if not any_req:
+            return 0
+        return max(cnt, 0)
+
+    fit_now = np.zeros(N, np.int64)
+    fit_freed = np.zeros(N, np.int64)
+    frag = np.zeros(N, np.float32)
+    for n in range(N):
+        best_now = 0
+        best_freed = 0
+        for u in range(U):
+            best_now = max(best_now, fit_one(idle[n], req[u]))
+            best_freed = max(best_freed, fit_one(idle[n] + ev[n], req[u]))
+        fit_now[n] = best_now
+        fit_freed[n] = best_freed
+        prov = [r for r in range(R) if alloc[n][r] > eps[r]]
+        if not prov:
+            idle_frac = 0.0
+        else:
+            idle_frac = sum(
+                min(max(idle[n][r] / max(alloc[n][r], 1e-9), 0.0), 1.0)
+                for r in prov
+            ) / len(prov)
+        has_idle = any(idle[n][r] > eps[r] for r in range(R))
+        if ready[n] and has_idle and best_now == 0:
+            frag[n] = np.float32(idle_frac)
+
+    # Selection, re-derived independently of select_drain_set's
+    # sort-then-walk: repeatedly SCAN all remaining candidates for the
+    # best next node by the shared key spec (victim count asc, gain
+    # desc, index asc), charging budgets per victim as it goes.  A
+    # defect in either formulation (sort order, skip handling, budget
+    # charge) diverges here instead of being cloned.
+    gain = fit_freed - fit_now
+
+    def is_cand(n):
+        return gain[n] > 0 and frag[n] > 0.0 and bool(victims_by_node[n])
+
+    def best_next(taken):
+        best = None
+        for n in range(N):
+            if n in taken or not is_cand(n):
+                continue
+            key = (len(victims_by_node[n]), -int(gain[n]), n)
+            if best is None or key < best[0]:
+                best = (key, n)
+        return None if best is None else best[1]
+
+    left = dict(budget_left)
+    chosen = []
+    taken = set()
+    acc = 0
+    skipped = False
+    while acc < need and len(chosen) < drain_cap:
+        n = best_next(taken)
+        if n is None:
+            break
+        taken.add(n)
+        overdraw = False
+        charges = {}
+        for row in victims_by_node[n]:
+            g = victim_group[row]
+            charges[g] = charges.get(g, 0) + 1
+        for g, c in charges.items():
+            if left.get(g, 0) < c:
+                overdraw = True
+        if overdraw:
+            skipped = True
+            continue
+        for g, c in charges.items():
+            left[g] = left.get(g, 0) - c
+        chosen.append(n)
+        acc += int(gain[n])
+    feasible = acc >= need
+    if not feasible:
+        # Budget-blocked only when the same greedy with unlimited
+        # budgets, under the same cap, would have covered the need —
+        # again re-derived as a scan loop.
+        taken2 = set()
+        unbudgeted = 0
+        while len(taken2) < drain_cap:
+            n = best_next(taken2)
+            if n is None:
+                break
+            taken2.add(n)
+            unbudgeted += int(gain[n])
+        return RebalanceVerdict(
+            frag=frag, fit_now=fit_now, fit_freed=fit_freed,
+            drain_nodes=np.zeros(0, np.int64), feasible=False,
+            budget_blocked=bool(skipped and unbudgeted >= need),
+        )
+    return RebalanceVerdict(
+        frag=frag, fit_now=fit_now, fit_freed=fit_freed,
+        drain_nodes=np.asarray(chosen, np.int64), feasible=True,
+        budget_blocked=False,
+    )
+
+
 def oracle_backfill(be_feasible, group_inqueue, task_group):
     """backfill.go:39-88: zero-request pending tasks of Inqueue groups
     place on the first feasible node in index order (no resource charge

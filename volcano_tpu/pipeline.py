@@ -141,3 +141,76 @@ def abandon_inflight(store) -> bool:
              len(inflight.task_rows))
     inflight.abandon()
     return True
+
+
+class InflightPlan:
+    """A dispatched-but-uncommitted rebalance what-if solve (the plan of
+    cycle N, committed — or voided — at the top of cycle N+1).
+
+    The what-if ``solve_wave`` over the hypothetically drained cluster
+    rides the same pipelining as the allocate dispatch: the device round
+    trip overlaps the dispatching cycle's close and the next cycle's
+    host lanes.  Unlike ``InflightSolve``, a stale plan commits NOTHING
+    — a whole-cluster what-if has no per-row salvage (partial commit
+    would evict victims whose replacement placement was never proven),
+    so any ``mutation_seq``/``epoch``/``compact_gen``/node-count drift
+    voids it wholesale (``volcano_rebalance_plans_total``
+    outcome=stale-voided) and the planner simply re-plans against fresh
+    state next cycle.  Nothing is lost either way: a plan only mutates
+    the store at COMMIT time.
+    """
+
+    __slots__ = (
+        "payload", "plan", "mutation_seq", "epoch", "compact_gen",
+        "n_nodes", "plan_id",
+    )
+
+    def __init__(self, payload, plan, mutation_seq: int, epoch: int,
+                 compact_gen: int, n_nodes: int, plan_id: int = 0):
+        # A local jax AllocResult (copy_to_host_async already issued).
+        self.payload = payload
+        # ops.rebalance.RebalancePlan (host-side drain bookkeeping).
+        self.plan = plan
+        self.mutation_seq = mutation_seq
+        self.epoch = epoch
+        self.compact_gen = compact_gen
+        self.n_nodes = n_nodes
+        self.plan_id = plan_id
+
+    def fetch(self):
+        """Block on the remaining round trip; returns (assigned [P],
+        never_ready [J]) as numpy."""
+        import jax
+
+        assigned, never_ready = jax.device_get(
+            (self.payload.assigned, self.payload.never_ready)
+        )
+        return np.asarray(assigned), np.asarray(never_ready)
+
+    def abandon(self) -> None:
+        """Drop the pending plan without committing it (device futures
+        lose their last reference; nothing was mutated store-side)."""
+        self.payload = None
+
+
+def take_inflight_plan(store) -> Optional[InflightPlan]:
+    """Pop the store's in-flight rebalance plan (None when no plan is
+    pending).  Same locking contract as ``take_inflight``."""
+    with store._lock:
+        inflight = getattr(store, "_inflight_plan", None)
+        if inflight is not None:
+            store._inflight_plan = None
+    return inflight
+
+
+def abandon_inflight_plan(store) -> bool:
+    """Drop a pending rebalance plan, if any (shutdown / object-path
+    fallback: plans mutate nothing until committed, so this is free).
+    Returns True when one was abandoned."""
+    inflight = take_inflight_plan(store)
+    if inflight is None:
+        return False
+    log.info("abandoning in-flight rebalance plan of %d victims",
+             len(inflight.plan.victim_rows))
+    inflight.abandon()
+    return True

@@ -212,9 +212,12 @@ class Scheduler:
             # would double-schedule when the fast path later committed
             # the stale assignment.  Abandoning is safe — the pods
             # re-place on whichever path runs this cycle.
-            from .pipeline import abandon_inflight
+            from .pipeline import abandon_inflight, abandon_inflight_plan
 
             abandon_inflight(self.store)
+            # A parked rebalance plan is also fast-path-only state; it
+            # mutates nothing until committed, so dropping it is free.
+            abandon_inflight_plan(self.store)
             # The object session snapshots pod RECORDS as scheduling
             # truth: force any deferred bind-record walks (node_name on
             # committed pods, normally applied post-cycle by the bind
@@ -400,6 +403,7 @@ class Scheduler:
                 self._thread = None
         # Only after the thread is dead: the cycle thread owns the
         # in-flight handle while it runs.
-        from .pipeline import abandon_inflight
+        from .pipeline import abandon_inflight, abandon_inflight_plan
 
         abandon_inflight(self.store)
+        abandon_inflight_plan(self.store)

@@ -21,10 +21,23 @@ log = logging.getLogger(__name__)
 
 class ClusterSimulator:
     """Steps pod lifecycles: bound pods start running; deleting pods
-    terminate; optional completion/failure injection."""
+    terminate (through an optional Terminating grace window); optional
+    completion/failure injection.
 
-    def __init__(self, store: ClusterStore):
+    ``grace_steps``: eviction grace period in kubelet ticks.  A deleting
+    pod passes through Terminating for that many steps before the delete
+    lands and its capacity frees — the real capacity-not-yet-free window
+    migration e2e must exercise (a rebalance eviction's node stays
+    charged until termination completes, exactly as a kubelet honors
+    terminationGracePeriodSeconds).  0 (the default) keeps the historic
+    instant-delete behavior.
+    """
+
+    def __init__(self, store: ClusterStore, grace_steps: int = 0):
         self.store = store
+        self.grace_steps = max(int(grace_steps), 0)
+        # uid -> remaining Terminating ticks for deleting pods.
+        self._terminating: Dict[str, int] = {}
 
     def step(
         self,
@@ -34,12 +47,27 @@ class ClusterSimulator:
 
         ``complete(pod)`` may return an exit code for running pods: 0 ->
         Succeeded, nonzero -> Failed, None -> keep running.
-        Returns counts of transitions applied.
+        Returns counts of transitions applied (``terminating`` counts
+        deleting pods still inside their grace window this tick).
         """
-        started = finished = deleted = 0
+        started = finished = deleted = terminating = 0
+        if self._terminating:  # skip the O(pods) set on the common path
+            live = {p.uid for p in self.store.pods.values()}
+            for uid in list(self._terminating):
+                if uid not in live:  # deleted out-of-band
+                    del self._terminating[uid]
         for pod in list(self.store.pods.values()):
             if pod.deleting:
+                left = self._terminating.get(pod.uid)
+                if left is None:
+                    left = self.grace_steps
+                if left > 0:
+                    # Still Terminating: capacity stays charged.
+                    self._terminating[pod.uid] = left - 1
+                    terminating += 1
+                    continue
                 # Termination completes: the pod object goes away.
+                self._terminating.pop(pod.uid, None)
                 self.store.delete_pod(pod)
                 deleted += 1
                 continue
@@ -60,7 +88,12 @@ class ClusterSimulator:
                 )
                 self.store.update_pod(updated)
                 finished += 1
-        return {"started": started, "finished": finished, "deleted": deleted}
+        return {
+            "started": started,
+            "finished": finished,
+            "deleted": deleted,
+            "terminating": terminating,
+        }
 
     def fail_pod(self, uid: str, exit_code: int = 1) -> None:
         """Inject a pod failure (fault injection; the reference's e2e kills
