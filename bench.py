@@ -42,6 +42,18 @@ Perfetto-loadable trace file (flight-recorder cycles, BENCH_TRACE_DIR;
 default /tmp/vtpu_bench_traces) and reports staleness-drop totals plus
 per-lane p50/p95 (steady-state cycles only) in the machine-readable
 JSON tail.
+
+BENCH_MESH=<devices> (ISSUE 7) A/Bs the mesh-native sharded solve in
+one run: the process forces a virtual CPU platform with that many host
+devices (must be set at startup — the flag is baked into XLA client
+init), then the selected config executes twice — "(mesh on)" with every
+store's ``solve_mesh`` set (node axis + count tensors sharded, sharded
+devsnap, shard-local two-phase rankings) and "(mesh off)" plain — each
+emitting its JSON tail with the usual lane split, plus one extra
+"mesh winner-reduce" JSON line microbenching the cross-chip reduction
+(the two-stage shard-local top-k vs the global top-k on the same
+sharded plane).  Host-device simulation quantifies the decomposition;
+the real win is the per-chip memory/compute split on a TPU slice.
 """
 
 import json
@@ -58,6 +70,9 @@ NORTH_STAR_PODS = 100000
 # name, so one run carries both "(shortlist on)"/"(shortlist off)" JSON
 # tails (see main()).
 _MODE_SUFFIX = ""
+# BENCH_MESH A/B driver state: the jax.sharding.Mesh the benched stores
+# dispatch over ("(mesh on)" pass), or None for the plain pass.
+_MESH = None
 
 
 @contextmanager
@@ -174,6 +189,8 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
     async_bind = os.environ.get("BENCH_SYNC_BIND") != "1"
     store = warm_store if warm_store is not None else make_store(0)
     store.async_bind = async_bind
+    if _MESH is not None:
+        store.solve_mesh = _MESH
     binder = store.binder
     t0 = time.perf_counter()
     Scheduler(store, conf_str=conf).run_once()
@@ -188,6 +205,8 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
     for r in range(repeats):
         store_r = make_store(r + 1)
         store_r.async_bind = async_bind
+        if _MESH is not None:
+            store_r.solve_mesh = _MESH
         sched_r = Scheduler(store_r, conf_str=conf)
         t0 = time.perf_counter()
         sched_r.run_once()
@@ -230,6 +249,10 @@ def _pipelined_bench(make_store, conf, cycles=None):
     store = make_store(0)
     store.async_bind = os.environ.get("BENCH_SYNC_BIND") != "1"
     store.pipeline = True
+    if _MESH is not None:
+        # Pipelined dispatch works under a mesh (ISSUE 7): the parked
+        # InflightSolve's arrays live sharded across the chips.
+        store.solve_mesh = _MESH
     fed = {"total": 0}
 
     def feed(fc):
@@ -650,6 +673,57 @@ def config_rebalance():
     store.close()
 
 
+def _emit_mesh_microbench(mesh):
+    """One JSON line quantifying the cross-chip reduce of the sharded
+    selection: the two-stage shard-local top-k (winner reduction over
+    [U, shards*K] (score, node id) pairs) vs the global top-k, both on
+    the SAME node-sharded score plane at the config's node count."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import volcano_tpu.ops.wave as wave
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 10000))
+    np_pad = 1 << max(0, (n_nodes - 1).bit_length())
+    n_dev = int(mesh.devices.size)
+    if np_pad % n_dev:
+        return
+    u_rows = 256
+    k = wave.shortlist_size(np_pad)
+    rng = np.random.default_rng(0)
+    scores = rng.normal(size=(u_rows, np_pad)).astype(np.float32)
+    sharded = jax.device_put(scores, NamedSharding(mesh, P(None, "nodes")))
+    two = jax.jit(lambda x: wave._topk_nodes(x, k, n_dev))
+    glb = jax.jit(lambda x: wave._topk_nodes(x, k, 1))
+
+    def best_of(fn, arg, n=5):
+        fn(arg).block_until_ready()  # compile + warm
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn(arg).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return min(ts) * 1e3
+
+    reduce_ms = best_of(two, sharded)
+    global_ms = best_of(glb, sharded)
+    print(json.dumps({
+        "metric": f"mesh winner-reduce microbench{_MODE_SUFFIX}",
+        "value": round(reduce_ms, 3),
+        "unit": "ms",
+        "mesh": {
+            "devices": n_dev,
+            "n_nodes_padded": np_pad,
+            "profiles": u_rows,
+            "shortlist_k": k,
+            "shard_local_topk_ms": round(reduce_ms, 3),
+            "global_topk_ms": round(global_ms, 3),
+        },
+    }))
+
+
 def _run_selected(raw, repeats):
     if raw == "north":
         config_north(repeats)
@@ -675,7 +749,7 @@ def _run_selected(raw, repeats):
 
 
 def main():
-    global _MODE_SUFFIX
+    global _MODE_SUFFIX, _MESH
     raw = os.environ.get("BENCH_CONFIG", "north")
     # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
     # between runs, and the minimum is the stable estimator.
@@ -684,6 +758,29 @@ def main():
         # Fragmented-cluster defragmentation lane (ISSUE 5): its own
         # scenario, not a mode of the five configs.
         config_rebalance()
+        return
+    mesh_raw = os.environ.get("BENCH_MESH")
+    if mesh_raw:
+        # Mesh A/B (ISSUE 7): force the virtual multi-device CPU host
+        # BEFORE anything touches jax, then run the config mesh-on and
+        # mesh-off plus the winner-reduce microbench.
+        try:
+            n_dev = max(2, int(mesh_raw))
+        except ValueError:
+            n_dev = 4
+        from volcano_tpu.virtualcpu import force_virtual_cpu_platform
+
+        force_virtual_cpu_platform(n_dev)
+        from volcano_tpu.parallel import make_mesh
+
+        for on in (True, False):
+            _MODE_SUFFIX = " (mesh on)" if on else " (mesh off)"
+            _MESH = make_mesh(n_dev, platform="cpu") if on else None
+            if on:
+                _emit_mesh_microbench(_MESH)
+            _run_selected(raw, repeats)
+        _MODE_SUFFIX = ""
+        _MESH = None
         return
     ab = os.environ.get("BENCH_TOPK")
     if ab:

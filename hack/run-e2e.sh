@@ -9,6 +9,13 @@ hack/run-checks.sh
 # The pipelined-mode pass (tests/test_pipeline.py: double-buffered
 # sessions over the remote-solver split, overlap-correctness gate) runs
 # inside run-checks.sh's tier-1 leg above — not repeated here.
+# BENCH_MESH smoke (ISSUE 7): the mesh-native sharded solve A/B on a
+# forced 4-device virtual-CPU host at a small shape — asserts the mesh
+# pass completes, pipelines, and emits its JSON tail (plain vs mesh,
+# lane splits, winner-reduce microbench).
+BENCH_MESH=4 BENCH_CONFIG=2 BENCH_NODES=256 BENCH_PODS=2048 \
+  BENCH_REPEATS=1 BENCH_PIPE_CYCLES=5 JAX_PLATFORMS=cpu \
+  python bench.py
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

@@ -37,6 +37,10 @@ DEVICE_FNS = {
     "sharded_solve_wave_cycle", "sharded_solve", "device_put",
     "_scatter_rows", "_scatter_cnt0", "_scatter_profile_tables",
     "solve_fn", "solve_async", "_coarse_shortlist", "frag_scores",
+    # Mesh-native sharded solve (ISSUE 7): the shard-local ranking /
+    # winner-reduction helper and the cycle's mesh dispatch both return
+    # device values.
+    "_topk_nodes", "_solve_mesh_dispatch",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -77,6 +81,9 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
     "volcano_tpu/fastpath.py": [
         HotEntry("FastCycle._allocate"),
         HotEntry("FastCycle._dispatch_async"),
+        # Mesh dispatch lane (ISSUE 7): wraps sharded_solve_wave_cycle
+        # on the cycle thread for both the sync and pipelined paths.
+        HotEntry("FastCycle._solve_mesh_dispatch"),
         HotEntry("FastCycle._commit_inflight"),
         HotEntry("FastCycle._commit"),
         HotEntry("FastCycle._solve_inputs"),
@@ -107,6 +114,10 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
     "volcano_tpu/ops/devsnap.py": [
         HotEntry("DeviceSnapshot.node_planes"),
         HotEntry("DeviceSnapshot.class_tables"),
+        # Mesh-aware placement helpers (ISSUE 7): commit planes/deltas
+        # with the node-axis sharding on the cycle thread.
+        HotEntry("DeviceSnapshot._put_plane"),
+        HotEntry("DeviceSnapshot._put_delta"),
     ],
     "volcano_tpu/ops/nodeclass.py": [
         # Host-only by contract (numpy planes in, numpy planes out);

@@ -175,6 +175,13 @@ class ClusterStore:
         # InflightPlan): same ownership/locking contract as the solve
         # slot above.
         self._inflight_plan = None  # guarded-by: _lock (any-receiver)
+        # Mesh-path persistent plane cache (parallel/mesh.py
+        # shard_wave_inputs): epoch-keyed per-device placements of the
+        # epoch-stable planes the sharded devsnap does not own (e.g.
+        # aff.node_dom).  Written by the cycle thread (FastCycle runs
+        # under _lock), cleared by close() and pod-table compaction —
+        # a declared, lock-guarded slot, not an ad-hoc attribute.
+        self._mesh_plane_cache: Dict = {}  # guarded-by: _lock (any-receiver)
         # Migration ledger (actions/rebalance.py MigrationLedger),
         # attached by the rebalance lane's first committed plan; the
         # delete_pod hook below restores terminating victims through it.
@@ -372,6 +379,10 @@ class ClusterStore:
         # rebalance plan mutates nothing until committed — drop it too.
         abandon_inflight(self)
         abandon_inflight_plan(self)
+        with self._lock:
+            # Mesh plane cache pins per-device arrays across cycles;
+            # a closed store must release them with everything else.
+            self._mesh_plane_cache.clear()
         if self._bind_dispatcher is not None:
             self._bind_dispatcher.stop()
             self._bind_dispatcher = None
@@ -609,8 +620,13 @@ class ClusterStore:
                 self.bind_backoff.pop(
                     f"{pod.namespace}/{pod.name}", None
                 )
+            gen0 = self.mirror.compact_gen
             self.mirror.remove_pod(pod.uid)
             self.mirror.maybe_compact()
+            if self.mirror.compact_gen != gen0 and self._mesh_plane_cache:
+                # Compaction renumbers rows and voids in-flight device
+                # state wholesale; parked mesh placements resync too.
+                self._mesh_plane_cache.clear()
             self._notify("Pod", "delete", pod)
             if self.migrations is not None and old is not None:
                 # A terminating rebalance victim restores as a fresh

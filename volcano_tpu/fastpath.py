@@ -880,7 +880,10 @@ class FastCycle:
         cycle's lane split (device_coarse / device_fine sub-lanes of the
         device lane) and the trace event stream — these are the
         host-side dispatch legs; the residual device wait stays on the
-        fetch that consumes the result."""
+        fetch that consumes the result.  Mesh dispatches annotate both
+        events (and the cycle stats) with the node-axis shard count, so
+        a trace distinguishes the per-shard sub-lanes from single-device
+        ones."""
         from .ops import wave as _wave_mod
 
         info = _wave_mod.LAST_TWOPHASE
@@ -889,6 +892,10 @@ class FastCycle:
         lanes = self.lanes
         coarse = float(info.get("coarse_s", 0.0))
         fine = float(info.get("fine_s", 0.0))
+        shards = int(info.get("mesh_shards", 1) or 1)
+        args = {"mesh_shards": shards} if shards > 1 else None
+        if shards > 1:
+            self.stats["mesh_shards"] = shards
         lanes["device_coarse"] = lanes.get("device_coarse", 0.0) + coarse
         lanes["device_fine"] = lanes.get("device_fine", 0.0) + fine
         now = time.perf_counter_ns()
@@ -896,12 +903,12 @@ class FastCycle:
             self.tracer.event(
                 "device_coarse", "device",
                 now - int((coarse + fine) * 1e9), int(coarse * 1e9),
-                tid="cycle",
+                tid="cycle", args=args,
             )
         if fine > 0:
             self.tracer.event(
                 "device_fine", "device", now - int(fine * 1e9),
-                int(fine * 1e9), tid="cycle",
+                int(fine * 1e9), tid="cycle", args=args,
             )
 
     def _evict_machinery(self):
@@ -1249,15 +1256,22 @@ class FastCycle:
             try:
                 chunks = list(self._solve_chunks(solve_jobs, task_rows))
                 remote = getattr(store, "remote_solver", None)
-                mesh = getattr(store, "solve_mesh", None)
+                from .parallel.mesh import mesh_from_env
+
+                # store.solve_mesh, or the VOLCANO_TPU_MESH deploy knob
+                # (docs/tuning.md); resolves once per store.
+                mesh = mesh_from_env(store)
                 # Pipelined dispatch (ISSUE 1): a single-chunk wave
                 # solve is shipped WITHOUT blocking on the result; the
                 # commit lands at the top of the next cycle.  Chunked
                 # solves stay synchronous — later chunks must see
-                # earlier chunks' placements — and the mesh path keeps
-                # its own sharded dispatch.
+                # earlier chunks' placements.  The mesh path pipelines
+                # too (ISSUE 7): the InflightSolve payload is simply an
+                # AllocResult whose arrays live sharded on the mesh, and
+                # fetch()'s jax.device_get assembles them — the
+                # staleness guard is host-side numpy either way.
                 if (self._pipeline_on and solver == "wave"
-                        and mesh is None and len(chunks) == 1):
+                        and len(chunks) == 1):
                     cjobs, crows = chunks[0]
                     had_aff_chunks |= self._chunks_had_terms
                     with tracer.span("encode", lanes=lanes):
@@ -1280,11 +1294,15 @@ class FastCycle:
                             payload = remote.solve_async(inputs, pid,
                                                          profiles)
                         else:
-                            payload = solve_fn(*inputs, pid=pid,
-                                               profiles=profiles,
-                                               taint_any=self._taint_any,
-                                               node_classes=ncls)
-                            self._record_twophase_lanes()
+                            if mesh is not None:
+                                payload = self._solve_mesh_dispatch(
+                                    mesh, inputs, pid, profiles, ncls)
+                            else:
+                                payload = solve_fn(
+                                    *inputs, pid=pid, profiles=profiles,
+                                    taint_any=self._taint_any,
+                                    node_classes=ncls)
+                                self._record_twophase_lanes()
                             # Start the device->host transfer now; the
                             # fetch at the next cycle's top only waits
                             # for whatever is still in flight.
@@ -1310,21 +1328,8 @@ class FastCycle:
                         # rebuilds node classes from the frame itself.
                         result = remote.solve(inputs, pid, profiles)
                     elif solver == "wave" and mesh is not None:
-                        # Multi-chip dispatch: node axis + affinity
-                        # count tensors sharded over the mesh
-                        # (parallel/mesh.py shard_wave_inputs).
-                        from .parallel.mesh import sharded_solve_wave_cycle
-
-                        if not hasattr(store, "_mesh_plane_cache"):
-                            store._mesh_plane_cache = {}
-                        result = sharded_solve_wave_cycle(
-                            mesh, inputs, pid, profiles,
-                            plane_cache=store._mesh_plane_cache,
-                            epoch=self.m.epoch,
-                            taint_any=self._taint_any,
-                            node_classes=ncls,
-                        )
-                        self._record_twophase_lanes()
+                        result = self._solve_mesh_dispatch(
+                            mesh, inputs, pid, profiles, ncls)
                     elif solver == "wave":
                         result = solve_fn(*inputs, pid=pid,
                                           profiles=profiles,
@@ -1431,6 +1436,28 @@ class FastCycle:
             self.m.mutation_seq, self.m.epoch, self.m.compact_gen,
             self.Nn, solve_id=solve_id,
         )
+
+    def _solve_mesh_dispatch(self, mesh, inputs, pid, profiles, ncls):
+        """Dispatch the wave solve over the device mesh: node axis +
+        affinity count tensors sharded (parallel/mesh.py
+        shard_wave_inputs), the two-phase rankings shard-local with the
+        per-profile winner reduction as the only cross-chip step
+        (ops/wave.py _topk_nodes).  The sharded devsnap planes pass
+        straight through committed; the remaining epoch-stable plane
+        (aff.node_dom) rides the store's declared mesh plane cache
+        (cleared on close()/compaction, guarded by the store lock this
+        cycle already holds)."""
+        from .parallel.mesh import sharded_solve_wave_cycle
+
+        result = sharded_solve_wave_cycle(
+            mesh, inputs, pid, profiles,
+            plane_cache=self.store._mesh_plane_cache,
+            epoch=self.m.epoch,
+            taint_any=self._taint_any,
+            node_classes=ncls,
+        )
+        self._record_twophase_lanes()
+        return result
 
     def _commit_inflight(self) -> None:
         """Fetch + commit the previous cycle's dispatched solve (runs
@@ -2141,16 +2168,19 @@ class FastCycle:
 
     def _device_snapshot(self):
         """The store's persistent device-resident snapshot, or None on
-        paths that ship numpy (remote solver frames, mesh sharding — the
-        mesh keeps its own per-device plane cache in parallel/mesh.py)
-        or when disabled (VOLCANO_TPU_DEVSNAP=0)."""
+        paths that ship numpy (remote solver frames — the child process
+        owns its device state) or when disabled (VOLCANO_TPU_DEVSNAP=0).
+        A mesh store gets the mesh-sharded snapshot: node planes commit
+        with the node-axis NamedSharding and delta scatters stay
+        shard-local (ops/devsnap.py), so the mesh path no longer
+        re-ships numpy planes every cycle."""
         if (getattr(self.store, "remote_solver", None) is not None
-                or getattr(self.store, "solve_mesh", None) is not None
                 or os.environ.get("VOLCANO_TPU_DEVSNAP", "1") == "0"):
             return None
         from .ops.devsnap import for_store
 
-        return for_store(self.store)
+        return for_store(self.store,
+                         mesh=getattr(self.store, "solve_mesh", None))
 
     def _solve_inputs(self, solve_jobs: List[int], task_rows: np.ndarray,
                       slim: bool = False):
@@ -3176,9 +3206,12 @@ class FastCycle:
             return
         if (getattr(store, "remote_solver", None) is not None
                 or getattr(store, "solve_mesh", None) is not None):
-            # The what-if solve runs on the local backend; remote-solver
-            # and mesh deployments keep the lane off until those paths
-            # carry it.
+            # The what-if solve runs on the local single-device backend;
+            # remote-solver and mesh deployments keep the lane off until
+            # it carries them.  (The ALLOCATE lane pipelines under a
+            # mesh since ISSUE 7 — only this hypothetical-solve lane
+            # still needs the local backend, because the what-if patches
+            # host arrays that the sharded devsnap owns on-device.)
             return
         ledger = store.migrations
         if ledger is not None and ledger.active(store):

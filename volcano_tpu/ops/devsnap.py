@@ -19,10 +19,14 @@ keyed by the mirror epoch + plane shape:
 - shape changed / delta unprovable -> full re-upload.
 
 One snapshot instance lives per store (``store.device_snapshot``),
-created by the fast path on first use; it only serves the single-process
-wave path — the remote split ships numpy frames (the child process owns
-its own device state) and the mesh path has its own sharded input cache
-(``parallel.mesh.shard_wave_inputs``).
+created by the fast path on first use.  It serves the single-process
+wave path AND the mesh path: a mesh store's snapshot commits every node
+plane with the node-axis ``NamedSharding`` (each chip holds only its
+node shard) and the delta scatter then runs SHARD-LOCAL — node churn
+costs one small scatter on the owning chip instead of a full
+host->device re-upload of every plane on every chip.  Only the remote
+split stays out (it ships numpy frames; the child process owns its own
+device state).
 """
 
 from __future__ import annotations
@@ -67,9 +71,28 @@ def _pad_delta(rows: np.ndarray, vals: np.ndarray):
 
 
 class DeviceSnapshot:
-    """Persistent per-device plane set for one store (see module doc)."""
+    """Persistent per-device plane set for one store (see module doc).
 
-    def __init__(self):
+    ``mesh`` (optional ``jax.sharding.Mesh``) makes the snapshot
+    mesh-native: node planes commit with the node-axis NamedSharding
+    (replicated only when the padded node axis does not divide the mesh
+    — tiny clusters), the class tables replicate, and the dirty-row
+    delta scatter inherits the sharded donated buffer, so each update
+    touches only the owning shard.
+    """
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self._node_shd = None
+        self._rep_shd = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.mesh import NODES_AXIS
+
+            self._node_shd = NamedSharding(mesh,
+                                           PartitionSpec(NODES_AXIS))
+            self._rep_shd = NamedSharding(mesh, PartitionSpec())
         # name -> device array, all planes sharing self._key.
         self._planes: Dict[str, object] = {}
         self._key: Optional[Tuple] = None
@@ -82,6 +105,26 @@ class DeviceSnapshot:
         self.hits = 0
         self.class_uploads = 0
         self.class_hits = 0
+
+    # ------------------------------------------------------------ placement
+
+    def _put_plane(self, a: np.ndarray):
+        """Commit one full node plane: node-axis sharded on a mesh
+        (when the axis divides), single default device otherwise."""
+        if self._node_shd is not None:
+            n_dev = self.mesh.devices.size
+            if a.ndim and a.shape[0] % n_dev == 0:
+                return jax.device_put(a, self._node_shd)
+            return jax.device_put(a, self._rep_shd)
+        return jax.device_put(a)
+
+    def _put_delta(self, rows: np.ndarray, vals: np.ndarray):
+        """Commit a padded delta (replicated on a mesh: every chip needs
+        the row ids to decide ownership; the values are tiny)."""
+        if self._rep_shd is not None:
+            return (jax.device_put(rows, self._rep_shd),
+                    jax.device_put(vals, self._rep_shd))
+        return rows, vals
 
     # ------------------------------------------------------------- planes
 
@@ -132,11 +175,12 @@ class DeviceSnapshot:
                     # rows' ids shift under the sorted-signature
                     # ordering).  Re-upload just this plane; the others
                     # keep the scatter path.
-                    self._planes[name] = jax.device_put(
+                    self._planes[name] = self._put_plane(
                         np.asarray(fn(None))
                     )
                     continue
                 rows, vals = _pad_delta(delta_rows, np.asarray(dvals))
+                rows, vals = self._put_delta(rows, vals)
                 self._planes[name] = _scatter_rows(
                     self._planes[name], rows, vals
                 )
@@ -145,7 +189,7 @@ class DeviceSnapshot:
             self.delta_uploads += 1
             return self._planes
         self._planes = {
-            name: jax.device_put(np.asarray(fn(None)))
+            name: self._put_plane(np.asarray(fn(None)))
             for name, fn in build.items()
         }
         m.reset_node_delta()
@@ -170,8 +214,13 @@ class DeviceSnapshot:
         if self._cls_key == key:
             self.class_hits += 1
             return self._cls_planes
+        # Class tables are the COMPACTED [C, *] representation — tiny,
+        # so a mesh replicates them (every chip classifies its own node
+        # shard against the full table set).
+        _put = (jax.device_put if self._rep_shd is None
+                else (lambda a: jax.device_put(a, self._rep_shd)))
         self._cls_planes = {
-            name: jax.device_put(np.asarray(fn()))
+            name: _put(np.asarray(fn()))
             for name, fn in build.items()
         }
         self._cls_key = key
@@ -179,9 +228,12 @@ class DeviceSnapshot:
         return self._cls_planes
 
 
-def for_store(store) -> DeviceSnapshot:
-    """The store's snapshot, created on first use."""
+def for_store(store, mesh=None) -> DeviceSnapshot:
+    """The store's snapshot, created on first use.  ``mesh`` (the
+    store's ``solve_mesh``) selects the mesh-sharded placement; a
+    snapshot built for a different mesh (or none) is replaced wholesale
+    — its planes live on the wrong device set."""
     snap = getattr(store, "device_snapshot", None)
-    if snap is None:
-        snap = store.device_snapshot = DeviceSnapshot()
+    if snap is None or getattr(snap, "mesh", None) is not mesh:
+        snap = store.device_snapshot = DeviceSnapshot(mesh=mesh)
     return snap

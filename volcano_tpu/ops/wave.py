@@ -186,7 +186,9 @@ COARSE_CHUNK = _env_int("VOLCANO_TPU_COARSE_CHUNK", 256)
 # driver folds it into the device_coarse/device_fine sub-lanes and the
 # flight recorder; tests read the shortlist shape).  Keys: enabled,
 # coarse_s, fine_s, shortlist ((U, S) or None), n_nodes,
-# compacted_classes (bool: real class planes vs per-node identity).
+# compacted_classes (bool: real class planes vs per-node identity),
+# mesh_shards (effective node-axis shard count of the rankings; 1 off
+# a mesh).
 LAST_TWOPHASE: dict = {"enabled": False}
 
 
@@ -310,13 +312,57 @@ def _class_static(cls: NodeClasses, sel_bits, aff_bits, aff_terms,
     return ok, score
 
 
+def _topk_nodes(scores, k: int, n_shards: int = 1):
+    """Top-``k`` node ids per profile row — shard-local under a mesh.
+
+    ``scores`` is [U, N] with the node axis optionally sharded over
+    ``n_shards`` mesh devices.  With ``n_shards == 1`` this is exactly
+    ``jax.lax.top_k`` (ties prefer the lower node index).  With more,
+    the selection restructures into the mesh-native two-stage form the
+    batch-on-NN-processor architecture prescribes (arxiv 2002.07062 —
+    the reduction step is the only cross-device communication):
+
+    1. each shard ranks ONLY its own node slice (the reshape puts the
+       mesh axis on a leading dimension, so the inner ``top_k`` runs
+       shard-local with zero communication);
+    2. the per-shard winner lists reduce across chips as
+       (score, global node id) pairs — an all-reduce over the tiny
+       [U, n_shards * k] candidate set instead of a global sort/gather
+       of the full [U, N] plane.
+
+    The result is EXACTLY ``jax.lax.top_k(scores, k)``: a global top-k
+    element is necessarily a top-k element of its own shard, and the
+    tie-break matches because candidate positions order by (shard,
+    local rank) — shards are ascending-id node blocks and the local
+    ``top_k`` already breaks ties by ascending id, so within any score
+    class candidate position order IS ascending node id order.
+    """
+    if n_shards <= 1 or scores.shape[1] % n_shards:
+        _s, idx = jax.lax.top_k(scores, k)
+        return idx.astype(jnp.int32)
+    U, N = scores.shape
+    nl = N // n_shards
+    kl = min(k, nl)
+    loc = scores.reshape(U, n_shards, nl)
+    loc_s, loc_i = jax.lax.top_k(loc, kl)  # shard-local ranking
+    gid = loc_i.astype(jnp.int32) + (
+        jnp.arange(n_shards, dtype=jnp.int32) * nl
+    )[None, :, None]
+    cand_s = loc_s.reshape(U, n_shards * kl)
+    cand_i = gid.reshape(U, n_shards * kl)
+    _s, pos = jax.lax.top_k(cand_s, k)  # cross-chip winner reduction
+    return jnp.take_along_axis(cand_i, pos, axis=1)
+
+
 @partial(jax.jit, static_argnames=("sl_k", "chunk", "features",
-                                   "cnt0_any", "cls_identity"))
+                                   "cnt0_any", "cls_identity",
+                                   "mesh_shards"))
 def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
                       score_prof, cls: NodeClasses, aff: AffinityArgs,
                       weights: ScoreWeights, eps, scalar_slot,
                       sl_k: int, chunk: int, features: tuple,
-                      cnt0_any: bool, cls_identity: bool):
+                      cnt0_any: bool, cls_identity: bool,
+                      mesh_shards: int = 1):
     """Phase 1 + shortlist selection of the two-phase solve.
 
     Evaluates the wave-0-attempt-1 live mask + score for every profile
@@ -340,6 +386,12 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
     Profiles stream through ``lax.map`` in ``chunk`` rows so the
     [chunk, N, R] fit broadcast — the pass's only [*, N, R] tensor —
     bounds device memory at hyperscale profile counts.
+
+    ``mesh_shards`` > 1 (the node axis is sharded over that many mesh
+    devices) makes the candidate selection shard-local: each chip ranks
+    only its own node slice and the per-profile winners reduce across
+    chips as (score, global node id) pairs (``_topk_nodes``) — the
+    shortlist membership is bit-identical to the single-device pass.
     """
     (has_ports, has_aff, has_taints, has_future, _has_overuse,
      has_extra, has_extra_score) = features
@@ -405,7 +457,9 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
             feas &= (aff_viol < 0.5) & (anti_viol < 0.5)
             score = score + jnp.matmul(t_soft, cv0_f.T)
         masked = jnp.where(feas, score, NEG)
-        _scores, idx = jax.lax.top_k(masked, sl_k)
+        # Shard-local ranking + cross-chip winner reduction under a
+        # mesh; identical membership to a global top_k (see _topk_nodes).
+        idx = _topk_nodes(masked, sl_k, mesh_shards)
         return jnp.sort(idx, axis=1).astype(jnp.int32)
 
     ones_u = jnp.ones((U, 1), bool)
@@ -428,7 +482,8 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
 
 @partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features",
                                    "terms_disjoint", "two_phase",
-                                   "cls_identity", "fb_cap"))
+                                   "cls_identity", "fb_cap",
+                                   "mesh_shards"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -454,6 +509,7 @@ def _solve_wave(
     two_phase: bool = False,
     cls_identity: bool = False,
     fb_cap: int = 0,
+    mesh_shards: int = 1,
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
@@ -832,9 +888,12 @@ def _solve_wave(
                 p_score = p_score + aff_soft
             p_score = jnp.where(p_feasible, p_score, NEG)
             # top_k is the partial sort: ties prefer lower node index,
-            # matching the stable argsort it replaces.
-            _scores, order = jax.lax.top_k(p_score, K)
-            return order.astype(jnp.int32)
+            # matching the stable argsort it replaces.  Under a mesh the
+            # ranking runs shard-local with only the (score, node id)
+            # winner reduction crossing chips (_topk_nodes) — this is
+            # the full-N path, so it also keeps the two-phase fallback
+            # rescore shard-local.
+            return _topk_nodes(p_score, K, mesh_shards)
 
         def live_parts_sl(s: GState, cw_a, cw_p, aff_ok_c, aff_soft_c,
                           aff_dirty_a):
@@ -2159,6 +2218,7 @@ def solve_wave(
     extra_score=None,
     taint_any=None,
     node_classes: NodeClasses = None,
+    mesh_shards: int = 1,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -2176,6 +2236,15 @@ def solve_wave(
     into the profile grouping so tasks sharing a profile share a mask
     row, and is only supported when profiles are computed in-call
     (custom plugins make a configuration fast-path-ineligible).
+
+    ``mesh_shards`` (mesh callers: the device count the node axis is
+    sharded over) restructures every node-axis ranking — the coarse
+    shortlist selection, the per-attempt walk ranking, and the full-N
+    fallback rescore — into the shard-local + winner-reduction form
+    (``_topk_nodes``), keeping the per-profile (score, node id)
+    all-reduce as the only cross-chip communication of the selection
+    step.  Results are bit-identical to ``mesh_shards=1``; a node axis
+    the shard count does not divide falls back to the global form.
     """
     P = int(tasks.job.shape[0])
     if (extra_ok is not None or extra_score is not None) and (
@@ -2417,6 +2486,12 @@ def solve_wave(
             ready=z1((1,), bool),
         )
     sl_k = shortlist_size(N_in) if two_phase else 1
+    # Effective shard count for the node-axis rankings: only when the
+    # (padded) node axis divides evenly — otherwise the global form is
+    # both correct and what GSPMD would fall back to anyway.
+    n_sh = int(mesh_shards) if mesh_shards else 1
+    if n_sh > 1 and (N_in % n_sh):
+        n_sh = 1
     U_rows = int(profiles.req.shape[0])
     # Largest power of two <= COARSE_CHUNK: the profile axis is
     # pow2-padded, so a pow2 chunk always divides it (lax.map needs an
@@ -2438,7 +2513,7 @@ def solve_wave(
                 weights, eps, scalar_slot,
                 sl_k=sl_k, chunk=chunk,
                 features=features, cnt0_any=bool(cnt0_any),
-                cls_identity=cls_identity,
+                cls_identity=cls_identity, mesh_shards=n_sh,
             )
             t_coarse = _time.perf_counter() - t0
         else:
@@ -2451,6 +2526,7 @@ def solve_wave(
             wave=wave, n_waves=n_waves, ew=ew, features=features,
             terms_disjoint=terms_disjoint, two_phase=two_phase,
             cls_identity=cls_identity, fb_cap=_fallback_cap(),
+            mesh_shards=n_sh,
         )
         t_fine = _time.perf_counter() - t0
     # Dispatch-side sub-lane telemetry (the cycle driver folds it into
@@ -2465,6 +2541,7 @@ def solve_wave(
         "shortlist": (U_rows, sl_k) if two_phase else None,
         "n_nodes": N_in,
         "compacted_classes": two_phase and not cls_identity,
+        "mesh_shards": n_sh,
     })
     if pad:
         res = res._replace(

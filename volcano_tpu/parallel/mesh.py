@@ -18,11 +18,15 @@ the same code runs unchanged on a real multi-chip TPU slice.
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger(__name__)
 
 NODES_AXIS = "nodes"
 
@@ -45,6 +49,39 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = NODES_AXIS,
             )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def mesh_from_env(store) -> Optional[Mesh]:
+    """The store's solve mesh, or one built from ``VOLCANO_TPU_MESH=<n>``
+    (the deploy-time enable knob: ``store.solve_mesh`` set explicitly
+    always wins; unset/0/1 keeps the single-device path).  A backend
+    with fewer than n devices logs once and stays single-device instead
+    of failing the cycle — the knob must be safe to bake into a config
+    that also runs on one chip."""
+    mesh = getattr(store, "solve_mesh", None)
+    if mesh is not None:
+        return mesh
+    if getattr(store, "_mesh_env_checked", False):
+        return None
+    store._mesh_env_checked = True
+    raw = os.environ.get("VOLCANO_TPU_MESH", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        if raw:
+            log.warning("VOLCANO_TPU_MESH=%r is not an integer; "
+                        "staying single-device", raw)
+        return None
+    if n < 2:
+        return None
+    try:
+        mesh = make_mesh(n)
+    except RuntimeError as e:
+        log.warning("VOLCANO_TPU_MESH=%s but %s; staying single-device",
+                    raw, e)
+        return None
+    store.solve_mesh = mesh
+    return mesh
 
 
 def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
@@ -108,13 +145,16 @@ def sharded_solve_wave(mesh: Mesh, solve_args: Sequence,
 
     args = shard_solve_args(mesh, solve_args, axis)
     kw = {} if wave is None else {"wave": wave}
-    return solve_wave(*args, **kw)
+    return solve_wave(*args, mesh_shards=int(mesh.devices.size), **kw)
 
 
 # SolveNodes fields that move only with the NODE table (the mirror's
-# epoch key), not per cycle: with a plane cache these skip the per-cycle
-# device_put entirely (the multi-chip analog of ops/devsnap.py — the
-# sharded placement makes them a persistent PER-DEVICE array set).
+# epoch key), not per cycle.  On the fast path these now arrive as
+# committed mesh-sharded arrays from the sharded devsnap
+# (ops/devsnap.py — per-shard resident planes with shard-local delta
+# scatters) and pass straight through; the plane cache below remains
+# the fallback for direct callers and VOLCANO_TPU_DEVSNAP=0, where it
+# still skips the per-cycle device_put on an epoch hit.
 _EPOCH_STABLE_NODE_FIELDS = frozenset(
     {"allocatable", "max_tasks", "ready", "label_bits", "taint_bits"}
 )
@@ -153,18 +193,32 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
     col_sharded = NamedSharding(mesh, P(None, axis))
 
     nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = solve_args
-    n_nodes = int(np.asarray(nodes.idle).shape[0])
+    idle_in = nodes.idle
+    n_nodes = int(idle_in.shape[0] if hasattr(idle_in, "shape")
+                  else np.asarray(idle_in).shape[0])
 
     def put_node(x):
+        # Mesh-resident planes (the sharded devsnap, ops/devsnap.py)
+        # arrive committed with the node-axis sharding already: hand
+        # them straight through — np.asarray here would be a full
+        # device->host->device round trip of every plane every cycle,
+        # exactly the re-shipping this path exists to remove.
+        if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+            return x
         # The slim fast path ships [1, R] broadcast dummies for
         # releasing/pipelined; those replicate (a 1-row axis cannot
         # shard over the mesh).
         a = np.asarray(x)
-        sh = node_sharded if (a.ndim and a.shape[0] == n_nodes) \
+        sh = node_sharded if (a.ndim and a.shape[0] == n_nodes
+                              and a.shape[0] % mesh.devices.size == 0) \
             else replicated
         return jax.device_put(a, sh)
 
     def put_node_cached(name, x):
+        # Committed mesh arrays (sharded devsnap) ARE the persistent
+        # per-device planes — no cache entry needed.
+        if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
+            return x
         # Persistent per-device plane: re-ship only when the node table
         # (epoch) or the padded shape moved.
         if plane_cache is None or epoch is None:
@@ -277,4 +331,4 @@ def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
     kw = {} if wave is None else {"wave": wave}
     return solve_wave(*args, pid=pid, profiles=profiles,
                       taint_any=taint_any, node_classes=node_classes,
-                      **kw)
+                      mesh_shards=int(mesh.devices.size), **kw)
