@@ -43,6 +43,15 @@ default /tmp/vtpu_bench_traces) and reports staleness-drop totals plus
 per-lane p50/p95 (steady-state cycles only) in the machine-readable
 JSON tail.
 
+BENCH_HOST=1 (ISSUE 8) A/Bs the incremental host lanes in one run: the
+selected config executes three times — "(incremental on)",
+"(incremental off)" (full-rebuild derive, no host-lane caches), and
+"(incremental fallback)" (VOLCANO_TPU_DIRTY_CAP=1, so every cycle
+exercises the dirty-overflow fallback) — each emitting plain +
+pipelined JSON tails whose `host_lanes_ms` field sums the host lanes
+(derive+order+encode+commit+close+enqueue+feed+backfill) and whose
+`lane_p50`/`lane_p95` tails carry the steady-state distribution.
+
 BENCH_MESH=<devices> (ISSUE 7) A/Bs the mesh-native sharded solve in
 one run: the process forces a virtual CPU platform with that many host
 devices (must be set at startup — the flag is baked into XLA client
@@ -73,6 +82,15 @@ _MODE_SUFFIX = ""
 # BENCH_MESH A/B driver state: the jax.sharding.Mesh the benched stores
 # dispatch over ("(mesh on)" pass), or None for the plain pass.
 _MESH = None
+
+# The HOST lanes whose serial sum floors the pipelined cycle (ISSUE 8):
+# everything the cycle thread does besides the device dispatch/fetch.
+HOST_LANES = ("derive", "order", "encode", "commit", "close", "enqueue",
+              "feed", "backfill")
+
+
+def _host_lane_sum_ms(lanes) -> float:
+    return sum(lanes.get(k, 0.0) for k in HOST_LANES) * 1e3
 
 
 @contextmanager
@@ -124,6 +142,10 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
             for k, v in sorted(lanes.items(), key=lambda kv: -kv[1])
             if v >= 5e-4
         }
+        # Host-lane serial sum (incl. the pipelined feed lane, ISSUE 8
+        # satellite — the accounting must sum to the cycle time):
+        # the number the BENCH_HOST incremental A/B compares.
+        payload["host_lanes_ms"] = round(_host_lane_sum_ms(lanes), 2)
     if records:
         # Flight-recorder tail (ISSUE 3): staleness-drop totals by
         # reason and per-lane p50/p95 over the steady-state cycles, so
@@ -781,6 +803,40 @@ def main():
             _run_selected(raw, repeats)
         _MODE_SUFFIX = ""
         _MESH = None
+        return
+    host = os.environ.get("BENCH_HOST")
+    if host:
+        # Incremental host-lane A/B (ISSUE 8): the selected config runs
+        # three times — "(incremental on)", "(incremental off)" (every
+        # derive takes the proven full-rebuild path and no host-lane
+        # cache is consulted), and "(incremental fallback)" (tracking
+        # stays ON but VOLCANO_TPU_DIRTY_CAP=1 overflows every cycle,
+        # so the dirty-cap fallback is EXERCISED and measured, not just
+        # dodged).  Each pass emits the usual plain + pipelined rows;
+        # the pipelined row's host_lanes_ms + lane_p50/p95 tails carry
+        # the per-lane p50/p95 across steady-state cycles.
+        modes = (
+            ("on", {"VOLCANO_TPU_INCREMENTAL": "1"}),
+            ("off", {"VOLCANO_TPU_INCREMENTAL": "0"}),
+            ("fallback", {"VOLCANO_TPU_INCREMENTAL": "1",
+                          "VOLCANO_TPU_DIRTY_CAP": "1"}),
+        )
+        keys = {k for _, env in modes for k in env}
+        old = {k: os.environ.get(k) for k in keys}
+        try:
+            for mode, env in modes:
+                for k in keys:
+                    os.environ.pop(k, None)
+                os.environ.update(env)
+                _MODE_SUFFIX = f" (incremental {mode})"
+                _run_selected(raw, repeats)
+        finally:
+            _MODE_SUFFIX = ""
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         return
     ab = os.environ.get("BENCH_TOPK")
     if ab:

@@ -16,6 +16,20 @@ hack/run-checks.sh
 BENCH_MESH=4 BENCH_CONFIG=2 BENCH_NODES=256 BENCH_PODS=2048 \
   BENCH_REPEATS=1 BENCH_PIPE_CYCLES=5 JAX_PLATFORMS=cpu \
   python bench.py
+# BENCH_HOST smoke (ISSUE 8): the incremental host-lane A/B at a small
+# shape — asserts all three modes (on / off / dirty-cap fallback)
+# complete, pipeline, and emit their host_lanes_ms JSON tails.
+BENCH_HOST=1 BENCH_CONFIG=2 BENCH_NODES=128 BENCH_PODS=1024 \
+  BENCH_REPEATS=1 BENCH_PIPE_CYCLES=5 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+want = {"(incremental on)", "(incremental off)", "(incremental fallback)"}
+modes = {m for m in want for r in rows if m in r["metric"]}
+assert modes == want, f"missing BENCH_HOST modes: {want - modes}"
+assert any("host_lanes_ms" in r for r in rows), "no host_lanes_ms tail"
+print(f"BENCH_HOST smoke OK ({len(rows)} rows)")
+'
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

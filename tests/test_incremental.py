@@ -1,0 +1,494 @@
+"""Incremental host lanes (ISSUE 8): dirty-set derive parity, order/
+encode cache parity, fallback behavior, and the dirty-set <-> staleness
+guard agreement contract.
+
+The acceptance bar is BIT-FOR-BIT: with ``VOLCANO_TPU_INCREMENTAL=1``,
+every derive aggregate, the job ordering, and the solver inputs must
+equal the full-rebuild path across randomized churn — and binds must be
+identical end-to-end.
+"""
+
+import logging
+import random
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    Queue,
+    TaskStatus,
+)
+from volcano_tpu.fastpath import FastCycle
+from volcano_tpu.framework import parse_scheduler_conf
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _reset_uid_counters():
+    """Pod uids / creation timestamps draw from process-global counters;
+    twin runs must see identical universes to be comparable."""
+    import itertools
+
+    import volcano_tpu.api.spec as spec
+
+    spec._uid_counter = itertools.count(1)
+    spec._ts_counter = itertools.count(1)
+
+
+def _inqueue_all(store):
+    """Move every PodGroup to Inqueue so a derive-only probe sees
+    schedulable jobs without running an enqueue action first."""
+    for pg in list(store.pod_groups.values()):
+        pg.status.phase = "Inqueue"
+        store.update_pod_group(pg)
+
+
+def _probe(store):
+    """A derive-only FastCycle over the store (no solve, no actions)."""
+    cyc = FastCycle(store, parse_scheduler_conf(CONF))
+    with store._lock:
+        cyc.derive()
+        cyc._proportion()
+    return cyc
+
+
+def _assert_aggr_parity(store):
+    """Every derive aggregate must equal a from-scratch build."""
+    from volcano_tpu.fastpath_incr import _build_aggregates
+
+    cyc = _probe(store)
+    m = store.mirror
+    with store._lock:
+        (resident, used, rel, ntasks, counts, empty, alloc,
+         pending) = _build_aggregates(m, cyc.Pn, cyc.Nn, cyc.R,
+                                      cyc.n_alive)
+    assert np.array_equal(cyc.resident, resident)
+    # The PERSISTENT planes are the bit-for-bit contract (float64);
+    # the cycle's copies are their f32 casts.
+    assert np.array_equal(cyc.aggr.n_used, used)
+    assert np.array_equal(cyc.aggr.n_releasing, rel)
+    assert np.array_equal(cyc.n_used, used.astype(np.float32))
+    assert np.array_equal(cyc.n_releasing, rel.astype(np.float32))
+    assert np.array_equal(cyc.n_ntasks, ntasks.astype(np.int32))
+    assert np.array_equal(cyc.aggr.js_counts, counts)
+    assert np.array_equal(cyc.j_cnt_empty_pending,
+                          empty.astype(np.int32))
+    assert np.array_equal(cyc.aggr.j_alloc_res, alloc)
+    assert np.array_equal(cyc.aggr.j_pending_res, pending)
+    assert np.array_equal(cyc.j_alloc_res, alloc.astype(np.float32))
+    assert np.array_equal(cyc.j_pending_res,
+                          pending.astype(np.float32))
+    return cyc
+
+
+def _assert_rank_parity(store):
+    """The merge-cached job rank must equal a fresh full lexsort."""
+    cyc = _probe(store)
+    with store._lock:
+        drf = cyc._drf_shares()
+        cached_rank = cyc._job_keys(cyc.session_jobs, drf)
+        # Fresh, cache-free rank over the SAME key columns.
+        store._job_rank_cache = None
+        fresh_rank = cyc._job_keys(cyc.session_jobs, drf)
+    assert np.array_equal(cached_rank, fresh_rank)
+
+
+def _churn(store, rng, step):
+    """One randomized mutation batch: adds, deletes, node flaps, queue
+    weight edits."""
+    op = rng.choice(["add_gang", "delete_pod", "node_flap",
+                     "queue_weight", "add_pods"])
+    if op == "add_gang":
+        name = f"churn-{step}"
+        store.add_pod_group(PodGroup(name=name, min_member=2))
+        for i in range(2):
+            store.add_pod(Pod(
+                name=f"{name}-{i}",
+                annotations={GROUP_NAME_ANNOTATION: name},
+                containers=[{"cpu": "1", "memory": "1Gi"}],
+            ))
+    elif op == "delete_pod":
+        # Keyed by NAME: uids are process-global counters, so a twin
+        # run's uids differ and must not steer the op sequence.
+        pods = sorted(store.pods.values(), key=lambda p: p.name)
+        if pods:
+            store.delete_pod(pods[rng.randrange(len(pods))])
+    elif op == "node_flap":
+        names = sorted(store.mirror.n_row)
+        if names:
+            name = names[rng.randrange(len(names))]
+            if rng.random() < 0.5:
+                store.delete_node(name)
+            else:
+                store.add_node(Node(
+                    name=name,
+                    allocatable={"cpu": "64", "memory": "256Gi",
+                                 "pods": 256},
+                ))
+    elif op == "queue_weight":
+        store.update_queue(Queue(name="default",
+                                 weight=rng.randrange(1, 9)))
+    elif op == "add_pods":
+        name = f"solo-{step}"
+        store.add_pod_group(PodGroup(name=name, min_member=1))
+        store.add_pod(Pod(
+            name=f"{name}-0",
+            annotations={GROUP_NAME_ANNOTATION: name},
+            containers=[{"cpu": "2", "memory": "2Gi"}],
+        ))
+
+
+def test_churn_parity_aggregates_order_and_binds(monkeypatch):
+    """Randomized churn: after every cycle the persistent aggregates,
+    the merged job rank, AND the end-to-end binds are bit-for-bit equal
+    to the full-rebuild path (a twin store with the incremental
+    machinery off sees the identical op sequence)."""
+    monkeypatch.setenv("VOLCANO_TPU_INCR_VERIFY", "1")
+
+    def run(incremental: bool):
+        monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL",
+                           "1" if incremental else "0")
+        _reset_uid_counters()
+        store = synthetic_cluster(
+            n_nodes=10, n_pods=48, gang_size=4, zones=2, n_queues=2,
+            queue_weights=(1, 3), affinity_fraction=0.2,
+            anti_affinity_fraction=0.1, spread_fraction=0.2, seed=3,
+        )
+        sched = Scheduler(store, conf_str=CONF)
+        rng = random.Random(11)
+        modes = []
+        for step in range(8):
+            sched.run_once()
+            modes.append(store.mirror._cycle_aggr.last_mode)
+            if incremental:
+                _assert_aggr_parity(store)
+                _assert_rank_parity(store)
+            _churn(store, rng, step)
+        sched.run_once()
+        binds = dict(store.binder.binds)
+        phases = {uid: pg.status.phase
+                  for uid, pg in sorted(store.pod_groups.items())}
+        status = {
+            store.mirror.p_uid[r]: (
+                int(store.mirror.p_status[r]),
+                store.mirror.p_node_name[r],
+            )
+            for r in range(store.mirror.n_pods)
+            if store.mirror.p_uid[r] is not None
+        }
+        return binds, phases, status, modes
+
+    binds_on, phases_on, status_on, modes_on = run(True)
+    binds_off, phases_off, status_off, modes_off = run(False)
+    assert binds_on == binds_off
+    assert phases_on == phases_off
+    assert status_on == status_off
+    # The incremental run must actually take the delta path (node flaps
+    # force some full rebuilds; steady steps must not).
+    assert "delta" in modes_on
+    assert all(mode == "full" for mode in modes_off)
+
+
+def test_rank_merge_matches_full_lexsort():
+    """rank_from_cols: merged ranks are identical to the full lexsort
+    under randomized key churn (unique tie-break column)."""
+    from volcano_tpu.fastpath_incr import rank_from_cols
+
+    rng = np.random.default_rng(5)
+    n = 257
+    prio = rng.integers(0, 4, n)
+    gang = rng.integers(0, 2, n).astype(bool)
+    drf = rng.random(n).astype(np.float64)
+    create = rng.random(n)
+    uid_rank = rng.permutation(n).astype(np.int64)
+    cache = None
+    for step in range(30):
+        cols = [prio.copy(), gang.copy(), drf.copy(), create.copy(),
+                uid_rank]
+        rank, cache = rank_from_cols(cols, cache)
+        order = np.lexsort(tuple(reversed(cols)))
+        want = np.empty(n, np.int64)
+        want[order] = np.arange(n)
+        assert np.array_equal(rank, want), f"step {step}"
+        # Perturb a few rows' keys for the next iteration.
+        k = int(rng.integers(0, 9))
+        idx = rng.choice(n, size=k, replace=False).astype(np.int64)
+        prio[idx] = rng.integers(0, 4, k)
+        drf[idx] = rng.random(k)
+
+
+def test_encode_cache_bit_for_bit(monkeypatch):
+    """The cached encode-lane structures (profiles, pid, affinity
+    inputs) must be bit-identical to a cache-free rebuild — including
+    the inter-pod term path."""
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "1")
+    store = synthetic_cluster(
+        n_nodes=6, n_pods=24, gang_size=4, zones=2,
+        affinity_fraction=0.4, anti_affinity_fraction=0.2,
+        spread_fraction=0.4, seed=1,
+    )
+    _inqueue_all(store)
+    cyc = _probe(store)
+    with store._lock:
+        ordered = cyc._ordered_jobs()
+        prep = cyc._pending_rows(ordered)
+        assert prep is not None
+        solve_jobs, task_rows = prep
+        store._encode_cache = None
+        built = cyc._solve_inputs(solve_jobs, task_rows, slim=True)
+        assert store._encode_cache is not None
+        cached = cyc._solve_inputs(solve_jobs, task_rows, slim=True)
+
+    def eq(a, b, path="root"):
+        if isinstance(a, np.ndarray):
+            assert np.array_equal(a, np.asarray(b)), path
+        elif isinstance(a, (list, tuple)):
+            assert len(a) == len(list(b)), path
+            for i, (x, y) in enumerate(zip(a, b)):
+                eq(x, y, f"{path}[{i}]")
+        elif hasattr(a, "_fields"):  # NamedTuple
+            for f in a._fields:
+                eq(getattr(a, f), getattr(b, f), f"{path}.{f}")
+        else:
+            assert a == b, path
+
+    (inputs_b, pid_b, profiles_b, _ncls_b) = built
+    (inputs_c, pid_c, profiles_c, _ncls_c) = cached
+    eq(pid_b, pid_c, "pid")
+    eq(profiles_b, profiles_c, "profiles")
+    # nodes/tasks/jobs/queues/weights/eps/scalar/aff
+    for i, (x, y) in enumerate(zip(inputs_b, inputs_c)):
+        if hasattr(x, "_fields"):
+            for f in x._fields:
+                a_f, b_f = getattr(x, f), getattr(y, f)
+                if isinstance(a_f, np.ndarray):
+                    eq(a_f, b_f, f"inputs[{i}].{f}")
+        elif isinstance(x, np.ndarray):
+            eq(x, y, f"inputs[{i}]")
+
+
+def test_pending_rows_cache_reused_and_invalidated(monkeypatch):
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "1")
+    store = synthetic_cluster(n_nodes=6, n_pods=24, gang_size=3, seed=2)
+    _inqueue_all(store)
+    cyc = _probe(store)
+    with store._lock:
+        ordered = cyc._ordered_jobs()
+        a = cyc._pending_rows(ordered)
+        b = cyc._pending_rows(ordered)
+    assert a is not None and b is not None
+    # Second call reuses the cached (frozen) task-row array.
+    assert b[1] is a[1]
+    assert a[0] == b[0]
+    # A status change invalidates via the pending-set content.
+    row = int(a[1][0])
+    with store._lock:
+        store.mirror.p_status[row] = int(TaskStatus.Bound)
+        store.mirror.mark_pods_dirty(np.array([row]))
+        store.mirror.mutation_seq += 1
+    cyc2 = _probe(store)
+    with store._lock:
+        ordered2 = cyc2._ordered_jobs()
+        c = cyc2._pending_rows(ordered2)
+    assert c is not None
+    assert row not in c[1]
+
+
+def test_dirty_cap_overflow_falls_back(monkeypatch):
+    """Past VOLCANO_TPU_DIRTY_CAP the tracker gives up and the next
+    derive full-rebuilds — with identical results."""
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "1")
+    monkeypatch.setenv("VOLCANO_TPU_DIRTY_CAP", "2")
+    store = synthetic_cluster(n_nodes=6, n_pods=24, gang_size=2, seed=4)
+    sched = Scheduler(store, conf_str=CONF)
+    sched.run_once()  # first derive: full (no prior state)
+    # The commit marked ~24 rows > cap 2 -> overflow -> next derive full.
+    m = store.mirror
+    assert m._pod_dirty_overflow
+    sched.run_once()
+    aggr = m._cycle_aggr
+    assert aggr.last_mode == "full"
+    assert aggr.full_reason == "dirty-overflow"
+    _assert_aggr_parity(store)
+
+
+def test_dirty_set_and_staleness_guard_agree(caplog):
+    """Every mutation batch that advances the dirty set also advances
+    mutation_seq (or epoch / compact_gen) — the agreement the pipelined
+    staleness guard's skip-on-equality proof rests on.  Exercised over
+    randomized store ops AND a pipelined loop with mid-flight
+    mutations; the defensive revalidation path must never fire."""
+    store = synthetic_cluster(n_nodes=8, n_pods=32, gang_size=2, seed=6)
+    m = store.mirror
+    rng = random.Random(13)
+    sched = Scheduler(store, conf_str=CONF)
+    store.pipeline = True
+
+    def token():
+        return (m.mutation_seq, m.dirty_seq, m.epoch, m.compact_gen)
+
+    with caplog.at_level(logging.ERROR, logger="volcano_tpu.fastpath"):
+        prev = token()
+        for step in range(10):
+            sched.run_once()
+            _churn(store, rng, 100 + step)
+            cur = token()
+            if cur[1] != prev[1]:  # dirty_seq advanced ...
+                assert (cur[0] != prev[0] or cur[2] != prev[2]
+                        or cur[3] != prev[3]), (
+                    "dirty set advanced without mutation_seq/epoch/"
+                    "compact_gen")
+            prev = cur
+        sched.run_once()
+    assert "without a mutation_seq bump" not in caplog.text
+
+
+def test_live_status_counts_match_scan():
+    """Close-time live counts (derive table + current dirty deltas)
+    equal a full scan after in-cycle mutations."""
+    from volcano_tpu.fastpath_incr import (
+        _scan_status_counts,
+        aggregates_of,
+    )
+
+    store = synthetic_cluster(n_nodes=4, n_pods=16, gang_size=2, seed=8)
+    cyc = _probe(store)
+    m = store.mirror
+    with store._lock:
+        # Mutate a few rows the way a commit would (status writes +
+        # dirty marks, no derive in between).
+        rows = np.array([0, 3, 5], np.int64)
+        m.p_status[rows] = int(TaskStatus.Bound)
+        m.p_node[rows] = 0
+        m.mark_pods_dirty(rows)
+        m.mutation_seq += 1
+        live = aggregates_of(m).live_status_counts(m, cyc.Pn)
+        want = _scan_status_counts(m, cyc.Pn, len(m.j_uid))
+    assert np.array_equal(live, want)
+
+
+def test_close_gauge_cache_reuses_retry_keys(monkeypatch):
+    """A persistently-unready gang re-increments its retry counter each
+    cycle from the CACHED key list (no per-cycle rebuild), with gauge
+    values unchanged."""
+    from volcano_tpu.metrics import metrics
+
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "1")
+    store = synthetic_cluster(n_nodes=2, n_pods=4, gang_size=4, seed=9,
+                              pod_cpu_choices=("512",))  # can't fit
+    sched = Scheduler(store, conf_str=CONF)
+    sched.run_once()
+    cache1 = store._close_gang_cache
+    assert cache1 is not None
+    key = cache1["retry_keys"][0]
+    before = metrics.job_retry_counts.data.get(key, 0)
+    sched.run_once()
+    # Cache object survived (reused, not rebuilt) ...
+    assert store._close_gang_cache is cache1
+    # ... and the retry counter still advanced.
+    assert metrics.job_retry_counts.data.get(key, 0) == before + 1
+
+
+def test_incremental_env_kill_switch(monkeypatch):
+    """VOLCANO_TPU_INCREMENTAL=0: every derive is a full rebuild and no
+    host-lane cache is consulted."""
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "0")
+    store = synthetic_cluster(n_nodes=4, n_pods=12, gang_size=2, seed=10)
+    sched = Scheduler(store, conf_str=CONF)
+    sched.run_once()
+    sched.run_once()
+    aggr = store.mirror._cycle_aggr
+    assert aggr.last_mode == "full"
+    assert aggr.full_reason == "disabled"
+    assert store._job_rank_cache is None
+    assert store._pending_order_cache is None
+    assert store._encode_cache is None
+    assert store._objarr_cache is None
+    assert store._unbind_gather_cache is None
+    assert store._close_gang_cache is None
+
+
+def test_node_heartbeat_keeps_delta_path(monkeypatch):
+    """A content-identical node re-upsert (the controller heartbeat
+    pattern) must NOT force the full-rebuild fallback: the aggregates
+    key on node LIVENESS, not the full epoch — only an actual
+    membership flip (remove/rejoin) invalidates."""
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "1")
+    monkeypatch.setenv("VOLCANO_TPU_INCR_VERIFY", "1")
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2, seed=12)
+    sched = Scheduler(store, conf_str=CONF)
+    sched.run_once()
+    # Heartbeat: re-upsert an existing, alive node unchanged.
+    m = store.mirror
+    store.add_node(Node(
+        name=m.n_name[0],
+        allocatable={"cpu": "64", "memory": "256Gi", "pods": 256},
+    ))
+    sched.run_once()
+    assert store.mirror._cycle_aggr.last_mode == "delta"
+    # Membership flip: the node leaves — the fallback must fire.
+    store.delete_node(m.n_name[1])
+    sched.run_once()
+    aggr = store.mirror._cycle_aggr
+    assert aggr.last_mode == "full"
+    _assert_aggr_parity(store)
+
+
+def test_fractional_quantities_stay_exact(monkeypatch):
+    """Fractional quantity SPECS round up to integral milli/bytes at
+    ingestion (k8s Quantity semantics), so the float64 delta planes
+    keep their bit-for-bit contract — the runtime verifier must stay
+    silent across delta derives with such pods."""
+    monkeypatch.setenv("VOLCANO_TPU_INCREMENTAL", "1")
+    monkeypatch.setenv("VOLCANO_TPU_INCR_VERIFY", "1")
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2, seed=14)
+    store.add_pod_group(PodGroup(name="frac", min_member=2))
+    for t in range(2):
+        store.add_pod(Pod(
+            name=f"frac-{t}",
+            annotations={GROUP_NAME_ANNOTATION: "frac"},
+            # Numeric fractional cpu + sub-byte memory string: both
+            # must land as integral quantities.
+            containers=[{"cpu": 0.0001, "memory": "100m"}],
+        ))
+    from volcano_tpu.api.resource import parse_bytes, parse_milli
+
+    assert parse_milli(0.0001) == 1.0
+    assert parse_bytes("100m") == 1.0
+    sched = Scheduler(store, conf_str=CONF)
+    for _ in range(3):
+        sched.run_once()  # INCR_VERIFY raises on any ulp drift
+    _assert_aggr_parity(store)
+
+
+def test_dirty_mask_growth_plants_no_stale_bits():
+    """Mask growth must zero-fill: np.resize TILES the old contents,
+    which would plant phantom dirty bits at rows beyond the table."""
+    store = synthetic_cluster(n_nodes=2, n_pods=4, gang_size=1, seed=13)
+    m = store.mirror
+    with store._lock:
+        m.consume_pod_dirty(m.n_pods)  # reset
+        cap = len(m._pod_dirty_mask)
+        m.mark_pod_dirty(0)
+        m.mark_pod_dirty(cap + 5)  # forces growth with bit 0 set
+        mask = m._pod_dirty_mask
+        assert mask[0] and mask[cap + 5]
+        assert int(mask.sum()) == 2, "growth tiled stale bits"

@@ -417,6 +417,75 @@ def test_metrics_drift_real_tree_is_clean():
     assert raw == [], [f.render() for f in raw]
 
 
+# --------------------------------------- persistent caches (VCL50x)
+
+
+AGG_FIXTURE = textwrap.dedent('''\
+    import numpy as np
+
+
+    def _epoch_cached(m, attr, key, build):
+        return build()
+
+
+    class Cycle:
+        def good_epoch(self, m, Nn, R):
+            return _epoch_cached(
+                m, "_node_alloc_cache", (m.epoch, Nn, R),
+                lambda: (np.zeros((Nn, R)),),
+            )
+
+        def bad_epoch(self, m, Nn, R):
+            return _epoch_cached(
+                m, "_other_cache", (Nn, R),
+                lambda: (np.zeros((Nn, R)),),
+            )
+
+        def keyed_read(self, store, m, rows):
+            cache = getattr(store, "_pending_order_cache", None)
+            if cache is not None and cache[0] == m.compact_gen:
+                return cache[1]
+            store._pending_order_cache = (m.compact_gen, rows)
+            return rows
+
+        def keyless_write(self, store, rows):
+            store._mystery_cache = rows
+''')
+
+
+def test_aggcheck_catches_seeded_violations():
+    from tools.vclint import aggcheck
+
+    raw = aggcheck.analyze_files([("agg.py", AGG_FIXTURE)])
+    findings = finish("agg.py", AGG_FIXTURE, raw)
+    got = _codes(findings)
+    # key tuple without the epoch (bad_epoch's _epoch_cached call).
+    assert ("VCL501", 16) in got
+    # unregistered persistent cache attribute (keyless_write).
+    assert ("VCL503", 29) in got
+    assert any("_mystery_cache" in f.message for f in findings
+               if f.code == "VCL503")
+    # good_epoch's keyed call is clean (only ONE VCL501 in the file).
+    assert len([1 for c, _ in got if c == "VCL501"]) == 1
+    # Fixture registry entries not present in this file report as
+    # stale entries (VCL502) — prove the stale-entry arm fires.
+    assert any(c == "VCL502" for c, _ in got)
+
+
+def test_aggcheck_registry_covers_tree_slots():
+    """Every registered slot resolves to real accesses in the scan set
+    (no stale registry entries on the committed tree)."""
+    from tools.vclint import aggcheck
+
+    sources = [
+        (rel, (REPO_ROOT / rel).read_text())
+        for rel in aggcheck.SCAN_FILES
+    ]
+    raw = aggcheck.analyze_files(sources)
+    stale = [f for f in raw if "stale" in f.message]
+    assert stale == [], [f.render() for f in stale]
+
+
 # ------------------------------------------------------------- the gate
 
 

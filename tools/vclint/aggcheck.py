@@ -1,0 +1,260 @@
+"""Persistent cycle-aggregate cache contract (VCL50x).
+
+ISSUE 8 made the host lanes incremental: aggregate planes, orderings,
+and encodings persist across cycles and are refreshed by deltas or
+reused on content matches.  Every such cache is only correct while its
+inputs hold still — and the mirror's ``mutation_seq`` / ``epoch`` /
+``compact_gen`` (plus the dirty set they drive) are the ONLY versioning
+machinery writers are required to maintain.  This analyzer turns the
+"key your cache on the mirror versions" convention (previously just the
+``_epoch_cached`` idiom) into a checked contract:
+
+- **VCL501**: an ``_epoch_cached(...)`` call whose key expression never
+  references ``epoch`` — the cache would survive node-table churn.
+- **VCL502**: a registered persistent cache whose accessor functions
+  never reference one of its DECLARED invalidation tokens (see
+  ``CACHE_REGISTRY``), or a registry entry no code accesses anymore.
+- **VCL503**: a persistent-cache-shaped attribute (``_*_cache`` /
+  ``_cycle_aggr``) on a store/mirror receiver that is not registered —
+  new caches must declare their invalidation story here.
+
+The token check is a UNION over every function that reads or writes the
+slot (across the scanned files), plus ONE level of locally-defined
+helpers those functions call (key builders like ``_encode_cache_key``
+and contract-carrying classes like ``CycleAggregates`` count toward
+their callers): the contract is "somewhere in the cache's read/write
+surface, each declared version token participates", which catches the
+real failure mode — a cache added or refactored without any keying at
+all — without trying to prove key-tuple shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from .findings import Finding
+
+# Slot -> invalidation tokens that must appear in the union of its
+# accessor functions.  Tokens are identifier/attribute names: mirror
+# version counters (mutation_seq / epoch / compact_gen and the derived
+# content versions term_members_total / pod_obj_gen / j_cond_sig), the
+# dirty-set consumer, or the content-diff helper for caches that
+# re-validate by comparing their full key columns every cycle.
+CACHE_REGISTRY: Dict[str, Set[str]] = {
+    # Job-order rank: content-diffed key columns (rank_from_cols
+    # compares every column, so no explicit version is needed).
+    "_job_rank_cache": {"rank_from_cols"},
+    # Pending-task order: row ids pin compact_gen; set/order content is
+    # compared by array equality.
+    "_pending_order_cache": {"compact_gen"},
+    # Encode-lane profile/affinity structures: row ids (compact_gen),
+    # node planes (epoch), and the append-only membership tables.
+    "_encode_cache": {"compact_gen", "epoch", "term_members_total"},
+    # Commit-path object arrays: rows (compact_gen), record slots
+    # (pod_obj_gen); the name list is append-only (tail extension).
+    "_objarr_cache": {"compact_gen", "pod_obj_gen"},
+    # Feed-lane unbind gather: row ids only (specs immutable per row).
+    "_unbind_gather_cache": {"compact_gen"},
+    # Close-lane gang gauges: revalidated against the persisted
+    # condition signatures.
+    "_close_gang_cache": {"j_cond_sig"},
+    # Mesh plane cache: epoch-keyed placements, voided on compaction.
+    "_mesh_plane_cache": {"compact_gen", "epoch"},
+    # The persistent aggregate planes themselves: keyed on
+    # (node_liveness_gen, compact_gen) — liveness is the only node
+    # property the resident predicate reads — and refreshed from the
+    # consumed dirty set.
+    "_cycle_aggr": {"node_liveness_gen", "compact_gen",
+                    "consume_pod_dirty"},
+}
+
+# Files whose cache accesses are analyzed (the incremental host-lane
+# surface).
+SCAN_FILES: Sequence[str] = (
+    "volcano_tpu/fastpath.py",
+    "volcano_tpu/fastpath_incr.py",
+    "volcano_tpu/cache/store.py",
+)
+
+# Cache-shaped attributes that are deliberately NOT persistent (cycle-
+# or object-lifetime memos): exempt from VCL503.
+CYCLE_LOCAL = {
+    "_obj_arr_cache",   # per-FastCycle memo of the store-level arrays
+    "_tier_opts_cache",  # per-cycle config memo (config is immutable)
+}
+
+_CACHE_SHAPE = re.compile(r"^_[a-z0-9_]*_cache$")
+_RECEIVERS = {"store", "m", "mirror", "self"}
+
+
+def _receiver_name(node: ast.AST):
+    """Leaf receiver name of an attribute chain (``self.store.x`` ->
+    ``store``; ``m.x`` -> ``m``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _idents(tree: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.add(sub.value)
+    return out
+
+
+def _functions(tree: ast.Module):
+    """Yield (qualname, node) for every function/method, including
+    nested defs (attributed to their outermost function)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _local_defs(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Bare name -> identifier set, for top-level functions, methods
+    (by method name), and classes (the whole class body) — the one-hop
+    helper expansion for the token union."""
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, set()).update(_idents(node))
+        elif isinstance(node, ast.ClassDef):
+            out.setdefault(node.name, set()).update(_idents(node))
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    out.setdefault(sub.name, set()).update(_idents(sub))
+    return out
+
+
+def _accessor_tokens(fn: ast.AST, local_defs: Dict[str, Set[str]]
+                     ) -> Set[str]:
+    """Identifiers of ``fn`` plus those of locally-defined helpers it
+    calls (one hop)."""
+    toks = _idents(fn)
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            leaf = getattr(sub.func, "id", None) or getattr(
+                sub.func, "attr", None)
+            if leaf and leaf in local_defs:
+                toks |= local_defs[leaf]
+    return toks
+
+
+def _slot_accesses(fn: ast.AST) -> Iterable[Tuple[str, int]]:
+    """(slot, line) for cache-shaped attribute accesses + getattr calls
+    on store/mirror-shaped receivers inside ``fn``."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+            if (_CACHE_SHAPE.match(name) or name == "_cycle_aggr"):
+                recv = _receiver_name(sub.value)
+                if recv in _RECEIVERS:
+                    yield name, sub.lineno
+        elif isinstance(sub, ast.Call):
+            leaf = getattr(sub.func, "id", None)
+            if leaf == "getattr" and len(sub.args) >= 2:
+                recv = _receiver_name(sub.args[0])
+                arg = sub.args[1]
+                if (recv in _RECEIVERS and isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    name = arg.value
+                    if _CACHE_SHAPE.match(name) or name == "_cycle_aggr":
+                        yield name, sub.lineno
+
+
+def analyze_files(sources: Sequence[Tuple[str, str]]) -> List[Finding]:
+    """``sources``: [(rel_path, text)].  Returns raw findings (caller
+    applies suppressions via ``findings.finish``)."""
+    findings: List[Finding] = []
+    # slot -> list of (path, line); slot -> union of accessor idents.
+    accesses: Dict[str, List[Tuple[str, int]]] = {}
+    tokens_seen: Dict[str, Set[str]] = {}
+    epoch_cached_slots: Set[str] = set()
+
+    for rel, src in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as err:
+            findings.append(Finding(
+                "VCL001", rel, err.lineno or 1,
+                f"aggcheck could not parse: {err.msg}",
+            ))
+            continue
+        local_defs = _local_defs(tree)
+        for qual, fn in _functions(tree):
+            fn_idents = None
+            for slot, line in _slot_accesses(fn):
+                accesses.setdefault(slot, []).append((rel, line))
+                if fn_idents is None:
+                    fn_idents = _accessor_tokens(fn, local_defs)
+                tokens_seen.setdefault(slot, set()).update(fn_idents)
+        # VCL501: _epoch_cached key expressions must reference epoch.
+        for sub in ast.walk(tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            leaf = getattr(sub.func, "id", None) or getattr(
+                sub.func, "attr", None)
+            if leaf != "_epoch_cached" or len(sub.args) < 3:
+                continue
+            attr_arg = sub.args[1]
+            if isinstance(attr_arg, ast.Constant) and isinstance(
+                    attr_arg.value, str):
+                epoch_cached_slots.add(attr_arg.value)
+            key_idents = _idents(sub.args[2])
+            if "epoch" not in key_idents:
+                findings.append(Finding(
+                    "VCL501", rel, sub.lineno,
+                    "_epoch_cached key does not reference the mirror "
+                    "epoch — the cache would survive node-table churn",
+                ))
+
+    # VCL502: declared tokens must appear in the accessor union; stale
+    # registry entries are findings too (first scanned file, line 1).
+    for slot, required in CACHE_REGISTRY.items():
+        sites = accesses.get(slot)
+        if not sites:
+            findings.append(Finding(
+                "VCL502", SCAN_FILES[0] if sources else "?", 1,
+                f"registered persistent cache {slot} is never accessed "
+                "(stale CACHE_REGISTRY entry)",
+            ))
+            continue
+        missing = required - tokens_seen.get(slot, set())
+        if missing:
+            rel, line = sites[0]
+            findings.append(Finding(
+                "VCL502", rel, line,
+                f"persistent cache {slot} accessors never reference "
+                f"declared invalidation token(s) "
+                f"{sorted(missing)} — the cache can go stale across "
+                "mirror versions",
+            ))
+
+    # VCL503: cache-shaped slots on persistent receivers must register.
+    for slot, sites in accesses.items():
+        if slot in CACHE_REGISTRY or slot in CYCLE_LOCAL \
+                or slot in epoch_cached_slots:
+            continue
+        rel, line = sites[0]
+        findings.append(Finding(
+            "VCL503", rel, line,
+            f"persistent cache attribute {slot} is not registered in "
+            "aggcheck.CACHE_REGISTRY (declare its mutation_seq/epoch/"
+            "compact_gen invalidation story)",
+        ))
+    return findings

@@ -13,7 +13,7 @@ import sys
 from pathlib import Path
 from typing import List
 
-from . import hotpath, lockcheck, metricscheck, schemacheck
+from . import aggcheck, hotpath, lockcheck, metricscheck, schemacheck
 from .findings import Finding, finish
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
@@ -115,6 +115,24 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
                 rel, texts[key], by_path.get(rel, [])
             ))
 
+    # ---- persistent cycle-aggregate cache contract (VCL50x) --------
+    agg_sources = []
+    for rel in aggcheck.SCAN_FILES:
+        path = root / rel
+        if path.is_file():
+            agg_sources.append((rel, path.read_text()))
+        else:
+            all_findings.append(Finding(
+                "VCL001", rel, 1,
+                "aggregate-cache scan set names a missing file",
+            ))
+    raw5 = aggcheck.analyze_files(agg_sources)
+    by_file5 = {}
+    for f in raw5:
+        by_file5.setdefault(f.path, []).append(f)
+    for rel, src in agg_sources:
+        all_findings.extend(finish(rel, src, by_file5.get(rel, [])))
+
     # ---- metrics <-> docs drift ------------------------------------
     try:
         m_src = _read(METRICS_FILES["metrics"], root)
@@ -148,7 +166,8 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
         f"{len(suppressed)} suppressed "
         f"({len(sources)} lock files, "
         f"{sum(len(v) for v in hotpath.HOT_REGISTRY.values())} hot "
-        "functions, 1 schema/ABI surface, 1 metrics/docs surface)",
+        f"functions, {len(aggcheck.CACHE_REGISTRY)} keyed caches, "
+        "1 schema/ABI surface, 1 metrics/docs surface)",
         file=out,
     )
     return 1 if open_findings else 0

@@ -7,6 +7,8 @@ Finding codes (see docs/static_analysis.md for the full catalog):
 - VCL2xx  device hot-path hygiene (host syncs, donation, retrace)
 - VCL3xx  schema <-> C++ ABI drift (wire codec, ctypes bindings)
 - VCL4xx  metrics <-> docs drift (registry vs docs/metrics.md)
+- VCL5xx  persistent cycle-aggregate cache contract (keyed on the
+          mirror's mutation_seq/epoch/compact_gen machinery)
 
 Suppression convention: a finding is silenced by a trailing comment on
 the SAME line it is reported at, or by a comment-only line DIRECTLY
@@ -49,6 +51,9 @@ CODE_TITLES = {
     "VCL401": "metric series missing from docs/metrics.md",
     "VCL402": "documented metric series missing from the registry",
     "VCL403": "metric kind drift (docs vs registry)",
+    "VCL501": "_epoch_cached key missing the mirror epoch",
+    "VCL502": "persistent cache missing its declared invalidation",
+    "VCL503": "unregistered persistent cycle-aggregate cache",
 }
 
 

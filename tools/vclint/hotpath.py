@@ -119,6 +119,18 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         HotEntry("DeviceSnapshot._put_plane"),
         HotEntry("DeviceSnapshot._put_delta"),
     ],
+    "volcano_tpu/fastpath_incr.py": [
+        # Incremental host-lane delta scatters (ISSUE 8): host-only
+        # numpy by contract — registered so a device value leaking into
+        # the derive refresh trips VCL201 instead of a per-cycle sync.
+        HotEntry("CycleAggregates.refresh"),
+        HotEntry("CycleAggregates._apply_delta"),
+        HotEntry("CycleAggregates._scatter_side"),
+        HotEntry("CycleAggregates.live_status_counts"),
+        HotEntry("_build_aggregates"),
+        HotEntry("rank_from_cols"),
+        HotEntry("_lex_searchsorted"),
+    ],
     "volcano_tpu/ops/nodeclass.py": [
         # Host-only by contract (numpy planes in, numpy planes out);
         # registered so an accidental device value reaching the class

@@ -357,18 +357,25 @@ def parse_quantity(q: object) -> float:
 
 
 def parse_milli(q: object) -> float:
-    """Quantity -> milli-units (k8s Quantity.MilliValue)."""
+    """Quantity -> milli-units (k8s Quantity.MilliValue: rounded UP to
+    an integral milli count).  Integrality is load-bearing beyond
+    parity with the reference: the incremental cycle aggregates
+    (fastpath_incr.py) rely on requests being exact in float64 so the
+    subtract-old/add-new delta planes stay bit-for-bit with a full
+    rebuild — a fractional milli value would accrue ulp drift."""
     if isinstance(q, (int, float)):
-        # Numbers are whole units (e.g. cpu: 2 -> 2000 milli).
-        return float(q) * 1000.0
-    return math.ceil(parse_quantity(q) * 1000.0)
+        # Numbers are whole units (e.g. cpu: 2 -> 2000 milli); a
+        # fractional number (cpu: 0.0001) rounds up like the reference.
+        return float(math.ceil(float(q) * 1000.0))
+    return float(math.ceil(parse_quantity(q) * 1000.0))
 
 
 def parse_bytes(q: object) -> float:
-    """Quantity -> bytes (k8s Quantity.Value)."""
+    """Quantity -> bytes (k8s Quantity.Value: rounded UP to an integral
+    byte count; same integrality contract as parse_milli)."""
     if isinstance(q, (int, float)):
-        return float(q)
-    return parse_quantity(q)
+        return float(math.ceil(float(q)))
+    return float(math.ceil(parse_quantity(q)))
 
 
 def parse_count(q: object) -> float:

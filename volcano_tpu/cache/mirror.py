@@ -201,6 +201,9 @@ class StoreMirror:
         # pairs / job id; candidate pods for a new term come from the
         # pair->rows index.
         self.term_members: List[List[int]] = []
+        # Total memberships across terms: an O(1) content version for
+        # the encode cache (memberships only grow between compactions).
+        self.term_members_total = 0
         self._terms_by_pair: Dict[Tuple[str, str], List[int]] = {}
         self._terms_by_job: Dict[str, List[int]] = {}
         self._terms_all: List[int] = []  # empty-selector terms
@@ -329,6 +332,15 @@ class StoreMirror:
         self._orphans: Dict[str, List[str]] = {}
         # Epoch bumps force full fallback-path consumers to resync if needed.
         self.epoch = 0  # guarded-by: _lock
+        # Node-LIVENESS generation: bumped only when a node row's
+        # n_alive actually flips (join, rejoin, removal) — NOT on
+        # content-identical upserts or label/capacity edits.  The
+        # persistent cycle aggregates key on this instead of the full
+        # epoch: node liveness is the only node property the resident
+        # predicate reads, so routine node re-syncs/heartbeats keep the
+        # delta derive alive while real membership churn still forces
+        # the proven full rebuild.
+        self.node_liveness_gen = 0  # guarded-by: _lock
         # Monotone pod/node mutation counter: the pipelined cycle's
         # staleness guard compares the value captured at solve dispatch
         # against the value at fetch — equality proves NO pod/node state
@@ -345,6 +357,36 @@ class StoreMirror:
         # full [N, *] planes on every node-table epoch bump.
         self._node_dirty_rows: set = set()  # guarded-by: _lock
         self._node_dirty_floor = 0  # guarded-by: _lock
+        # Pod rows whose DYNAMIC state (status/node/job/alive) changed
+        # since the last derive consumed them (ISSUE 8): the incremental
+        # host-lane machinery (fastpath_incr.CycleAggregates) turns the
+        # per-cycle full-table reductions into subtract-old/add-new
+        # delta scatters over exactly these rows.  Every writer of the
+        # dynamic columns — the mirror's own mutators AND the fast
+        # path's bulk commits/unbinds/evictions — must mark the rows it
+        # touched, or the persistent aggregates silently drift; vclint's
+        # VCL50x family checks the contract statically and the
+        # VOLCANO_TPU_INCR_VERIFY=1 runtime guard checks it dynamically.
+        self._pod_dirty_mask = np.zeros(cap, bool)  # guarded-by: _lock
+        # Marked-row count with duplicates (the VOLCANO_TPU_DIRTY_CAP
+        # overflow trigger is O(1) per mark batch, not O(unique)).
+        self._pod_dirty_marks = 0  # guarded-by: _lock
+        # Tracking gave up for this span (cap overflow, resync_status):
+        # the next derive must full-rebuild, which resets it.
+        self._pod_dirty_overflow = False  # guarded-by: _lock
+        # Per-mirror memo of VOLCANO_TPU_DIRTY_CAP (the evict lane marks
+        # per row; an env read per mark would be its own hot path).
+        self._dirty_cap_memo = None  # guarded-by: _lock
+        # Monotone count of mark events: the pipelined staleness guard's
+        # agreement token — a dirty_seq advance between solve dispatch
+        # and commit implies a mutation_seq advance (never vice-free),
+        # so the guard can never skip a change the dirty set recorded.
+        self.dirty_seq = 0  # guarded-by: _lock
+        # Bumped whenever a pod RECORD slot changes (p_pod list writes:
+        # copy-on-write replacements, removals) — the commit path's
+        # object-array cache keys on it, so the 100k-element np.fromiter
+        # walk reruns only when a record actually moved.
+        self.pod_obj_gen = 0  # guarded-by: _lock
 
     # ================================================================ pods
 
@@ -514,6 +556,7 @@ class StoreMirror:
                 & self.p_alive[:len(self.p_uid)]
             )
             members.extend(int(r) for r in rows)
+            self.term_members_total += len(rows)
             return
         if sel:
             # Candidates: rows carrying the rarest selector pair.
@@ -535,6 +578,7 @@ class StoreMirror:
             juid = self.j_uid[jrow] if jrow >= 0 else ""
             if self._term_matches(e, pod.namespace, pod.labels, juid or ""):
                 members.append(row)
+                self.term_members_total += 1
 
     _pods_ref: Optional[Dict[str, Pod]] = None
 
@@ -558,6 +602,8 @@ class StoreMirror:
                 self._orphans.setdefault(pod.node_name, []).append(pod.uid)
         row = self.p_row.get(pod.uid)
         if row is not None and self.p_uid[row] == pod.uid:
+            self.mark_pod_dirty(row)
+            self.pod_obj_gen += 1
             self.p_pod[row] = pod
             if self.p_feat[row] is feat:
                 # Same spec blob (bind/evict copy-on-write carries it over):
@@ -573,6 +619,7 @@ class StoreMirror:
             # Spec changed: tombstone the old row, fall through to re-add.
             self.remove_pod(pod.uid)
         row = len(self.p_uid)
+        self.mark_pod_dirty(row)
         self.p_uid.append(pod.uid)
         self.p_key.append(f"{pod.namespace}/{pod.name}")
         self.p_pod.append(pod)
@@ -652,6 +699,7 @@ class StoreMirror:
             for e in cand:
                 if self._term_matches(e, pod.namespace, pod.labels, juid):
                     self.term_members[e].append(row)
+                    self.term_members_total += 1
 
     # holds: _lock
     def remove_pod(self, uid: str) -> None:
@@ -659,6 +707,8 @@ class StoreMirror:
         if row is None:
             return
         self.mutation_seq += 1
+        self.mark_pod_dirty(row)
+        self.pod_obj_gen += 1
         self.p_alive[row] = False
         self.p_uid[row] = None
         self.p_node_name[row] = None
@@ -672,6 +722,7 @@ class StoreMirror:
         row = self.p_row.get(uid)
         if row is not None:
             self.mutation_seq += 1
+            self.mark_pod_dirty(row)
             self.p_status[row] = status
             self.p_node[row] = node_row
             self.p_node_name[row] = (
@@ -733,6 +784,8 @@ class StoreMirror:
             self._node_csr_row = getattr(self, "_node_csr_row", {})
             self._node_csr_row[row] = nrow
         self.n_ready[row] = bool(node.ready) and not node.unschedulable
+        if new or not self.n_alive[row]:
+            self.node_liveness_gen += 1
         self.n_alive[row] = True
         self.n_maxtasks[row] = alloc.max_task_num
         self._node_dom_dirty = True
@@ -742,6 +795,7 @@ class StoreMirror:
         for uid in self._orphans.pop(node.name, []):
             prow = self.p_row.get(uid)
             if prow is not None:
+                self.mark_pod_dirty(prow)
                 self.p_node[prow] = row
         return row
 
@@ -759,6 +813,8 @@ class StoreMirror:
     def remove_node(self, name: str) -> None:
         row = self.n_row.get(name)
         if row is not None:
+            if self.n_alive[row]:
+                self.node_liveness_gen += 1
             self.n_alive[row] = False
             # Pods pointing at this node keep their row; their node col is
             # fixed up by the per-cycle liveness mask (n_alive).
@@ -780,6 +836,100 @@ class StoreMirror:
     def reset_node_delta(self) -> None:
         self._node_dirty_rows.clear()
         self._node_dirty_floor = self.epoch
+
+    # ------------------------------------------------------ pod dirty set
+
+    @staticmethod
+    def dirty_cap() -> int:
+        """VOLCANO_TPU_DIRTY_CAP (docs/tuning.md): marked-row budget per
+        derive span, counted WITH duplicates so the overflow check is
+        O(1) per mark batch.  Past it the tracker gives up and the next
+        derive full-rebuilds — the bound on both the mask bookkeeping
+        and the delta-scatter work a single derive can be handed."""
+        import os
+
+        raw = os.environ.get("VOLCANO_TPU_DIRTY_CAP", "262144")
+        try:
+            return max(int(raw), 0)
+        except ValueError:
+            return 262144
+
+    # holds: _lock
+    def mark_pods_dirty(self, rows) -> None:
+        """Record pod rows whose dynamic state (status/node/job/alive)
+        just changed.  Idempotent per row; vectorized for the fast
+        path's bulk writers (a 100k-row commit pays one mask scatter)."""
+        n = len(rows)
+        if not n:
+            return
+        self.dirty_seq += 1
+        if self._pod_dirty_overflow:
+            return
+        cap = self._dirty_cap_memo
+        if cap is None:
+            cap = self._dirty_cap_memo = self.dirty_cap()
+        self._pod_dirty_marks += n
+        if self._pod_dirty_marks > cap:
+            self._pod_dirty_overflow = True
+            return
+        mask = self._pod_dirty_mask
+        top = int(np.max(rows)) if not isinstance(rows, np.ndarray) \
+            else int(rows.max())
+        if top >= len(mask):
+            mask = self._pod_dirty_mask = self._grow_mask(mask, top + 1)
+        mask[rows] = True
+
+    # holds: _lock
+    def mark_pod_dirty(self, row: int) -> None:
+        """Scalar ``mark_pods_dirty`` for the per-row mutators."""
+        self.dirty_seq += 1
+        if self._pod_dirty_overflow:
+            return
+        cap = self._dirty_cap_memo
+        if cap is None:
+            cap = self._dirty_cap_memo = self.dirty_cap()
+        self._pod_dirty_marks += 1
+        if self._pod_dirty_marks > cap:
+            self._pod_dirty_overflow = True
+            return
+        mask = self._pod_dirty_mask
+        if row >= len(mask):
+            mask = self._pod_dirty_mask = self._grow_mask(mask, row + 1)
+        mask[row] = True
+
+    @staticmethod
+    def _grow_mask(mask: np.ndarray, n: int) -> np.ndarray:
+        """Zero-filled growth — np.resize TILES the old contents, which
+        would plant stale True bits at rows beyond the live table."""
+        out = np.zeros(max(n, len(mask) * 2), bool)
+        out[:len(mask)] = mask
+        return out
+
+    # holds: _lock
+    def mark_pods_overflow(self) -> None:
+        """Give up tracking for this span (bulk resyncs): the next
+        derive must full-rebuild."""
+        self.dirty_seq += 1
+        self._pod_dirty_overflow = True
+
+    # holds: _lock
+    def consume_pod_dirty(self, n_rows: int):
+        """Hand the dirty rows (< ``n_rows``) to the single consumer
+        (the derive-time aggregate refresh) and reset tracking.  Returns
+        ``None`` when tracking overflowed — the caller must rebuild."""
+        overflow = self._pod_dirty_overflow
+        mask = self._pod_dirty_mask
+        rows = None
+        if not overflow:
+            rows = np.flatnonzero(mask[:n_rows])
+            mask[rows] = False
+            # Rows at/beyond n_rows cannot exist: the mask only ever
+            # marks rows of the live table, and compaction resets it.
+        else:
+            mask[:] = False
+        self._pod_dirty_marks = 0
+        self._pod_dirty_overflow = False
+        return rows
 
     def node_dom(self) -> np.ndarray:
         """[Nrows, K] topology domain ids (interned, append-only)."""
@@ -954,7 +1104,8 @@ class StoreMirror:
                      "j_pg", "j_phase_code", "j_st_run", "j_st_fail",
                      "j_st_succ", "j_cond_sig", "j_gauge_key",
                      "j_event_key",
-                     "j_alive", "_pods_ref", "_orphans", "epoch"):
+                     "j_alive", "_pods_ref", "_orphans", "epoch",
+                     "node_liveness_gen"):
             setattr(fresh, attr, getattr(old, attr))
         fresh._node_dom_dirty = True
         if hasattr(old, "_node_csr_row"):
@@ -1013,6 +1164,9 @@ class StoreMirror:
             [int(remap[m]) for m in members if remap[m] >= 0]
             for members in old.term_members
         ]
+        fresh.term_members_total = sum(
+            len(members) for members in fresh.term_members
+        )
         fresh._pods_by_pair = {
             kv: [int(remap[r]) for r in rows if remap[r] >= 0]
             for kv, rows in old._pods_by_pair.items()
@@ -1021,12 +1175,19 @@ class StoreMirror:
         # row indices held by in-flight solves are void now, so bump the
         # generation; any delta consumer must also full-resync.
         seq, gen = self.mutation_seq, self.compact_gen
+        dseq = self.dirty_seq
         dirty, floor = self._node_dirty_rows, self._node_dirty_floor
         self.__dict__.update(fresh.__dict__)
         self.mutation_seq = seq + 1
         self.compact_gen = gen + 1
         self._node_dirty_rows = dirty
         self._node_dirty_floor = floor
+        # Row renumbering voids the pod dirty mask wholesale; the
+        # compact_gen bump already forces the aggregate consumer to
+        # full-rebuild (which resets tracking), so a fresh zero mask
+        # (from fresh.__init__) is exactly right — only the monotone
+        # agreement token must survive.
+        self.dirty_seq = dseq + 1
 
     # holds: _lock
     def resync_status(self, pods: Dict[str, "Pod"]) -> None:
@@ -1034,6 +1195,9 @@ class StoreMirror:
         (the system of record).  Recovery path: a failed fast cycle may
         leave uncommitted status mutations in the mirror."""
         self.mutation_seq += 1
+        # Every live row may change: per-row marking would cost as much
+        # as the rebuild it exists to avoid.
+        self.mark_pods_overflow()
         for uid, row in self.p_row.items():
             pod = pods.get(uid)
             if pod is None:

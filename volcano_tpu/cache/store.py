@@ -182,6 +182,24 @@ class ClusterStore:
         # under _lock), cleared by close() and pod-table compaction —
         # a declared, lock-guarded slot, not an ad-hoc attribute.
         self._mesh_plane_cache: Dict = {}  # guarded-by: _lock (any-receiver)
+        # Incremental host-lane caches (ISSUE 8, fastpath.py /
+        # fastpath_incr.py): content-validated results the steady-state
+        # cycle reuses instead of re-deriving — the job-order rank (+
+        # its key columns), the pending-task order, the encode-lane
+        # profile/affinity structures, the commit path's object arrays,
+        # the feed lane's unbind request gather, and the close lane's
+        # gang gauge lists.  All written and read ONLY by the cycle
+        # thread under the store lock (FastCycle class-holds) and
+        # dropped on close(); each carries the mirror versions
+        # (mutation-driven content, compact_gen/epoch keys) its entries
+        # are valid under — the VCL50x keyed-cache contract.
+        self._job_rank_cache = None  # guarded-by: _lock (any-receiver)
+        self._pending_order_cache = None  # guarded-by: _lock (any-receiver)
+        self._encode_cache = None  # guarded-by: _lock (any-receiver)
+        self._objarr_cache = None  # guarded-by: _lock (any-receiver)
+        self._unbind_gather_cache = None  # guarded-by: _lock (any-receiver)
+        self._close_gang_cache = None  # guarded-by: _lock (any-receiver)
+
         # Migration ledger (actions/rebalance.py MigrationLedger),
         # attached by the rebalance lane's first committed plan; the
         # delete_pod hook below restores terminating victims through it.
@@ -383,6 +401,14 @@ class ClusterStore:
             # Mesh plane cache pins per-device arrays across cycles;
             # a closed store must release them with everything else.
             self._mesh_plane_cache.clear()
+            # Host-lane caches pin large arrays (and pod records, via
+            # the object arrays); a closed store must not.
+            self._job_rank_cache = None
+            self._pending_order_cache = None
+            self._encode_cache = None
+            self._objarr_cache = None
+            self._unbind_gather_cache = None
+            self._close_gang_cache = None
         if self._bind_dispatcher is not None:
             self._bind_dispatcher.stop()
             self._bind_dispatcher = None

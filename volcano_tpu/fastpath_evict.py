@@ -141,6 +141,9 @@ class EvictState:
         n = int(m.p_node[row])
         req = self.req[row]
         m.p_status[row] = ST_RELEASING
+        # Direct mirror status write: the incremental derive's dirty set
+        # must see it (the action stamps mutation_seq at its end).
+        m.mark_pod_dirty(row)
         c.n_releasing[n] += req
         self.fi[n] += req
         jr = int(m.p_job[row])
@@ -168,6 +171,7 @@ class EvictState:
         m = c.m
         req = self.req[row]
         m.p_status[row] = ST_RUNNING
+        m.mark_pod_dirty(row)
         c.n_releasing[n] -= req
         self.fi[n] -= req
         if jr >= 0:

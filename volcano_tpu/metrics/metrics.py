@@ -228,6 +228,14 @@ class Metrics:
             "(required inter-pod terms drifted from the solve-start "
             "counts the shortlist was built on)",
         )
+        self.host_incremental_derives = _Counter(
+            f"{ns}_host_incremental_derives_total",
+            "Derive-lane aggregate refreshes by mode: delta "
+            "(subtract-old/add-new scatters over the mirror's dirty "
+            "row set) or full (the proven rebuild fallback: first "
+            "derive, node-membership churn, compaction, dirty-set overflow "
+            "past VOLCANO_TPU_DIRTY_CAP, or VOLCANO_TPU_INCREMENTAL=0)",
+        )
         self.pipeline_stale_drops = _Counter(
             f"{ns}_pipeline_stale_drop_rows_total",
             "In-flight solve rows that did not commit, by reason: the "

@@ -50,13 +50,13 @@ class InflightSolve:
     __slots__ = (
         "kind", "payload", "solve_jobs", "task_rows", "req_gather",
         "mutation_seq", "epoch", "compact_gen", "n_nodes", "solve_id",
-        "fallbacks",
+        "fallbacks", "dirty_seq",
     )
 
     def __init__(self, kind: str, payload, solve_jobs: List[int],
                  task_rows: np.ndarray, req_gather: Tuple,
                  mutation_seq: int, epoch: int, compact_gen: int,
-                 n_nodes: int, solve_id: int = 0):
+                 n_nodes: int, solve_id: int = 0, dirty_seq: int = 0):
         self.kind = kind
         self.payload = payload
         self.solve_jobs = solve_jobs
@@ -75,6 +75,15 @@ class InflightSolve:
         # counts of the solve, populated by fetch(); the commit folds
         # them into the per-reason counter series.
         self.fallbacks = (0, 0)
+        # The mirror's dirty-set event counter at dispatch (ISSUE 8):
+        # the incremental derive and this guard must agree on what
+        # "changed" means — a dirty_seq advance during the overlap
+        # implies a mutation_seq advance (every marking writer also
+        # bumps the mutation counter, or epoch/compact_gen), so
+        # mutation_seq equality at fetch proves the dirty set recorded
+        # no pod-state change either.  ``_commit_inflight`` asserts the
+        # implication; tests/test_incremental.py churns it.
+        self.dirty_seq = dirty_seq
 
     # ----------------------------------------------------------- lifecycle
 
