@@ -82,6 +82,14 @@ _MODE_SUFFIX = ""
 # BENCH_MESH A/B driver state: the jax.sharding.Mesh the benched stores
 # dispatch over ("(mesh on)" pass), or None for the plain pass.
 _MESH = None
+# BENCH_DEVINCR driver state (ISSUE 9): the fraction of bound rows the
+# pipelined feed re-pends per cycle (1.0 = everything — the classic
+# steady-state loop; the devincr A/B uses a sparse fraction so the
+# dirty set looks like production churn, not a full re-pend), and
+# whether to append a null-delta probe (feed off for two cycles,
+# asserting the skip path) to the pipelined pass.
+_FEED_FRACTION = 1.0
+_DEVINCR_PROBE = False
 
 # The HOST lanes whose serial sum floors the pipelined cycle (ISSUE 8):
 # everything the cycle thread does besides the device dispatch/fetch.
@@ -114,7 +122,7 @@ def _twophase_env(on: bool, topk: int = 0):
 
 
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
-          records=None, fallbacks=None, rebalance=None):
+          records=None, fallbacks=None, rebalance=None, devincr=None):
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
@@ -134,6 +142,10 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # Two-phase shortlist-fallback rescores over the measured
         # cycles, by reason (docs/metrics.md).
         payload["shortlist_fallbacks"] = dict(fallbacks)
+    if devincr:
+        # Device-incremental decisions over the measured cycles
+        # (warm/full/skip counts + static-plane hits, ISSUE 9).
+        payload["devincr"] = dict(devincr)
     if lanes:
         # Lane split rides in the JSON tail so the driver's BENCH_rXX
         # artifacts carry the per-mode breakdown, not just the total.
@@ -282,6 +294,12 @@ def _pipelined_bench(make_store, conf, cycles=None):
         rows = np.flatnonzero(
             (m.p_status[:fc.Pn] == st_bound) & m.p_alive[:fc.Pn]
         )
+        if _FEED_FRACTION < 1.0 and len(rows):
+            # Sparse steady-state churn (BENCH_DEVINCR): re-pend only a
+            # fraction of the bound rows, so the per-cycle dirty set
+            # looks like production (a few hundred rows), not a full
+            # backlog re-pend.
+            rows = rows[:max(1, int(len(rows) * _FEED_FRACTION))]
         if len(rows):
             fed["total"] += len(rows)
             fc._unbind_rows(rows)
@@ -291,6 +309,14 @@ def _pipelined_bench(make_store, conf, cycles=None):
     t0 = time.perf_counter()
     sched.run_once()  # warm-up: compile + first dispatch (no commit yet)
     sched.run_once()  # pipeline fill: first commit lands
+    if _DEVINCR_PROBE:
+        # Device-incremental A/B: the warm-shortlist kernel compiles on
+        # its FIRST warm-eligible cycle (the pending set stabilizes a
+        # couple of cycles after the backlog first commits); keep that
+        # compile out of the measured steady state, in every mode (the
+        # extra cycles are mode-symmetric).
+        for _ in range(3):
+            sched.run_once()
     warm_s = time.perf_counter() - t0
     # Steady-state seam reset: the re-pend feed keeps the backlog
     # constant, but the two warm-up cycles already accumulated
@@ -316,16 +342,59 @@ def _pipelined_bench(make_store, conf, cycles=None):
     # compile + pipeline-fill time and would skew the percentiles).
     records = store.flight.recent()[-len(times):]
     fallbacks = dict(getattr(store, "_shortlist_fb", {}) or {})
+    devincr = None
+    dv = getattr(store, "_devincr_cache", None)
+    if dv is not None:
+        devincr = dict(dv.counts)
+        devincr["static_hits"] = dv.static_hits
+        devincr["static_builds"] = dv.static_builds
+    if _DEVINCR_PROBE:
+        # Null-delta probe (ISSUE 9): feed off, backlog committed, ONE
+        # pending-but-unschedulable gang keeping the pending set
+        # non-empty (an empty set early-outs before any solve and would
+        # prove nothing).  With the lane on, idle cycles must complete
+        # WITHOUT a solve dispatch (the skip proof); with it off, every
+        # cycle re-dispatches the futile solve — measured, not assumed.
+        from volcano_tpu.api import (
+            GROUP_NAME_ANNOTATION as _GNA,
+            Pod as _Pod,
+            PodGroup as _PodGroup,
+        )
+
+        store.cycle_feed = None
+        sched.run_once()  # commits the last dispatched solve
+        store.add_pod_group(_PodGroup(name="bench-nullprobe",
+                                      min_member=1))
+        store.add_pod(_Pod(
+            name="bench-nullprobe-0",
+            annotations={_GNA: "bench-nullprobe"},
+            containers=[{"cpu": "900000", "memory": "900000Gi"}],
+        ))
+        sched.run_once()  # dispatches the (failing) probe solve
+        sched.run_once()  # commits its empty result
+        seq0 = store._solve_seq
+        skip0 = dv.counts["skip"] if dv is not None else 0
+        t0 = time.perf_counter()
+        probe_n = 2
+        for _ in range(probe_n):
+            sched.run_once()
+        probe_ms = (time.perf_counter() - t0) / probe_n * 1e3
+        if devincr is None:
+            devincr = {}
+        devincr["null_delta_cycle_ms"] = round(probe_ms, 3)
+        devincr["null_delta_dispatches"] = store._solve_seq - seq0
+        if dv is not None:
+            devincr["null_delta_skips"] = dv.counts["skip"] - skip0
     store.close()
     return (amortized_ms, bound_per_cycle, warm_s, times, lanes, records,
-            fallbacks)
+            fallbacks, devincr)
 
 
 def _emit_pipelined(label, mk, conf, n_pods):
     if os.environ.get("BENCH_PIPELINE", "1") == "0":
         return
     (amortized_ms, bound, warm_s, times, lanes, records,
-     fallbacks) = _pipelined_bench(mk, conf)
+     fallbacks, devincr) = _pipelined_bench(mk, conf)
     _emit(
         f"{label} (pipelined steady-state, amortized {len(times)} cycles)",
         amortized_ms, n_pods,
@@ -336,6 +405,7 @@ def _emit_pipelined(label, mk, conf, n_pods):
         lanes=lanes,
         records=records,
         fallbacks=fallbacks,
+        devincr=devincr,
     )
 
 
@@ -771,7 +841,7 @@ def _run_selected(raw, repeats):
 
 
 def main():
-    global _MODE_SUFFIX, _MESH
+    global _MODE_SUFFIX, _MESH, _FEED_FRACTION, _DEVINCR_PROBE
     raw = os.environ.get("BENCH_CONFIG", "north")
     # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
     # between runs, and the minimum is the stable estimator.
@@ -832,6 +902,51 @@ def main():
                 _run_selected(raw, repeats)
         finally:
             _MODE_SUFFIX = ""
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return
+    dev = os.environ.get("BENCH_DEVINCR")
+    if dev:
+        # Device-lane incremental A/B (ISSUE 9): the selected config
+        # runs three times — "(devincr on)" (persistent static planes +
+        # warm shortlists + null-delta skips), "(devincr off)"
+        # (VOLCANO_TPU_DEVINCR=0: every solve re-evaluates statics and
+        # re-ranks all N), and "(devincr fallback)" (the lane is ON but
+        # VOLCANO_TPU_DIRTY_CAP=1 overflows tracking every cycle, so
+        # the proven full-recompute fallback is EXERCISED and measured,
+        # not just dodged).  The pipelined feed re-pends only
+        # BENCH_DEVINCR_FRAC of the bound rows (default 5%) so the
+        # steady-state dirty set looks like production churn, and each
+        # pipelined pass ends with a null-delta probe (two feed-less
+        # cycles that must skip the dispatch wholesale).
+        try:
+            frac = float(os.environ.get("BENCH_DEVINCR_FRAC", "0.05"))
+        except ValueError:
+            frac = 0.05
+        modes = (
+            ("on", {"VOLCANO_TPU_DEVINCR": "1"}),
+            ("off", {"VOLCANO_TPU_DEVINCR": "0"}),
+            ("fallback", {"VOLCANO_TPU_DEVINCR": "1",
+                          "VOLCANO_TPU_DIRTY_CAP": "1"}),
+        )
+        keys = {k for _, env in modes for k in env}
+        old = {k: os.environ.get(k) for k in keys}
+        _FEED_FRACTION = min(max(frac, 0.0), 1.0)
+        _DEVINCR_PROBE = True
+        try:
+            for mode, env in modes:
+                for k in keys:
+                    os.environ.pop(k, None)
+                os.environ.update(env)
+                _MODE_SUFFIX = f" (devincr {mode})"
+                _run_selected(raw, repeats)
+        finally:
+            _MODE_SUFFIX = ""
+            _FEED_FRACTION = 1.0
+            _DEVINCR_PROBE = False
             for k, v in old.items():
                 if v is None:
                     os.environ.pop(k, None)

@@ -242,11 +242,17 @@ int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len, uint8_t* dtypes,
   int32_t n = vcsnap_frame_info(buf, len, &moff, &mlen);
   if (n < 0) return -1;
   int64_t off = vcsnap_align8(16 + mlen);
+  // Bounds checks below are written as `X > len - off`, never
+  // `off + X > len`: a hostile header can put a value near INT64_MAX
+  // in an additive position, and `off + X` would wrap (signed
+  // overflow, UB) into a PASSING comparison.  `off` stays within
+  // [0, len + 7] throughout (the +7 from align8 rounding), so
+  // `len - off` never overflows and a negative difference rejects.
   for (int32_t i = 0; i < n; ++i) {
-    if (off + 16 > len) return -1;
+    if (16 > len - off) return -1;
     uint8_t nd = buf[off + 1];
     if (nd > kVcsnapMaxDims) return -1;
-    if (off + 8 + 8 * static_cast<int64_t>(nd) + 8 > len) return -1;
+    if (8 + 8 * static_cast<int64_t>(nd) + 8 > len - off) return -1;
     uint8_t dt = buf[off];
     if (dt >= kVcsnapNDtypes) return -1;
     dtypes[i] = dt;
@@ -268,7 +274,7 @@ int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len, uint8_t* dtypes,
     // reader's zero-copy view would bleed into the next array's bytes.
     if (nb != elems * kVcsnapDtypes[dt].size) return -1;
     off += vcsnap_header_bytes(nd);
-    if (off + nb > len) return -1;
+    if (nb < 0 || nb > len - off) return -1;
     data_off[i] = off;
     nbytes[i] = nb;
     off += vcsnap_align8(nb);

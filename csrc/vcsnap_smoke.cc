@@ -176,9 +176,87 @@ static void smoke_drive_mq() {
   std::printf("drive_mq smoke OK\n");
 }
 
+// Crafted-frame regression for the vcsnap_frame_unpack bounds checks:
+// every `off + X > len` comparison was rewritten `X > len - off`
+// because a hostile nb near INT64_MAX wrapped the addition (signed
+// overflow, UB) into a PASSING check.  Under the UBSan test build the
+// OLD form traps here; the new form must reject every corruption with
+// -1 and still accept the valid frame.
+static void smoke_hostile_frames() {
+  // Valid 2-array frame via the real packer: f32[3] + int8[5].
+  std::vector<float> a0 = {1.0f, 2.0f, 3.0f};
+  std::vector<uint8_t> a1 = {1, 2, 3, 4, 5};
+  uint8_t dtypes[2] = {0, 6};  // kVcsnapDtypes: 0 = f32, 6 = uint8
+  uint8_t ndims[2] = {1, 1};
+  int64_t dims_flat[2] = {3, 5};
+  int64_t nbytes[2] = {12, 5};
+  const uint8_t* srcs[2] = {
+      reinterpret_cast<const uint8_t*>(a0.data()), a1.data()};
+  const char* man = "{\"op\":\"x\"}";
+  int64_t mlen = 10;
+  int64_t total = vcsnap_frame_bytes(ndims, nbytes, 2, mlen);
+  std::vector<uint8_t> frame(static_cast<size_t>(total), 0);
+  vcsnap_frame_pack(dtypes, ndims, dims_flat, nbytes, srcs, 2,
+                    reinterpret_cast<const uint8_t*>(man), mlen,
+                    frame.data());
+  uint8_t out_dt[2], out_nd[2];
+  int64_t out_dims[16], out_off[2], out_nb[2];
+  assert(vcsnap_frame_unpack(frame.data(), total, out_dt, out_nd,
+                             out_dims, out_off, out_nb) == 0);
+  assert(out_nb[0] == 12 && out_nb[1] == 5);
+  assert(std::memcmp(frame.data() + out_off[1], a1.data(), 5) == 0);
+
+  // Locate array 0's header: it starts right after the aligned
+  // manifest; its nb field sits at header + 8 + 8*nd.
+  int64_t hdr0 = (16 + mlen + 7) & ~int64_t(7);
+  int64_t nb_at = hdr0 + 8 + 8 * 1;
+
+  // (1) nb near INT64_MAX: the old `off + nb > len` wrapped negative
+  // and accepted; the rewritten `nb > len - off` must reject (the
+  // dtype-width equality also rejects — both layers must hold).
+  std::vector<uint8_t> evil = frame;
+  int64_t huge = INT64_MAX - 4;
+  std::memcpy(evil.data() + nb_at, &huge, 8);
+  assert(vcsnap_frame_unpack(evil.data(), total, out_dt, out_nd,
+                             out_dims, out_off, out_nb) == -1);
+
+  // (2) nb consistent with a hostile dim that claims the whole frame:
+  // dim = total (so elems*size passes the equality for int8 only if
+  // nb == total) — data would run past the end; must reject.
+  evil = frame;
+  int64_t dim_at = hdr0 + 8;
+  // Rewrite array 0 as int8[total] with nb = total.
+  evil[hdr0] = 6;  // int8
+  std::memcpy(evil.data() + dim_at, &total, 8);
+  std::memcpy(evil.data() + nb_at, &total, 8);
+  assert(vcsnap_frame_unpack(evil.data(), total, out_dt, out_nd,
+                             out_dims, out_off, out_nb) == -1);
+
+  // (3) negative nb must reject.
+  evil = frame;
+  int64_t neg = -8;
+  std::memcpy(evil.data() + nb_at, &neg, 8);
+  assert(vcsnap_frame_unpack(evil.data(), total, out_dt, out_nd,
+                             out_dims, out_off, out_nb) == -1);
+
+  // (4) truncated frame: headers intact, last data segment cut short.
+  assert(vcsnap_frame_unpack(frame.data(), total - 4, out_dt, out_nd,
+                             out_dims, out_off, out_nb) == -1);
+
+  // (5) negative dim must reject (elems guard).
+  evil = frame;
+  int64_t negdim = -3;
+  std::memcpy(evil.data() + dim_at, &negdim, 8);
+  assert(vcsnap_frame_unpack(evil.data(), total, out_dt, out_nd,
+                             out_dims, out_off, out_nb) == -1);
+
+  std::printf("hostile-frame unpack OK\n");
+}
+
 int main() {
   std::printf("vcsnap_version=%d\n", vcsnap_version());
   smoke_serializer();
+  smoke_hostile_frames();
 
   // Cluster: 4 nodes x 2 slots; queue 0 = "victim" (reclaimable),
   // queue 1 = "premium".  Rows 0-7: running victims (job per row, queue

@@ -30,6 +30,30 @@ assert modes == want, f"missing BENCH_HOST modes: {want - modes}"
 assert any("host_lanes_ms" in r for r in rows), "no host_lanes_ms tail"
 print(f"BENCH_HOST smoke OK ({len(rows)} rows)")
 '
+# BENCH_DEVINCR smoke (ISSUE 9): the device-lane incremental A/B at a
+# small shape — asserts all three modes (on / off / dirty-cap
+# forced-fallback) complete, pipeline, and emit their devincr JSON
+# tails, the on/fallback passes actually take their warm/full paths,
+# and the null-delta probe completes WITHOUT a solve dispatch when the
+# lane is on.
+BENCH_DEVINCR=1 BENCH_CONFIG=2 BENCH_NODES=128 BENCH_PODS=1024 \
+  BENCH_REPEATS=1 BENCH_PIPE_CYCLES=5 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+want = {"(devincr on)", "(devincr off)", "(devincr fallback)"}
+modes = {m for m in want for r in rows if m in r["metric"]}
+assert modes == want, f"missing BENCH_DEVINCR modes: {want - modes}"
+tails = {m: r["devincr"] for m in want for r in rows
+         if m in r["metric"] and "devincr" in r}
+assert tails["(devincr on)"]["warm"] >= 1, tails
+assert tails["(devincr on)"]["null_delta_dispatches"] == 0, tails
+assert tails["(devincr on)"]["null_delta_skips"] >= 1, tails
+assert tails["(devincr fallback)"]["warm"] == 0, tails
+assert tails["(devincr fallback)"]["full"] >= 1, tails
+assert tails["(devincr off)"]["null_delta_dispatches"] >= 1, tails
+print(f"BENCH_DEVINCR smoke OK ({len(rows)} rows)")
+'
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

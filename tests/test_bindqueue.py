@@ -388,3 +388,46 @@ def test_apply_pending_bind_records_covers_undispatched_batches():
     store.flush_binds(timeout=30)
     assert len(store.binder.binds) == 32
     store.close()
+
+
+def test_materialize_bind_entry_removes_by_identity():
+    """Regression (ISSUE 9 satellite): ``_materialize_bind_entry`` used
+    ``list.remove``, whose == scan compares this entry against OTHER
+    pending entries — and two entries holding numpy object arrays raise
+    the ambiguous-truth ValueError mid-scan, which the old handler
+    swallowed.  The materialized entry then stayed registered forever
+    and ``apply_pending_bind_records`` (which loops until the list
+    drains) never terminated.  Removal is now by identity."""
+    import numpy as np
+
+    from volcano_tpu.cache import ClusterStore
+
+    class Rec:
+        node_name = None
+
+    store = ClusterStore()
+
+    def batch(n, tag):
+        keys = np.array([f"default/{tag}-{i}" for i in range(n)],
+                        dtype=object)
+        hosts = np.array([f"n{i}" for i in range(n)], dtype=object)
+        pods = np.array([Rec() for _ in range(n)], dtype=object)
+        return keys, hosts, pods
+
+    e1 = store.defer_bind_records(*batch(3, "a"))
+    e2 = store.defer_bind_records(*batch(3, "b"))
+    # Materialize the SECOND entry first: the removal scan compares it
+    # against e1 (numpy object arrays on both sides) before reaching
+    # e2 — exactly the ambiguous-truth trap.
+    keys, hosts, pods = store._materialize_bind_entry(e2)
+    assert keys == ["default/b-0", "default/b-1", "default/b-2"]
+    assert [p.node_name for p in pods] == ["n0", "n1", "n2"]
+    # The entry must be GONE (by identity) — pre-fix it was stranded
+    # with entry[3] already True, the unbounded-loop condition.
+    assert not any(e is e2 for e in store._pending_record_walks)
+    # And the drain loop terminates, applying the remaining batch.
+    store.apply_pending_bind_records()
+    assert store._pending_record_walks == []
+    assert not any(e is e1 for e in store._pending_record_walks)
+    assert e1[3] is True
+    store.close()

@@ -68,6 +68,13 @@ CACHE_REGISTRY: Dict[str, Set[str]] = {
     # consumed dirty set.
     "_cycle_aggr": {"node_liveness_gen", "compact_gen",
                     "consume_pod_dirty"},
+    # Device-lane incremental context (ISSUE 9, ops/devincr.py): the
+    # persistent [U, C] static planes + warm-shortlist candidates +
+    # null-delta skip proof.  Keys assembled in
+    # FastCycle._devincr_prepare / _null_delta_token: node churn
+    # (epoch / node_liveness_gen), row renumbering (compact_gen), plus
+    # content tokens (class-table sig, profile generation, cnt0 hash).
+    "_devincr_cache": {"epoch", "node_liveness_gen", "compact_gen"},
 }
 
 # Files whose cache accesses are analyzed (the incremental host-lane

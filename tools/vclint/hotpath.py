@@ -41,6 +41,10 @@ DEVICE_FNS = {
     # winner-reduction helper and the cycle's mesh dispatch both return
     # device values.
     "_topk_nodes", "_solve_mesh_dispatch",
+    # Device-incremental lane (ISSUE 9): the static-plane producer,
+    # the warm-shortlist kernel, and the DeviceIncremental services
+    # that return their cached device results.
+    "_static_planes", "_warm_shortlist", "static_planes", "shortlist",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -110,6 +114,14 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
             "node_classes.class_id", "node_classes.label_bits",
             "node_classes.taint_bits", "node_classes.ready",
         )),
+    ],
+    "volcano_tpu/ops/devincr.py": [
+        # Device-incremental services (ISSUE 9): they juggle the
+        # persistent device planes on the cycle thread — an implicit
+        # sync here (fetching a cached plane back just to inspect it)
+        # would stall every steady-state dispatch.
+        HotEntry("DeviceIncremental.static_planes"),
+        HotEntry("DeviceIncremental.shortlist"),
     ],
     "volcano_tpu/ops/devsnap.py": [
         HotEntry("DeviceSnapshot.node_planes"),

@@ -199,6 +199,13 @@ class ClusterStore:
         self._objarr_cache = None  # guarded-by: _lock (any-receiver)
         self._unbind_gather_cache = None  # guarded-by: _lock (any-receiver)
         self._close_gang_cache = None  # guarded-by: _lock (any-receiver)
+        # Device-lane incremental context (ISSUE 9, ops/devincr.py):
+        # persistent [U, C] static planes + warm-shortlist candidates +
+        # the null-delta skip proof, keyed on mirror versions
+        # (epoch / compact_gen / node_liveness_gen) and content tokens
+        # (class-table sig, profile generation, cnt0 hash) assembled by
+        # FastCycle._devincr_prepare.  Cycle-thread only, under _lock.
+        self._devincr_cache = None  # guarded-by: _lock (any-receiver)
 
         # Migration ledger (actions/rebalance.py MigrationLedger),
         # attached by the rebalance lane's first committed plan; the
@@ -347,10 +354,17 @@ class ClusterStore:
                     pod.node_name = hostname
                 entry[0], entry[1], entry[2] = keys, hosts, pods
                 entry[3] = True
-                try:
-                    self._pending_record_walks.remove(entry)
-                except ValueError:
-                    pass
+                # Remove by IDENTITY, never list.remove: remove scans
+                # with ==, and comparing this entry against a DIFFERENT
+                # pending entry compares their numpy object arrays
+                # elementwise — the ambiguous-truth ValueError that was
+                # previously swallowed here left the entry stranded,
+                # and apply_pending_bind_records (which loops until the
+                # list drains) then never terminated.
+                for i, e in enumerate(self._pending_record_walks):
+                    if e is entry:
+                        del self._pending_record_walks[i]
+                        break
             return entry[0], entry[1], entry[2]
 
     def apply_pending_bind_records(self) -> None:
@@ -409,6 +423,9 @@ class ClusterStore:
             self._objarr_cache = None
             self._unbind_gather_cache = None
             self._close_gang_cache = None
+            # Device-incremental planes pin device buffers (static
+            # planes + shortlist candidates); release them too.
+            self._devincr_cache = None
         if self._bind_dispatcher is not None:
             self._bind_dispatcher.stop()
             self._bind_dispatcher = None

@@ -301,6 +301,19 @@ class FastCycle:
         # the bench A/B (BENCH_HOST=1) measures the full surface.
         self._incr = incremental_on()
         self.derive_mode = aggr.refresh(m, Pn, Nn, R, self.n_alive)
+        # Device-lane incrementality (ISSUE 9): fold this derive's
+        # changed-node capture into the store's DeviceIncremental — the
+        # warm-shortlist diff is against the previous SOLVE, which may
+        # be several derives back (skip cycles consume empty sets in
+        # between).  A full derive poisons the accumulator, so the next
+        # solve provably re-ranks fully.
+        from .ops.devincr import devincr_on, of_store
+
+        if devincr_on():
+            of_store(self.store).accumulate_dirty(
+                aggr.last_dirty_nodes if self.derive_mode == "delta"
+                else None
+            )
         # The cycle's working copies stay float32 (the evict lane's C
         # engine and the solver uploads are 32-bit contracts); the
         # PERSISTENT planes are float64 so the delta arithmetic is
@@ -885,6 +898,15 @@ class FastCycle:
             int(self.stats.get("shortlist_fallbacks", 0))
             + exhausted + affinity)
 
+    def _devincr_drop_skip(self) -> None:
+        """Void the null-delta skip proof: the previously dispatched
+        solve's result was LOST (reply lost / device crash), so even an
+        unchanged store must re-dispatch — the lost solve may have
+        found placements nobody ever saw."""
+        dvc = getattr(self.store, "_devincr_cache", None)
+        if dvc is not None:
+            dvc.skip_token = None
+
     def _record_twophase_lanes(self) -> None:
         """Fold the wave solver's coarse/fine dispatch timings into the
         cycle's lane split (device_coarse / device_fine sub-lanes of the
@@ -906,6 +928,14 @@ class FastCycle:
         args = {"mesh_shards": shards} if shards > 1 else None
         if shards > 1:
             self.stats["mesh_shards"] = shards
+        dvinfo = info.get("devincr")
+        if dvinfo:
+            # Device-incremental decision of this dispatch (ISSUE 9):
+            # cycle stats + the per-mode counter series.
+            self.stats["devincr"] = dict(dvinfo)
+            mode = dvinfo.get("mode")
+            if mode in ("warm", "full"):
+                metrics.device_incremental_solves.inc(mode=mode)
         lanes["device_coarse"] = lanes.get("device_coarse", 0.0) + coarse
         lanes["device_fine"] = lanes.get("device_fine", 0.0) + fine
         now = time.perf_counter_ns()
@@ -1211,6 +1241,13 @@ class FastCycle:
         scale = max(scale / 2.0, self._MIN_BUDGET_SCALE)
         store._aff_budget_scale = scale
         store._aff_clean_cycles = 0
+        # The device-incremental caches hold buffers allocated on the
+        # runtime that just crashed (and a solve that died mid-stream
+        # may have half-updated the warm candidates): drop everything —
+        # the next solve provably full-recomputes on fresh buffers.
+        dvc = getattr(store, "_devincr_cache", None)
+        if dvc is not None:
+            dvc.invalidate()
         log.error(
             "TPU runtime crash mid-solve (%s); halving affinity chunk "
             "budget to %.3gx and resuming the cycle", e, scale,
@@ -1249,6 +1286,31 @@ class FastCycle:
         lanes = self.lanes
         store = self.store
         tracer = self.tracer
+        # Null-delta fast cycle (ISSUE 9): when nothing the solve is a
+        # function of changed since the previous dispatch — and that
+        # dispatch's result was fetched and committed — a re-dispatch
+        # would reproduce the identical (empty) outcome, so the cycle
+        # skips the solve wholesale.  Any bind-backoff entry disables
+        # the skip (backoff windows expire on wall time, not on a
+        # mirror version).
+        from .ops import devincr as _dvm
+
+        dv_store = None
+        if solver == "wave" and _dvm.devincr_on():
+            dv_store = _dvm.of_store(store)
+            if not store.bind_backoff and dv_store.skip_token is not None:
+                tok = self._null_delta_token(solver, rounds)
+                if dv_store.skip_token == tok:
+                    dv_store.counts["skip"] += 1
+                    metrics.device_incremental_solves.inc(mode="skip")
+                    self.stats["device_events"].append(
+                        "null-delta: solve dispatch skipped")
+                    self.stats["solve_skipped"] = True
+                    return
+        # Solve-input token as of the LAST encode of this lane; the
+        # epilogue persists it as the skip token iff nothing mutated
+        # after that encode (i.e. the final solve placed nothing).
+        self._last_encode_token = None
         retry = False
         rnd = 0
         crashes = 0
@@ -1295,6 +1357,11 @@ class FastCycle:
                     with tracer.span("encode", lanes=lanes):
                         inputs, pid, profiles, ncls = self._solve_inputs(
                             cjobs, crows, slim=True)
+                    # Device-incremental context (ISSUE 9): cache keys
+                    # + dirty superset for this dispatch (a token dict
+                    # for the remote child, which owns its planes).
+                    dv, dv_manifest = self._devincr_prepare(
+                        inputs, mesh, remote is not None)
                     kind = "remote" if remote is not None else "local"
                     # The dispatch span opens the solve-id flow; the
                     # matching fetch/commit spans close it in cycle N+1.
@@ -1308,18 +1375,27 @@ class FastCycle:
                         if remote is not None:
                             # The child process rebuilds node classes
                             # from the numpy frame itself; class planes
-                            # do not cross the wire.
-                            payload = remote.solve_async(inputs, pid,
-                                                         profiles)
+                            # do not cross the wire — the manifest's
+                            # devincr tokens key the child's own
+                            # persistent planes.
+                            payload = remote.solve_async(
+                                inputs, pid, profiles,
+                                devincr=dv_manifest)
+                            if dv_manifest is not None:
+                                # The child solves every frame it
+                                # receives: a successful send anchors
+                                # the dirty accumulator on its caches.
+                                _dvm.of_store(store).anchor_dirty()
                         else:
                             if mesh is not None:
                                 payload = self._solve_mesh_dispatch(
-                                    mesh, inputs, pid, profiles, ncls)
+                                    mesh, inputs, pid, profiles, ncls,
+                                    devincr=dv)
                             else:
                                 payload = solve_fn(
                                     *inputs, pid=pid, profiles=profiles,
                                     taint_any=self._taint_any,
-                                    node_classes=ncls)
+                                    node_classes=ncls, devincr=dv)
                                 self._record_twophase_lanes()
                             # Start the device->host transfer now; the
                             # fetch at the next cycle's top only waits
@@ -1328,8 +1404,12 @@ class FastCycle:
                                 payload.assigned.copy_to_host_async()
                             except AttributeError:
                                 pass
-                        self._dispatch_async(cjobs, crows, kind, payload,
-                                             solve_id)
+                        self._last_encode_token = (
+                            self._null_delta_token(solver, rounds)
+                            if dv_store is not None else None)
+                        self._dispatch_async(
+                            cjobs, crows, kind, payload, solve_id,
+                            devincr_token=self._last_encode_token)
                     self.stats["dispatched_solve_id"] = solve_id
                     break
                 for cjobs, crows in chunks:
@@ -1337,6 +1417,16 @@ class FastCycle:
                     with tracer.span("encode", lanes=lanes):
                         inputs, pid, profiles, ncls = self._solve_inputs(
                             cjobs, crows, slim=(solver == "wave"))
+                    # Device-incremental context: single-chunk wave
+                    # solves only (chunked solves interleave commits,
+                    # so each chunk would need its own proof).
+                    dv = dv_manifest = None
+                    if solver == "wave" and len(chunks) == 1:
+                        dv, dv_manifest = self._devincr_prepare(
+                            inputs, mesh, remote is not None)
+                        self._last_encode_token = (
+                            self._null_delta_token(solver, rounds)
+                            if dv_store is not None else None)
                     t0 = time.perf_counter()
                     if solver == "wave" and remote is not None:
                         # Remote-solver split (BASELINE north-star
@@ -1344,15 +1434,24 @@ class FastCycle:
                         # process as one C++-packed frame; assignment
                         # vectors come back as numpy.  The child
                         # rebuilds node classes from the frame itself.
-                        result = remote.solve(inputs, pid, profiles)
+                        result = remote.solve(inputs, pid, profiles,
+                                              devincr=dv_manifest)
+                        if dv_manifest is not None:
+                            _dvm.of_store(store).anchor_dirty()
+                        mode = getattr(remote, "last_devincr_mode",
+                                       None)
+                        if mode in ("warm", "full"):
+                            metrics.device_incremental_solves.inc(
+                                mode=mode)
                     elif solver == "wave" and mesh is not None:
                         result = self._solve_mesh_dispatch(
-                            mesh, inputs, pid, profiles, ncls)
+                            mesh, inputs, pid, profiles, ncls,
+                            devincr=dv)
                     elif solver == "wave":
                         result = solve_fn(*inputs, pid=pid,
                                           profiles=profiles,
                                           taint_any=self._taint_any,
-                                          node_classes=ncls)
+                                          node_classes=ncls, devincr=dv)
                         self._record_twophase_lanes()
                     else:
                         result = solve_fn(*inputs)
@@ -1431,11 +1530,138 @@ class FastCycle:
                     store._aff_clean_cycles = 0
                 else:
                     store._aff_clean_cycles = clean
+        if dv_store is not None:
+            # Persist the skip proof iff nothing mutated after the last
+            # encode — i.e. the final solve of this lane placed nothing
+            # (a pipelined dispatch counts: its commit lands next cycle
+            # and bumps the mutation counter if it binds, breaking the
+            # proof before the next skip check reads it).
+            tok_now = (self._null_delta_token(solver, rounds)
+                       if self._last_encode_token is not None else None)
+            dv_store.skip_token = (
+                tok_now if tok_now is not None
+                and tok_now == self._last_encode_token else None)
+
+    # --------------------------------------- device-lane incrementality
+
+    def _dirty_nodes_now(self) -> Optional[np.ndarray]:
+        """Node rows touched by the mirror's still-unconsumed dirty pod
+        rows (old node from the aggregate shadow — the state as of the
+        last derive — plus current node), or None when tracking
+        overflowed.  Together with the derive-time captures accumulated
+        on the DeviceIncremental this is a superset of every node whose
+        solve inputs changed since the previous solve (ISSUE 9)."""
+        m = self.m
+        if m._pod_dirty_overflow:
+            return None
+        rows = np.flatnonzero(m._pod_dirty_mask[:self.Pn])
+        if not len(rows):
+            return np.zeros(0, np.int64)
+        aggr = self.aggr
+        if len(aggr.sh_node) < self.Pn:
+            return None
+        nds = np.concatenate([
+            m.p_node[rows].astype(np.int64),
+            aggr.sh_node[rows].astype(np.int64),
+        ])
+        return np.unique(nds[nds >= 0])
+
+    # Affinity count tables past this size are not content-hashed per
+    # solve; warm shortlists simply disable (full re-rank — today's
+    # behavior) there.  8 MB ≈ 8 ms of blake2b worst case on the cycle
+    # thread, a bounded fraction of the warm win; beyond it the hash
+    # itself would eat the saving.
+    _DEVINCR_CNT0_HASH_MAX = 8_000_000
+
+    def _devincr_prepare(self, inputs, mesh, remote: bool):
+        """Assemble the device-incremental cache keys + dirty superset
+        for the solve about to dispatch (ISSUE 9).  Returns ``(dv,
+        manifest)``: the store's DeviceIncremental primed via
+        ``begin_solve`` for local/mesh dispatches, or a JSON-able token
+        dict for the remote solver child (which keeps its own
+        persistent planes keyed on these frames' tokens)."""
+        import hashlib
+
+        from .ops import devincr as _dvm
+        from .ops import wave as _wave_mod
+
+        m = self.m
+        if not _dvm.devincr_on() or not _wave_mod._two_phase_on():
+            return None, None
+        gen = getattr(self, "_profile_gen", None)
+        if gen is None:
+            return None, None
+        ws = inputs[4]
+        wt = (
+            float(ws.binpack_weight),
+            tuple(np.asarray(ws.binpack_res, np.float32).tolist()),
+            float(ws.least_req_weight), float(ws.most_req_weight),
+            float(ws.balanced_weight), float(ws.node_affinity_weight),
+        )
+        cls_tok = self._cls_sig or f"identity-{m.epoch}"
+        static_key = (cls_tok, int(gen), wt, int(self._solve_np),
+                      self.R)
+        aff = inputs[7]
+        cnt0 = np.asarray(aff.cnt0)
+        warm_key = None
+        if cnt0.nbytes <= self._DEVINCR_CNT0_HASH_MAX:
+            if cnt0.any():
+                h = hashlib.blake2b(digest_size=16)
+                h.update(repr(cnt0.shape).encode())
+                h.update(np.ascontiguousarray(cnt0).tobytes())
+                cnt0_tok = h.hexdigest()
+            else:
+                cnt0_tok = f"z{cnt0.shape}"
+            warm_key = (static_key, int(m.epoch),
+                        int(m.node_liveness_gen), int(m.compact_gen),
+                        self.Nn, cnt0_tok)
+        dv = self.store._devincr_cache
+        if dv is None:
+            dv = _dvm.of_store(self.store)
+        dirty = dv.take_dirty(self._dirty_nodes_now())
+        if remote:
+            return None, {
+                "static_key": repr(static_key),
+                "warm_key": repr(warm_key) if warm_key is not None
+                else None,
+                "dirty_nodes": (dirty.tolist() if dirty is not None
+                                else None),
+            }
+        dv.set_mesh(mesh)
+        dv.begin_solve(static_key, warm_key, dirty)
+        return dv, None
+
+    def _null_delta_token(self, solver: str, rounds: int):
+        """Content token over every input the allocate lane's solve is
+        a function of: equality across cycles proves a re-dispatched
+        solve would see bit-equal inputs and reproduce the previous
+        (empty) outcome — the null-delta fast cycle's skip proof
+        (ISSUE 9).  Conservative by construction: any mirror mutation
+        (mutation_seq/dirty_seq), node churn (epoch/liveness), row
+        renumbering (compact_gen), PodGroup phase/min-member drift, or
+        queue share/deserved change breaks equality."""
+        import hashlib
+
+        m = self.m
+        Jn = self.Jn
+        h = hashlib.blake2b(digest_size=16)
+        h.update(m.j_phase_code[:Jn].tobytes())
+        h.update(m.j_minav[:Jn].tobytes())
+        h.update(np.ascontiguousarray(self.q_deserved).tobytes())
+        h.update(np.ascontiguousarray(self.q_alloc).tobytes())
+        return (
+            int(m.mutation_seq), int(m.epoch), int(m.compact_gen),
+            int(m.dirty_seq), int(m.node_liveness_gen),
+            self.Pn, self.Nn, Jn, self.Qn, self.R,
+            h.hexdigest(), solver, int(rounds),
+            tuple(self.action_names), tuple(sorted(self.plugin_opts)),
+        )
 
     # ------------------------------------------------- pipelined sessions
 
     def _dispatch_async(self, cjobs: List[int], crows: np.ndarray,
-                        kind: str, payload, solve_id: int = 0) -> None:
+                        kind: str, payload, solve_id: int = 0,
+                        devincr_token=None) -> None:
         """Park a dispatched-but-unread device solve on the store; the
         device round trip then runs concurrently with this cycle's
         backfill/close/enqueue and the next cycle's derive, and
@@ -1453,9 +1679,11 @@ class FastCycle:
             kind, payload, list(cjobs), crows, req_gather,
             self.m.mutation_seq, self.m.epoch, self.m.compact_gen,
             self.Nn, solve_id=solve_id, dirty_seq=self.m.dirty_seq,
+            devincr_token=devincr_token,
         )
 
-    def _solve_mesh_dispatch(self, mesh, inputs, pid, profiles, ncls):
+    def _solve_mesh_dispatch(self, mesh, inputs, pid, profiles, ncls,
+                             devincr=None):
         """Dispatch the wave solve over the device mesh: node axis +
         affinity count tensors sharded (parallel/mesh.py
         shard_wave_inputs), the two-phase rankings shard-local with the
@@ -1473,6 +1701,7 @@ class FastCycle:
             epoch=self.m.epoch,
             taint_any=self._taint_any,
             node_classes=ncls,
+            devincr=devincr,
         )
         self._record_twophase_lanes()
         return result
@@ -1554,6 +1783,7 @@ class FastCycle:
                     f"({type(e).__name__}); fetch failure "
                     f"{fails}/{self.REMOTE_FETCH_FAIL_CAP}"
                 )
+                self._devincr_drop_skip()
                 return
             if self._is_device_crash(e):
                 # Execution-time crashes surface at the async fetch,
@@ -1570,6 +1800,7 @@ class FastCycle:
                 # The crash event itself lands via _on_device_crash.
                 self._count_drops(
                     {"device-crash": len(inflight.task_rows)})
+                self._devincr_drop_skip()
                 self._on_device_crash(e)
                 return
             # A programming error must propagate, exactly as it would
@@ -1578,6 +1809,13 @@ class FastCycle:
         self.store._remote_fetch_fails = 0
         self.stats["committed_solve_id"] = inflight.solve_id or None
         self._count_shortlist_fb(*inflight.fallbacks)
+        if inflight.kind == "remote":
+            # The child reported its device-incremental decision in the
+            # reply manifest (decoded by the fetch above).
+            mode = getattr(getattr(self.store, "remote_solver", None),
+                           "last_devincr_mode", None)
+            if mode in ("warm", "full"):
+                metrics.device_incremental_solves.inc(mode=mode)
         # The residual wait is the pipeline's health signal: it
         # approaches zero exactly when the overlap works.  The
         # dispatch->available round trip is unobservable here (the
@@ -2495,6 +2733,11 @@ class FastCycle:
             task_rows, None if slim else tasks, Np
         )
         weights = self._score_weights()
+        # Device-incremental key inputs (ISSUE 9): the class-table
+        # content signature (or the identity marker — epoch-keyed) and
+        # the padded node axis, read by _devincr_prepare.
+        self._cls_sig = cls_sig if use_classes else ""
+        self._solve_np = Np
         return (
             (nodes, tasks, jobs, queues, weights, self.eps,
              self.scalar_slot, aff),
@@ -2567,11 +2810,21 @@ class FastCycle:
         m = self.m
         P = len(task_rows)
 
+        # Profile content generation (ISSUE 9): a monotone token that
+        # moves whenever the profile/affinity encoding is (re)built —
+        # an encode-cache hit keeps it, so the device-incremental lane
+        # can key its persistent [U, C] static planes and warm
+        # shortlists on "the same profile rows as last solve".  Any
+        # rebuild (even one producing identical content) bumps it:
+        # conservative, the caches just recompute once.
+        self._profile_gen = None
+
         if tasks is None and getattr(self, "_incr", True):
             cached = getattr(self.store, "_encode_cache", None)
             ckey = self._encode_cache_key(P)
             if (cached is not None and cached["key"] == ckey
                     and np.array_equal(cached["task_rows"], task_rows)):
+                self._profile_gen = cached.get("gen")
                 self._pid_out = cached["pid"]
                 E = cached["E"]
                 K = max(1, len(m.topo_keys))
@@ -2603,6 +2856,9 @@ class FastCycle:
         er_s, ei_s, ev_s = m.c_ip_soft.gather(task_rows)
         active = np.unique(np.concatenate([ei_a, ei_n, ei_s]))
         E = len(active)
+        gen = getattr(self.store, "_encode_gen", 0) + 1
+        self.store._encode_gen = gen
+        self._profile_gen = gen
         if E == 0:
             aff = empty_affinity(Np, 1)
             profiles = self._profiles_from_rows(
@@ -2613,7 +2869,7 @@ class FastCycle:
                     "key": self._encode_cache_key(P),
                     "task_rows": task_rows.copy(),
                     "pid": self._pid_out, "E": 0,
-                    "profiles": profiles,
+                    "profiles": profiles, "gen": gen,
                 }
             return aff, self._pid_out, profiles
 
@@ -2699,7 +2955,7 @@ class FastCycle:
                 "task_rows": task_rows.copy(),
                 "pid": self._pid_out, "E": E, "Ep": Ep,
                 "term_key": term_key, "members": active_members,
-                "profiles": profiles,
+                "profiles": profiles, "gen": gen,
             }
         return aff, self._pid_out, profiles
 
@@ -3713,12 +3969,24 @@ class FastCycle:
          self.q_deserved, self.q_alloc) = (
             idle_patch, ntasks_patch, ready_patch, resident_patch,
             deserved_patch, q_alloc_patch)
+        # The what-if's encode must not POLLUTE the allocate lane's
+        # encode cache: its task rows differ, so caching its entry
+        # would (a) evict the live entry and (b) bump the profile
+        # generation — needlessly invalidating the device-incremental
+        # static planes and warm candidates (ISSUE 9) on every cycle
+        # that plans a rebalance.  Save/restore both slots; the what-if
+        # entry would never hit for the live lane anyway.
+        store = self.store
+        saved_cache = store._encode_cache
+        saved_gen = getattr(store, "_encode_gen", 0)
         try:
             inputs, pid, profiles, ncls = self._solve_inputs(
                 solve_jobs, task_rows, slim=True)
         finally:
             (self.n_idle, self.n_ntasks, self.j_ready_base,
              self.resident, self.q_deserved, self.q_alloc) = saved
+            store._encode_cache = saved_cache
+            store._encode_gen = saved_gen
         return inputs, pid, profiles, ncls
 
     def _dispatch_plan(self, plan) -> None:

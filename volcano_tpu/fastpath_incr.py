@@ -127,7 +127,7 @@ class CycleAggregates:
         "n_used", "n_releasing", "n_ntasks", "resident",
         "js_counts", "j_empty_pending", "j_alloc_res", "j_pending_res",
         "sh_status", "sh_node", "sh_job", "sh_alive",
-        "last_mode", "delta_rows", "full_reason",
+        "last_mode", "delta_rows", "full_reason", "last_dirty_nodes",
     )
 
     def __init__(self):
@@ -157,6 +157,12 @@ class CycleAggregates:
         self.last_mode = ""
         self.delta_rows = 0
         self.full_reason = ""
+        # Node rows whose derive-visible dynamic state changed in the
+        # LAST delta refresh (old + new node of every truly-changed
+        # dirty row), or None after a full rebuild — the device-lane
+        # warm-shortlist diff (ops/devincr.py) accumulates these
+        # between solves.
+        self.last_dirty_nodes: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------ refresh
 
@@ -208,6 +214,7 @@ class CycleAggregates:
         self.sh_job = m.p_job[:Pn].copy()
         self.sh_alive = m.p_alive[:Pn].copy()
         self.delta_rows = 0
+        self.last_dirty_nodes = None
 
     # --------------------------------------------------------- delta path
 
@@ -234,6 +241,7 @@ class CycleAggregates:
         self.Pn, self.Jn = Pn, Jn
         if not len(rows):
             self.delta_rows = 0
+            self.last_dirty_nodes = np.zeros(0, np.int64)
             return
         st_o = self.sh_status[rows]
         nd_o = self.sh_node[rows]
@@ -247,7 +255,15 @@ class CycleAggregates:
               | (al_o != al_n))
         self.delta_rows = int(np.count_nonzero(ch))
         if not ch.any():
+            self.last_dirty_nodes = np.zeros(0, np.int64)
             return
+        # Old + new node of every truly-changed row: exactly the node
+        # rows whose n_used/n_releasing/n_ntasks/ports contributions
+        # moved this refresh (the warm-shortlist diff set).
+        nds = np.concatenate(
+            [nd_o[ch].astype(np.int64), nd_n[ch].astype(np.int64)]
+        )
+        self.last_dirty_nodes = np.unique(nds[nds >= 0])
         rows_c = rows[ch]
         be = m.p_be[rows_c]
         # One static-spec request gather serves both sides (specs are

@@ -228,6 +228,19 @@ class Metrics:
             "(required inter-pod terms drifted from the solve-start "
             "counts the shortlist was built on)",
         )
+        self.device_incremental_solves = _Counter(
+            f"{ns}_device_incremental_solves_total",
+            "Device-lane incremental solve decisions by mode: warm "
+            "(shortlists warm-started from the previous solve's "
+            "per-block candidates over the dirty node set), full (the "
+            "proven full re-rank: cache key drift — class-set, "
+            "profile-set, node churn, compaction, affinity-count "
+            "content — dirty overflow, or first solve), or skip (a "
+            "null-delta cycle proved the dispatch would reproduce the "
+            "previous empty outcome and skipped it wholesale; "
+            "VOLCANO_TPU_DEVINCR=0 disables the lane and counts "
+            "nothing)",
+        )
         self.host_incremental_derives = _Counter(
             f"{ns}_host_incremental_derives_total",
             "Derive-lane aggregate refreshes by mode: delta "

@@ -312,6 +312,61 @@ def _class_static(cls: NodeClasses, sel_bits, aff_bits, aff_terms,
     return ok, score
 
 
+def _identity_classes(nodes: SolveNodes) -> NodeClasses:
+    """Per-node identity classes derived from the node planes (the
+    automatic path when no compacted class planes were supplied): every
+    node is its own class, so the class-axis machinery applies with the
+    static matmuls staying at node granularity."""
+    N = nodes.idle.shape[0]
+    return NodeClasses(
+        class_id=jnp.arange(N, dtype=jnp.int32),
+        label_bits=nodes.label_bits,
+        taint_bits=nodes.taint_bits,
+        ready=nodes.ready,
+    )
+
+
+@partial(jax.jit, static_argnames=("chunk", "has_taints",
+                                   "cls_identity"))
+def _static_planes(nodes: SolveNodes, prof: SolveProfiles,
+                   cls: NodeClasses, naff_weight, chunk: int,
+                   has_taints: bool, cls_identity: bool):
+    """Separately-jitted producer of the [U, C] static planes (ISSUE 9
+    persistent statics): ``_class_static`` over the WHOLE padded profile
+    table, cached across solves by ``ops/devincr.DeviceIncremental``
+    keyed on (class-table content sig, profile content generation,
+    epoch-relevant bits) — steady-state solves then skip static
+    evaluation entirely, both in the coarse pass and per wave.
+
+    Rows are computed independently (the matmuls contract over the bit
+    axis only), so gathering rows of this result is bit-identical to
+    calling ``_class_static`` on the gathered rows in-kernel — the
+    property the DEVINCR=0 parity contract rests on.  Profiles stream
+    through ``lax.map`` in ``chunk`` rows like the coarse pass."""
+    if cls_identity:
+        cls = _identity_classes(nodes)
+    U = prof.sel_bits.shape[0]
+
+    def body(rowset):
+        sel_bits, aff_bits, aff_terms, tol_bits, pref_bits, pref_w = \
+            rowset
+        return _class_static(
+            cls, sel_bits, aff_bits, aff_terms, tol_bits, pref_bits,
+            pref_w, naff_weight, has_taints,
+        )
+
+    cols = (prof.sel_bits, prof.aff_bits, prof.aff_terms,
+            prof.tol_bits, prof.pref_bits, prof.pref_w)
+    if chunk >= U:
+        return body(cols)
+    resh = tuple(
+        a.reshape(U // chunk, chunk, *a.shape[1:]) for a in cols
+    )
+    ok, sc = jax.lax.map(body, resh)
+    C = ok.shape[-1]
+    return ok.reshape(U, C), sc.reshape(U, C)
+
+
 def _topk_nodes(scores, k: int, n_shards: int = 1):
     """Top-``k`` node ids per profile row — shard-local under a mesh.
 
@@ -356,13 +411,16 @@ def _topk_nodes(scores, k: int, n_shards: int = 1):
 
 @partial(jax.jit, static_argnames=("sl_k", "chunk", "features",
                                    "cnt0_any", "cls_identity",
-                                   "mesh_shards"))
+                                   "mesh_shards", "n_blocks",
+                                   "with_cand", "static_ext"))
 def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
                       score_prof, cls: NodeClasses, aff: AffinityArgs,
                       weights: ScoreWeights, eps, scalar_slot,
                       sl_k: int, chunk: int, features: tuple,
                       cnt0_any: bool, cls_identity: bool,
-                      mesh_shards: int = 1):
+                      mesh_shards: int = 1, n_blocks: int = 1,
+                      with_cand: bool = False, static_ext: bool = False,
+                      stat_ok=None, stat_score=None):
     """Phase 1 + shortlist selection of the two-phase solve.
 
     Evaluates the wave-0-attempt-1 live mask + score for every profile
@@ -392,6 +450,20 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
     only its own node slice and the per-profile winners reduce across
     chips as (score, global node id) pairs (``_topk_nodes``) — the
     shortlist membership is bit-identical to the single-device pass.
+
+    ``with_cand`` (the device-incremental lane, ISSUE 9) restructures
+    the selection into per-block top-k + winner merge over ``n_blocks``
+    ascending-id node blocks and ALSO returns the per-block candidate
+    lists ``(cand_s [U, B, klb], cand_i [U, B, klb])`` — the warm-start
+    state ``_warm_shortlist`` patches on later solves.  The selected
+    SET is identical to the direct top-k (a global top-k element is a
+    top-k element of its own block, and candidate positions order by
+    (block, local rank) — ascending node id within any score class, the
+    ``_topk_nodes`` argument), and the returned shortlist sorts
+    ascending, so the array is bit-identical either way.  ``static_ext``
+    takes the (profile x class) static planes as PARAMS (``stat_ok`` /
+    ``stat_score`` [U, C], chunk rows threaded through the profile
+    stream) instead of evaluating ``_class_static`` in-kernel.
     """
     (has_ports, has_aff, has_taints, has_future, _has_overuse,
      has_extra, has_extra_score) = features
@@ -400,12 +472,7 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
     N = nodes.idle.shape[0]
     U = prof.req.shape[0]
     if cls_identity:
-        cls = NodeClasses(
-            class_id=jnp.arange(N, dtype=jnp.int32),
-            label_bits=nodes.label_bits,
-            taint_bits=nodes.taint_bits,
-            ready=nodes.ready,
-        )
+        cls = _identity_classes(nodes)
     # Initial dynamic node state, shared by every chunk.
     if has_future:
         fi0 = nodes.idle + nodes.releasing - nodes.pipelined
@@ -427,11 +494,18 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
     def body(rowset):
         (req, init_req, ports, sel_bits, aff_bits, aff_terms, tol_bits,
          pref_bits, pref_w, t_req_aff, t_req_anti, t_matches, t_soft,
-         e_ok, e_score) = rowset
-        ok_c, score_c = _class_static(
-            cls, sel_bits, aff_bits, aff_terms, tol_bits, pref_bits,
-            pref_w, weights.node_affinity_weight, has_taints,
-        )
+         e_ok, e_score) = rowset[:15]
+        if static_ext:
+            # Persistent static planes (ISSUE 9): the chunk's rows of
+            # the externally-produced [U, C] planes — bit-identical to
+            # the in-kernel evaluation (rows are computed
+            # independently; see _static_planes).
+            ok_c, score_c = rowset[15], rowset[16]
+        else:
+            ok_c, score_c = _class_static(
+                cls, sel_bits, aff_bits, aff_terms, tol_bits, pref_bits,
+                pref_w, weights.node_affinity_weight, has_taints,
+            )
         feas = ok_c[:, cls.class_id]  # [u, N] expand
         static_score = score_c[:, cls.class_id]
         if has_extra:
@@ -457,6 +531,26 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
             feas &= (aff_viol < 0.5) & (anti_viol < 0.5)
             score = score + jnp.matmul(t_soft, cv0_f.T)
         masked = jnp.where(feas, score, NEG)
+        if with_cand:
+            # Per-block top-k + winner merge (ISSUE 9): identical
+            # membership to the direct top-k (see the docstring), and
+            # the block candidates become the warm-start state.
+            u_ = masked.shape[0]
+            nlb = N // n_blocks
+            klb = min(sl_k, nlb)
+            loc_s, loc_i = jax.lax.top_k(
+                masked.reshape(u_, n_blocks, nlb), klb
+            )
+            gid = loc_i.astype(jnp.int32) + (
+                jnp.arange(n_blocks, dtype=jnp.int32) * nlb
+            )[None, :, None]
+            _s, pos = jax.lax.top_k(
+                loc_s.reshape(u_, n_blocks * klb), sl_k
+            )
+            idx = jnp.take_along_axis(
+                gid.reshape(u_, n_blocks * klb), pos, axis=1
+            )
+            return (jnp.sort(idx, axis=1).astype(jnp.int32), loc_s, gid)
         # Shard-local ranking + cross-chip winner reduction under a
         # mesh; identical membership to a global top_k (see _topk_nodes).
         idx = _topk_nodes(masked, sl_k, mesh_shards)
@@ -472,18 +566,166 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
         extra_prof if has_extra else ones_u,
         score_prof if has_extra_score else zeros_u,
     )
+    if static_ext:
+        cols = cols + (stat_ok, stat_score)
     if chunk >= U:
         return body(cols)
     resh = tuple(
         a.reshape(U // chunk, chunk, *a.shape[1:]) for a in cols
     )
-    return jax.lax.map(body, resh).reshape(U, sl_k)
+    out = jax.lax.map(body, resh)
+    if with_cand:
+        sl, cand_s, cand_i = out
+        klb = cand_s.shape[-1]
+        return (sl.reshape(U, sl_k),
+                cand_s.reshape(U, n_blocks, klb),
+                cand_i.reshape(U, n_blocks, klb))
+    return out.reshape(U, sl_k)
+
+
+@partial(jax.jit, static_argnames=("sl_k", "klb", "nlb", "chunk",
+                                   "features", "cnt0_any",
+                                   "cls_identity", "static_ext"))
+def _warm_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
+                    score_prof, cls: NodeClasses, aff: AffinityArgs,
+                    weights: ScoreWeights, eps, scalar_slot,
+                    stat_ok, stat_score, db_rows, cand_s, cand_i,
+                    sl_k: int, klb: int, nlb: int, chunk: int,
+                    features: tuple, cnt0_any: bool, cls_identity: bool,
+                    static_ext: bool):
+    """Warm-started shortlist selection (ISSUE 9): re-rank ONLY the node
+    blocks whose rows are in the cycle's dirty set, patch their
+    candidates into the carried per-block lists, and merge winners.
+
+    ``db_rows`` is the [ndb] list of dirty block ids (padded with
+    duplicates of the first — the scatter rewrites identical values, so
+    padding is idempotent); ``cand_s``/``cand_i`` are the previous
+    solve's per-block candidates ([U, B, klb], produced by
+    ``_coarse_shortlist`` with ``with_cand`` or by an earlier warm
+    pass).  The caller (``ops/devincr.DeviceIncremental``) proves every
+    node OUTSIDE the dirty blocks has byte-identical solve inputs to the
+    previous solve, so its retained candidates equal what a fresh
+    ranking would produce and the merged shortlist is bit-identical to
+    a full ``_coarse_shortlist`` over today's state.  Same formulas as
+    the coarse body, evaluated on the gathered dirty-block node rows
+    ([U, ndb*nlb] instead of [U, N]).
+
+    Returns ``(shortlists [U, sl_k], cand_s, cand_i)`` — the updated
+    candidates are the next solve's warm state."""
+    (has_ports, has_aff, has_taints, has_future, _has_overuse,
+     _has_extra, _has_extra_score) = features
+    f32 = jnp.float32
+    bf = jnp.bfloat16
+    N = nodes.idle.shape[0]
+    U = prof.req.shape[0]
+    if cls_identity:
+        cls = _identity_classes(nodes)
+    ndb = db_rows.shape[0]
+    rows = (
+        db_rows[:, None] * nlb
+        + jnp.arange(nlb, dtype=jnp.int32)[None, :]
+    ).reshape(-1)  # [M] global node ids of the dirty blocks
+    # Gathered node-side solve-start state (row subsets of the same
+    # planes the coarse pass reads — values bitwise equal per node).
+    idle_r = nodes.idle[rows]
+    if has_future:
+        rel = nodes.releasing
+        rel_r = rel[rows] if rel.shape[0] == N else rel
+        pip = nodes.pipelined
+        pip_r = pip[rows] if pip.shape[0] == N else pip
+        fi0_r = idle_r + rel_r - pip_r
+    else:
+        fi0_r = idle_r
+    mt_r = nodes.max_tasks[rows]
+    pods_ok0_r = (mt_r <= 0) | (nodes.ntasks[rows] < mt_r)
+    cid_r = cls.class_id[rows]
+    alloc_r = nodes.allocatable[rows]
+    if has_ports:
+        nport_bf_r = _unpack_bits(nodes.ports[rows]).astype(bf)
+    if has_aff and cnt0_any:
+        E = aff.cnt0.shape[0]
+        nd_e_r = jnp.take(aff.node_dom[rows], aff.term_key,
+                          axis=1)  # [M, E]
+        cv0_r = aff.cnt0[jnp.arange(E)[None, :], jnp.maximum(nd_e_r, 0)]
+        cv0_r = jnp.where(nd_e_r >= 0, cv0_r, 0)
+        total0 = jnp.sum(aff.cnt0, axis=-1)
+        cv0_zero_bf = (cv0_r == 0).astype(bf)
+        cv0_pos_bf = (cv0_r > 0).astype(bf)
+        cv0_f = cv0_r.astype(f32)
+
+    def body(rowset):
+        (req, init_req, ports, sel_bits, aff_bits, aff_terms, tol_bits,
+         pref_bits, pref_w, t_req_aff, t_req_anti, t_matches,
+         t_soft) = rowset[:13]
+        if static_ext:
+            ok_c, score_c = rowset[13], rowset[14]
+        else:
+            ok_c, score_c = _class_static(
+                cls, sel_bits, aff_bits, aff_terms, tol_bits, pref_bits,
+                pref_w, weights.node_affinity_weight, has_taints,
+            )
+        feas = ok_c[:, cid_r]  # [u, M] expand at the dirty rows
+        static_score = score_c[:, cid_r]
+        fit = less_equal(
+            init_req[:, None, :], fi0_r[None, :, :], eps, scalar_slot
+        )
+        feas &= fit & pods_ok0_r[None, :]
+        if has_ports:
+            p_bits = _unpack_bits(ports)
+            clash = jnp.matmul(p_bits.astype(bf), nport_bf_r.T)
+            feas &= ~jnp.any(p_bits, axis=-1)[:, None] | (clash < 0.5)
+        score = jax.vmap(node_score, in_axes=(0, None, None, None))(
+            req, alloc_r, idle_r, weights
+        ) + static_score
+        if has_aff and cnt0_any:
+            selfok = (total0 == 0)[None, :] & t_matches
+            need = (t_req_aff & ~selfok).astype(bf)
+            aff_viol = jnp.matmul(need, cv0_zero_bf.T)
+            anti_viol = jnp.matmul(t_req_anti.astype(bf), cv0_pos_bf.T)
+            feas &= (aff_viol < 0.5) & (anti_viol < 0.5)
+            score = score + jnp.matmul(t_soft, cv0_f.T)
+        masked = jnp.where(feas, score, NEG)
+        u_ = masked.shape[0]
+        loc_s, loc_i = jax.lax.top_k(
+            masked.reshape(u_, ndb, nlb), klb
+        )
+        gid = loc_i.astype(jnp.int32) + db_rows[None, :, None] * nlb
+        return loc_s, gid
+
+    cols = (
+        prof.req, prof.init_req, prof.ports, prof.sel_bits,
+        prof.aff_bits, prof.aff_terms, prof.tol_bits, prof.pref_bits,
+        prof.pref_w, prof.t_req_aff, prof.t_req_anti, prof.t_matches,
+        prof.t_soft,
+    )
+    if static_ext:
+        cols = cols + (stat_ok, stat_score)
+    if chunk >= U:
+        s_new, i_new = body(cols)
+    else:
+        resh = tuple(
+            a.reshape(U // chunk, chunk, *a.shape[1:]) for a in cols
+        )
+        s_new, i_new = jax.lax.map(body, resh)
+        s_new = s_new.reshape(U, ndb, klb)
+        i_new = i_new.reshape(U, ndb, klb)
+    # Patch the dirty blocks' candidates (duplicate padded block ids
+    # rewrite identical values — idempotent) and merge winners exactly
+    # like the coarse pass's with_cand tail.
+    cand_s = cand_s.at[:, db_rows].set(s_new)
+    cand_i = cand_i.at[:, db_rows].set(i_new)
+    flat_s = cand_s.reshape(U, -1)
+    flat_i = cand_i.reshape(U, -1)
+    _s, pos = jax.lax.top_k(flat_s, sl_k)
+    idx = jnp.take_along_axis(flat_i, pos, axis=1)
+    sl = jnp.sort(idx, axis=1).astype(jnp.int32)
+    return sl, cand_s, cand_i
 
 
 @partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features",
                                    "terms_disjoint", "two_phase",
                                    "cls_identity", "fb_cap",
-                                   "mesh_shards"))
+                                   "mesh_shards", "static_ext"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -510,6 +752,9 @@ def _solve_wave(
     cls_identity: bool = False,
     fb_cap: int = 0,
     mesh_shards: int = 1,
+    static_ext: bool = False,
+    stat_ok=None,  # [U, C] bool persistent static planes (ISSUE 9)
+    stat_score=None,  # [U, C] f32
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
@@ -563,12 +808,7 @@ def _solve_wave(
             # nodes without caller-built planes): every node is its own
             # class — the shortlist machinery still applies, the static
             # matmuls just stay at node granularity.
-            cls = NodeClasses(
-                class_id=jnp.arange(N, dtype=jnp.int32),
-                label_bits=nodes.label_bits,
-                taint_bits=nodes.taint_bits,
-                ready=node_ready,
-            )
+            cls = _identity_classes(nodes)
     else:
         # Unpacked-bit tables (f32 complements feed the matmul subset
         # checks) — the two-phase path evaluates these per CLASS instead.
@@ -724,12 +964,22 @@ def _solve_wave(
             # expanded masks/scores equal the node-level computation
             # exactly; the [UM, B] x [B, C] matmuls replace [UM, B] x
             # [B, N] — the N/C compaction of the static fan-out.
-            cls_ok, cls_pref = _class_static(
-                cls, prof.sel_bits[pids], prof.aff_bits[pids],
-                prof.aff_terms[pids], prof.tol_bits[pids],
-                prof.pref_bits[pids], prof.pref_w[pids],
-                weights.node_affinity_weight, has_taints,
-            )
+            if static_ext:
+                # Persistent static planes (ISSUE 9): the wave's rows
+                # of the externally-produced [U, C] planes replace the
+                # per-wave _class_static evaluation entirely — the
+                # steady-state win of the device-incremental lane (rows
+                # compute independently, so the gather is bit-identical
+                # to the in-kernel evaluation).
+                cls_ok = stat_ok[pids]
+                cls_pref = stat_score[pids]
+            else:
+                cls_ok, cls_pref = _class_static(
+                    cls, prof.sel_bits[pids], prof.aff_bits[pids],
+                    prof.aff_terms[pids], prof.tol_bits[pids],
+                    prof.pref_bits[pids], prof.pref_w[pids],
+                    weights.node_affinity_weight, has_taints,
+                )
             p_ok = cls_ok[:, cls.class_id]  # [UM, N]
             if has_extra:
                 p_ok &= extra_prof[pids]
@@ -2219,6 +2469,7 @@ def solve_wave(
     taint_any=None,
     node_classes: NodeClasses = None,
     mesh_shards: int = 1,
+    devincr=None,
 ) -> AllocResult:
     """Wave-batched solve; same signature/result as ``allocate.solve``.
 
@@ -2245,6 +2496,16 @@ def solve_wave(
     all-reduce as the only cross-chip communication of the selection
     step.  Results are bit-identical to ``mesh_shards=1``; a node axis
     the shard count does not divide falls back to the global form.
+
+    ``devincr`` (optional ``ops.devincr.DeviceIncremental``, ISSUE 9)
+    makes the two-phase coarse machinery incremental ACROSS solves:
+    persistent [U, C] static planes keyed on content versions replace
+    the in-kernel ``_class_static`` passes, and the coarse shortlist
+    warm-starts from the previous solve's per-block candidates when the
+    caller proved (``begin_solve``) which node rows may have changed.
+    Results are bit-for-bit equal to ``devincr=None``; custom-plugin
+    solves (``extra_ok``/``extra_score``) and non-two-phase solves
+    ignore the context.
     """
     P = int(tasks.job.shape[0])
     if (extra_ok is not None or extra_score is not None) and (
@@ -2499,22 +2760,43 @@ def solve_wave(
     chunk = 1
     while chunk * 2 <= max(1, min(COARSE_CHUNK, U_rows)):
         chunk *= 2
+    # Device-incremental context (ISSUE 9): only the two-phase slim
+    # path qualifies — custom-plugin solves carry per-solve [U, N]
+    # planes the cache keys cannot cover.
+    dv = devincr
+    if dv is not None and (not two_phase or features[5] or features[6]):
+        dv = None
     # Exact f32 matmuls are load-bearing: the one-hot matmuls carry node
     # indices, resource sums, and 0/1 predicate counts that are compared
     # with == / <=; the TPU default (bf16 MXU passes) rounds node ids above
     # 256 and capacity sums, mis-routing placements and stalling the
     # attempt loop.
     t_coarse = 0.0
+    stat = None
     with jax.default_matmul_precision("float32"):
         if two_phase:
             t0 = _time.perf_counter()
-            sl = _coarse_shortlist(
-                nodes, profiles, extra_prof, score_prof, cls_arg, aff,
-                weights, eps, scalar_slot,
-                sl_k=sl_k, chunk=chunk,
-                features=features, cnt0_any=bool(cnt0_any),
-                cls_identity=cls_identity, mesh_shards=n_sh,
-            )
+            if dv is not None:
+                stat = dv.static_planes(
+                    nodes, profiles, cls_arg,
+                    weights.node_affinity_weight, chunk,
+                    has_taints=features[2], cls_identity=cls_identity,
+                )
+                sl = dv.shortlist(
+                    nodes, profiles, extra_prof, score_prof, cls_arg,
+                    aff, weights, eps, scalar_slot,
+                    sl_k=sl_k, chunk=chunk, features=features,
+                    cnt0_any=bool(cnt0_any), cls_identity=cls_identity,
+                    mesh_shards=n_sh, stat=stat,
+                )
+            else:
+                sl = _coarse_shortlist(
+                    nodes, profiles, extra_prof, score_prof, cls_arg,
+                    aff, weights, eps, scalar_slot,
+                    sl_k=sl_k, chunk=chunk,
+                    features=features, cnt0_any=bool(cnt0_any),
+                    cls_identity=cls_identity, mesh_shards=n_sh,
+                )
             t_coarse = _time.perf_counter() - t0
         else:
             sl = z1((1, 1), np.int32)
@@ -2527,6 +2809,9 @@ def solve_wave(
             terms_disjoint=terms_disjoint, two_phase=two_phase,
             cls_identity=cls_identity, fb_cap=_fallback_cap(),
             mesh_shards=n_sh,
+            static_ext=stat is not None,
+            stat_ok=stat[0] if stat is not None else None,
+            stat_score=stat[1] if stat is not None else None,
         )
         t_fine = _time.perf_counter() - t0
     # Dispatch-side sub-lane telemetry (the cycle driver folds it into
@@ -2542,7 +2827,10 @@ def solve_wave(
         "n_nodes": N_in,
         "compacted_classes": two_phase and not cls_identity,
         "mesh_shards": n_sh,
+        "devincr": dv.solve_info() if dv is not None else None,
     })
+    if dv is not None:
+        dv.end_solve()
     if pad:
         res = res._replace(
             assigned=res.assigned[:P], pipelined=res.pipelined[:P]

@@ -316,12 +316,17 @@ def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
                              plane_cache: Optional[dict] = None,
                              epoch: Optional[int] = None,
                              taint_any=None,
-                             node_classes=None):
+                             node_classes=None,
+                             devincr=None):
     """The fast path's solve dispatch on a mesh (FastCycle._allocate when
     ``store.solve_mesh`` is set): pre-profiled inputs, node axis + count
     tensors sharded per ``shard_wave_inputs``; epoch-stable planes
     (including the two-phase class planes) stay mesh-resident across
-    cycles via ``plane_cache``."""
+    cycles via ``plane_cache``.  ``devincr`` (ISSUE 9) threads the
+    store's device-incremental context through — its persistent static
+    planes and warm-shortlist candidates live replicated on this mesh
+    (``DeviceIncremental.set_mesh``, called by the fast path before the
+    dispatch), so a mesh change voids them via the placement token."""
     from ..ops.wave import solve_wave
 
     args, pid, profiles, node_classes = shard_wave_inputs(
@@ -331,4 +336,5 @@ def sharded_solve_wave_cycle(mesh: Mesh, solve_args: Sequence, pid,
     kw = {} if wave is None else {"wave": wave}
     return solve_wave(*args, pid=pid, profiles=profiles,
                       taint_any=taint_any, node_classes=node_classes,
-                      mesh_shards=int(mesh.devices.size), **kw)
+                      mesh_shards=int(mesh.devices.size),
+                      devincr=devincr, **kw)

@@ -50,13 +50,14 @@ class InflightSolve:
     __slots__ = (
         "kind", "payload", "solve_jobs", "task_rows", "req_gather",
         "mutation_seq", "epoch", "compact_gen", "n_nodes", "solve_id",
-        "fallbacks", "dirty_seq",
+        "fallbacks", "dirty_seq", "devincr_token",
     )
 
     def __init__(self, kind: str, payload, solve_jobs: List[int],
                  task_rows: np.ndarray, req_gather: Tuple,
                  mutation_seq: int, epoch: int, compact_gen: int,
-                 n_nodes: int, solve_id: int = 0, dirty_seq: int = 0):
+                 n_nodes: int, solve_id: int = 0, dirty_seq: int = 0,
+                 devincr_token=None):
         self.kind = kind
         self.payload = payload
         self.solve_jobs = solve_jobs
@@ -84,6 +85,13 @@ class InflightSolve:
         # no pod-state change either.  ``_commit_inflight`` asserts the
         # implication; tests/test_incremental.py churns it.
         self.dirty_seq = dirty_seq
+        # Device-incremental solve-input token captured at dispatch
+        # (ISSUE 9): the null-delta skip proof this dispatch would
+        # anchor.  Carried on the handle so an abandoned or lost solve
+        # demonstrably voids the proof (abandon_inflight below /
+        # fastpath's lost-reply handling) — a skipped re-dispatch must
+        # never stand in for a result nobody fetched.
+        self.devincr_token = devincr_token
 
     # ----------------------------------------------------------- lifecycle
 
@@ -148,6 +156,12 @@ def abandon_inflight(store) -> bool:
         return False
     log.info("abandoning in-flight solve of %d task rows",
              len(inflight.task_rows))
+    # The abandoned solve's result is lost: void the null-delta skip
+    # proof its dispatch anchored, or a restarted scheduler facing an
+    # unchanged store would skip forever while the pods stay Pending.
+    dvc = getattr(store, "_devincr_cache", None)
+    if dvc is not None and inflight.devincr_token is not None:
+        dvc.skip_token = None
     inflight.abandon()
     return True
 

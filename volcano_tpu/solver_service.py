@@ -135,8 +135,15 @@ class SolverServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         from .cache import snapwire as sw
+        from .ops.devincr import DeviceIncremental
 
         registry = _registry()
+        # Per-connection device-incremental caches (ISSUE 9): the
+        # scheduler sends cache-generation tokens in each solve frame's
+        # manifest, so the child keeps its own persistent static planes
+        # and warm-shortlist candidates across solves — one context per
+        # connection (one scheduler per connection by protocol).
+        devincr = DeviceIncremental()
         try:
             while True:
                 try:
@@ -144,9 +151,17 @@ class SolverServer:
                 except (ConnectionError, ValueError, OSError):
                     return
                 try:
-                    reply = self._handle(req, registry, sw)
+                    reply = self._handle(req, registry, sw, devincr)
                 except Exception as e:  # solver-side error -> client raises
                     log.exception("solve failed")
+                    # The scheduler anchored its dirty accumulator at
+                    # SEND time (it cannot see this failure distinctly
+                    # from a slow solve), so the failed frame's dirty
+                    # rows will be absent from later frames: drop every
+                    # cached plane — the next solve provably
+                    # full-recomputes (and sheds any buffer a
+                    # mid-execution crash poisoned).
+                    devincr.invalidate()
                     reply = sw.encode_frame(
                         [], {"op": "error", "message": f"{type(e).__name__}: {e}"}
                     )
@@ -160,7 +175,7 @@ class SolverServer:
             except OSError:
                 pass
 
-    def _handle(self, req: bytes, registry, sw) -> bytes:
+    def _handle(self, req: bytes, registry, sw, devincr=None) -> bytes:
         manifest, arrays = sw.decode_frame(req)
         op = manifest.get("op")
         if op == "ping":
@@ -194,8 +209,25 @@ class SolverServer:
             kw["wave"] = int(manifest["wave"])
         import time as _time
 
+        # Device-incremental tokens (ISSUE 9): the scheduler's frame
+        # names the cache generations its static planes / warm
+        # shortlists are valid under; this child's per-connection
+        # context applies the same key/dirty-superset discipline the
+        # local path does (ops/devincr.py).  Frames without the section
+        # (older schedulers, kill switch) solve exactly as before.
+        dv = None
+        dv_tokens = manifest.get("devincr")
+        if devincr is not None and dv_tokens:
+            dirty = dv_tokens.get("dirty_nodes")
+            devincr.begin_solve(
+                dv_tokens.get("static_key"),
+                dv_tokens.get("warm_key"),
+                None if dirty is None else np.asarray(dirty, np.int64),
+            )
+            dv = devincr
         t0 = _time.perf_counter()
-        res = solve_wave(*solve_args, pid=pid, profiles=profiles, **kw)
+        res = solve_wave(*solve_args, pid=pid, profiles=profiles,
+                         devincr=dv, **kw)
         out = jax.device_get(
             (res.assigned, res.pipelined, res.never_ready, res.fit_failed,
              res.iters if res.iters is not None else np.int32(0),
@@ -208,10 +240,11 @@ class SolverServer:
         self.solves += 1
         arrays_out = []
         tree = sw.flatten_tree(tuple(np.asarray(x) for x in out), arrays_out)
-        return sw.encode_frame(
-            arrays_out,
-            {"op": "result", "tree": tree, "solve_ms": round(solve_ms, 1)},
-        )
+        reply = {"op": "result", "tree": tree,
+                 "solve_ms": round(solve_ms, 1)}
+        if dv is not None:
+            reply["devincr_mode"] = dv.last_mode
+        return sw.encode_frame(arrays_out, reply)
 
 
 # ------------------------------------------------------------------ client
@@ -242,6 +275,10 @@ class RemoteSolver:
         self.bytes_out = 0
         self.bytes_in = 0
         self.last_solve_ms: Optional[float] = None
+        # Device-incremental decision the child reported for the last
+        # decoded reply ("warm" | "full" | None) — the scheduler folds
+        # it into volcano_device_incremental_solves_total.
+        self.last_devincr_mode: Optional[str] = None
         # Span sink (obs/trace.py Tracer; service.py wires the store's
         # in, the default is the shared no-op): the pipelined send and
         # fetch legs then land in the cycle trace as "rpc" track spans.
@@ -304,16 +341,20 @@ class RemoteSolver:
         return manifest
 
     def _encode_request(self, solve_args: Sequence, pid, profiles,
-                        wave: Optional[int]) -> bytes:
+                        wave: Optional[int],
+                        devincr: Optional[dict] = None) -> bytes:
         from .cache import snapwire as sw
 
         arrays: list = []
         tree = sw.flatten_tree(
             (tuple(solve_args), np.asarray(pid), profiles), arrays
         )
-        return sw.encode_frame(
-            arrays, {"op": "solve", "tree": tree, "wave": wave}
-        )
+        manifest = {"op": "solve", "tree": tree, "wave": wave}
+        if devincr is not None:
+            # Cache-generation tokens keying the child's persistent
+            # device-incremental planes (ISSUE 9; see _serve_conn).
+            manifest["devincr"] = devincr
+        return sw.encode_frame(arrays, manifest)
 
     def _decode_result(self, reply: bytes):
         from .cache import snapwire as sw
@@ -326,6 +367,7 @@ class RemoteSolver:
                 f"remote solver failed: {manifest.get('message')}"
             )
         self.last_solve_ms = manifest.get("solve_ms")
+        self.last_devincr_mode = manifest.get("devincr_mode")
         vals = sw.unflatten_tree(manifest["tree"], rarrays, _registry())
         assigned, pipelined, never_ready, fit_failed, iters = vals[:5]
         # Replies predating the two-phase solve carry 5 entries; the
@@ -342,12 +384,14 @@ class RemoteSolver:
         )
 
     def solve(self, solve_args: Sequence, pid, profiles,
-              wave: Optional[int] = None):
+              wave: Optional[int] = None,
+              devincr: Optional[dict] = None):
         """Ship (solve_args, pid, profiles); return an AllocResult-shaped
         namedtuple of numpy arrays (assigned/pipelined/never_ready/
         fit_failed/iters; idle/q_alloc stay device-side concerns and are
         not transported — the host commit recomputes both)."""
-        payload = self._encode_request(solve_args, pid, profiles, wave)
+        payload = self._encode_request(solve_args, pid, profiles, wave,
+                                       devincr)
         self.requests += 1
         self.bytes_out += len(payload) + 8
         with self.tracer.timed_event(
@@ -355,7 +399,8 @@ class RemoteSolver:
             return self._decode_result(self._roundtrip(payload))
 
     def solve_async(self, solve_args: Sequence, pid, profiles,
-                    wave: Optional[int] = None) -> "PendingSolve":
+                    wave: Optional[int] = None,
+                    devincr: Optional[dict] = None) -> "PendingSolve":
         """Pipelined dispatch: send frame N and return WITHOUT reading
         the reply, so the child's upload+solve+fetch runs concurrently
         with the scheduler's host lanes; ``PendingSolve.fetch`` receives
@@ -368,7 +413,8 @@ class RemoteSolver:
         NOT resend: the frame may be mid-solve in the child, and the
         caller's staleness machinery already treats a lost reply as "this
         cycle placed nothing" (the pods stay Pending and re-place)."""
-        payload = self._encode_request(solve_args, pid, profiles, wave)
+        payload = self._encode_request(solve_args, pid, profiles, wave,
+                                       devincr)
         with self.tracer.timed_event(
                 "rpc:solve_send", args={"bytes_out": len(payload) + 8}):
             with self._lock:
