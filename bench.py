@@ -63,6 +63,22 @@ emitting its JSON tail with the usual lane split, plus one extra
 (the two-stage shard-local top-k vs the global top-k on the same
 sharded plane).  Host-device simulation quantifies the decomposition;
 the real win is the per-chip memory/compute split on a TPU slice.
+
+BENCH_WIRE=1 (ISSUE 10) A/Bs the remote-solver transport in one run:
+an in-process ``SolverServer`` thread serves solves over the REAL
+loopback TCP stack (the solve shares this process's jit cache, so the
+A/B isolates wire costs, not compile variance), every benched store
+gets its own ``RemoteSolver`` client, and the selected config executes
+three times — "(wire delta)" (``VOLCANO_TPU_WIRE=1``: delta solve
+frames against the child's per-connection mirror), "(wire full)"
+(``VOLCANO_TPU_WIRE=0``: classic v1 full frames), and
+"(wire fallback)" (``VOLCANO_TPU_WIRE=fallback``: the delta machinery
+runs but every frame voids the cache first, exercising the full-frame
+fallback path).  The pipelined feed re-pends only BENCH_WIRE_FRAC of
+the bound rows (default 5%, the steady-state churn shape), and each
+pipelined JSON tail carries a "wire" section: per-kind frame counts
+and bytes over the steady-state cycles, bytes/cycle (the number the
+BASELINE "Remote wire" A/B compares), and fallback counts by reason.
 """
 
 import json
@@ -90,6 +106,11 @@ _MESH = None
 # asserting the skip path) to the pipelined pass.
 _FEED_FRACTION = 1.0
 _DEVINCR_PROBE = False
+
+# BENCH_WIRE driver state (ISSUE 10): the in-process solver server's
+# loopback port; when set, every benched store solves through its own
+# RemoteSolver client and the pipelined tail carries wire telemetry.
+_REMOTE_PORT = None
 
 # The HOST lanes whose serial sum floors the pipelined cycle (ISSUE 8):
 # everything the cycle thread does besides the device dispatch/fetch.
@@ -121,8 +142,21 @@ def _twophase_env(on: bool, topk: int = 0):
                 os.environ[k] = v
 
 
+def _attach_remote(store):
+    """BENCH_WIRE: point the store at the in-process solver server over
+    loopback TCP; returns the client (caller closes it)."""
+    if _REMOTE_PORT is None:
+        return None
+    from volcano_tpu.solver_service import RemoteSolver
+
+    client = RemoteSolver(f"127.0.0.1:{_REMOTE_PORT}")
+    store.remote_solver = client
+    return client
+
+
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
-          records=None, fallbacks=None, rebalance=None, devincr=None):
+          records=None, fallbacks=None, rebalance=None, devincr=None,
+          wire=None):
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
@@ -146,6 +180,11 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # Device-incremental decisions over the measured cycles
         # (warm/full/skip counts + static-plane hits, ISSUE 9).
         payload["devincr"] = dict(devincr)
+    if wire:
+        # Remote-solver transport telemetry over the steady-state
+        # cycles (ISSUE 10): per-kind frame counts/bytes, bytes/cycle,
+        # and fallback reasons.
+        payload["wire"] = dict(wire)
     if lanes:
         # Lane split rides in the JSON tail so the driver's BENCH_rXX
         # artifacts carry the per-mode breakdown, not just the total.
@@ -225,6 +264,7 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
     store.async_bind = async_bind
     if _MESH is not None:
         store.solve_mesh = _MESH
+    client = _attach_remote(store)
     binder = store.binder
     t0 = time.perf_counter()
     Scheduler(store, conf_str=conf).run_once()
@@ -232,6 +272,8 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
     store.flush_binds()
     bound = len(binder.binds)
     evicted = len(getattr(store.evictor, "evicts", []))
+    if client is not None:
+        client.close()
 
     times = []
     lanes_best = None
@@ -241,6 +283,7 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
         store_r.async_bind = async_bind
         if _MESH is not None:
             store_r.solve_mesh = _MESH
+        client_r = _attach_remote(store_r)
         sched_r = Scheduler(store_r, conf_str=conf)
         t0 = time.perf_counter()
         sched_r.run_once()
@@ -255,6 +298,8 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
         # The dispatcher thread's callbacks pin the store; stop it so the
         # repeat's full mirror is actually freed.
         store_r.close()
+        if client_r is not None:
+            client_r.close()
         del store_r, sched_r
     e2e_ms = min(times) * 1e3 if times else warm_s * 1e3
     return e2e_ms, bound, evicted, warm_s, times, lanes_best, records
@@ -287,6 +332,7 @@ def _pipelined_bench(make_store, conf, cycles=None):
         # Pipelined dispatch works under a mesh (ISSUE 7): the parked
         # InflightSolve's arrays live sharded across the chips.
         store.solve_mesh = _MESH
+    client = _attach_remote(store)
     fed = {"total": 0}
 
     def feed(fc):
@@ -309,12 +355,13 @@ def _pipelined_bench(make_store, conf, cycles=None):
     t0 = time.perf_counter()
     sched.run_once()  # warm-up: compile + first dispatch (no commit yet)
     sched.run_once()  # pipeline fill: first commit lands
-    if _DEVINCR_PROBE:
-        # Device-incremental A/B: the warm-shortlist kernel compiles on
-        # its FIRST warm-eligible cycle (the pending set stabilizes a
-        # couple of cycles after the backlog first commits); keep that
-        # compile out of the measured steady state, in every mode (the
-        # extra cycles are mode-symmetric).
+    if _DEVINCR_PROBE or client is not None:
+        # Device-incremental / wire A/B: the warm-shortlist kernel
+        # compiles on its FIRST warm-eligible cycle (the pending set
+        # stabilizes a couple of cycles after the backlog first
+        # commits); keep that compile out of the measured steady
+        # state, in every mode (the extra cycles are mode-symmetric —
+        # without this the A/B's first mode eats the compile alone).
         for _ in range(3):
             sched.run_once()
     warm_s = time.perf_counter() - t0
@@ -326,6 +373,13 @@ def _pipelined_bench(make_store, conf, cycles=None):
     # pipelined rows stay comparable.  (The epoch-keyed class planes
     # deliberately survive: the feed mutates pods, not nodes.)
     store._shortlist_fb = {}
+    # Wire-telemetry seam (BENCH_WIRE): counters to this point cover
+    # warm-up (incl. the connection's first, necessarily-full frame);
+    # the steady-state delta is what the A/B compares.
+    wire0 = None
+    if client is not None:
+        wire0 = (dict(client.frame_counts), dict(client.frame_bytes),
+                 dict(client.wire_fallbacks))
     times = []
     lane_acc = {}
     for _ in range(cycles):
@@ -336,6 +390,23 @@ def _pipelined_bench(make_store, conf, cycles=None):
             lane_acc[k] = lane_acc.get(k, 0.0) + v
     amortized_ms = sum(times) / len(times) * 1e3
     lanes = {k: v / len(times) for k, v in lane_acc.items()}
+    wire = None
+    if client is not None:
+        counts0, bytes0, fb0 = wire0
+        frames = {k: client.frame_counts[k] - counts0.get(k, 0)
+                  for k in client.frame_counts}
+        wbytes = {k: client.frame_bytes[k] - bytes0.get(k, 0)
+                  for k in client.frame_bytes}
+        wire = {
+            "frames": frames,
+            "bytes": wbytes,
+            "bytes_per_cycle": round(sum(wbytes.values()) / cycles),
+            "fallbacks": {
+                k: v - fb0.get(k, 0)
+                for k, v in client.wire_fallbacks.items()
+                if v - fb0.get(k, 0)
+            },
+        }
     store.flush_binds()
     bound_per_cycle = fed["total"] // max(cycles + 1, 1)
     # Steady-state flight records only (the two warm-up cycles carry
@@ -386,15 +457,17 @@ def _pipelined_bench(make_store, conf, cycles=None):
         if dv is not None:
             devincr["null_delta_skips"] = dv.counts["skip"] - skip0
     store.close()
+    if client is not None:
+        client.close()
     return (amortized_ms, bound_per_cycle, warm_s, times, lanes, records,
-            fallbacks, devincr)
+            fallbacks, devincr, wire)
 
 
 def _emit_pipelined(label, mk, conf, n_pods):
     if os.environ.get("BENCH_PIPELINE", "1") == "0":
         return
     (amortized_ms, bound, warm_s, times, lanes, records,
-     fallbacks, devincr) = _pipelined_bench(mk, conf)
+     fallbacks, devincr, wire) = _pipelined_bench(mk, conf)
     _emit(
         f"{label} (pipelined steady-state, amortized {len(times)} cycles)",
         amortized_ms, n_pods,
@@ -406,6 +479,7 @@ def _emit_pipelined(label, mk, conf, n_pods):
         records=records,
         fallbacks=fallbacks,
         devincr=devincr,
+        wire=wire,
     )
 
 
@@ -842,6 +916,7 @@ def _run_selected(raw, repeats):
 
 def main():
     global _MODE_SUFFIX, _MESH, _FEED_FRACTION, _DEVINCR_PROBE
+    global _REMOTE_PORT
     raw = os.environ.get("BENCH_CONFIG", "north")
     # min-of-5 by default: shared-host / TPU-tunnel latency varies 2x+
     # between runs, and the minimum is the stable estimator.
@@ -907,6 +982,58 @@ def main():
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+        return
+    wire_ab = os.environ.get("BENCH_WIRE")
+    if wire_ab:
+        # Remote-wire transport A/B (ISSUE 10): an in-process solver
+        # server thread serves every mode over real loopback TCP (the
+        # solve shares this process's jit cache — the A/B isolates
+        # wire costs), the pipelined feed re-pends BENCH_WIRE_FRAC of
+        # the bound rows (default 5%, production-churn shape), and the
+        # selected config runs three times — "(wire delta)"
+        # (VOLCANO_TPU_WIRE=1), "(wire full)" (=0, classic v1 frames),
+        # "(wire fallback)" (=fallback, every frame exercises the
+        # forced full-frame path).  Each pipelined row's "wire" tail
+        # carries steady-state frame counts/bytes + bytes_per_cycle:
+        # the delta-vs-full ratio is the headline the BASELINE "Remote
+        # wire" section records.
+        import threading
+
+        from volcano_tpu.solver_service import SolverServer
+
+        try:
+            frac = float(os.environ.get("BENCH_WIRE_FRAC", "0.05"))
+        except ValueError:
+            frac = 0.05
+        server = SolverServer(port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        _REMOTE_PORT = server.port
+        _FEED_FRACTION = min(max(frac, 0.0), 1.0)
+        modes = (
+            ("delta", {"VOLCANO_TPU_WIRE": "1"}),
+            ("full", {"VOLCANO_TPU_WIRE": "0"}),
+            ("fallback", {"VOLCANO_TPU_WIRE": "fallback"}),
+        )
+        old_wire = os.environ.get("VOLCANO_TPU_WIRE")
+        try:
+            for mode, env in modes:
+                os.environ.update(env)
+                _MODE_SUFFIX = f" (wire {mode})"
+                _run_selected(raw, repeats)
+        finally:
+            _MODE_SUFFIX = ""
+            _REMOTE_PORT = None
+            _FEED_FRACTION = 1.0
+            if old_wire is None:
+                os.environ.pop("VOLCANO_TPU_WIRE", None)
+            else:
+                os.environ["VOLCANO_TPU_WIRE"] = old_wire
+            server.shutdown()
+            # Let the per-connection daemon threads observe their
+            # closed sockets before interpreter teardown starts
+            # unloading XLA under them.
+            time.sleep(0.2)
         return
     dev = os.environ.get("BENCH_DEVINCR")
     if dev:

@@ -76,6 +76,21 @@ constexpr VcsnapDtype kVcsnapDtypes[] = {
 constexpr int32_t kVcsnapNDtypes =
     static_cast<int32_t>(sizeof(kVcsnapDtypes) / sizeof(kVcsnapDtypes[0]));
 
+// Delta-frame record tags (protocol v2, ISSUE 10).  These MUST mirror
+// cache/snapwire.py REC_FULL / REC_SAME / REC_DELTA — vclint's VCL305
+// cross-checker parses both sides and fails the green-gate on drift
+// (same class as kVcsnapDtypes).  Values are wire format between the
+// scheduler and the solver child; extend APPEND-ONLY.
+constexpr int32_t kVcsnapRecFull = 0;
+constexpr int32_t kVcsnapRecSame = 1;
+constexpr int32_t kVcsnapRecDelta = 2;
+// Reference the tags so -Werror=unused stays green until a native
+// decoder consumes them (the tag dispatch lives python-side; the C++
+// names exist as the vclint-checked wire contract anchor).
+static_assert(kVcsnapRecFull == 0 && kVcsnapRecSame == 1 &&
+                  kVcsnapRecDelta == 2,
+              "delta record tags are wire format");
+
 }  // namespace
 
 extern "C" {
@@ -278,6 +293,83 @@ int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len, uint8_t* dtypes,
     data_off[i] = off;
     nbytes[i] = nb;
     off += vcsnap_align8(nb);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Delta records (protocol v2, ISSUE 10): a solve frame may ship only the
+// rows of an array that changed since the mirrored base frame the receiver
+// already holds.  The wire descriptor is an int64 vector
+//
+//   [n_ranges, s0, e0, s1, e1, ...]
+//
+// of half-open [start, stop) row ranges, strictly ascending and
+// non-overlapping, and the payload is the changed rows concatenated in
+// range order.  The descriptor and the generation token arrive off the
+// wire and are HOSTILE until validated; rows / row_bytes / payload_bytes /
+// mirror_gen come from the receiver's own mirror state and are trusted.
+//
+// Bounds discipline (the vcsnap_frame_unpack rule): no additive or
+// multiplicative expression ever mixes a hostile value into arithmetic
+// that could wrap (signed overflow, UB) into a PASSING comparison —
+// counts are checked in division form, each range bound is compared
+// directly against trusted limits, and the per-range row sum is bounded
+// by `rows` before it accumulates (disjoint ranges within [0, rows)).
+
+// Returns the summed payload rows (>= 0), -1 on a malformed descriptor
+// (truncated, out-of-bounds, unsorted / overlapping / empty ranges,
+// payload length mismatch), -2 when the receiver's mirror generation is
+// not the delta's base (reconnect / child restart / token mismatch — the
+// caller must fall back to a full frame, never solve stale).
+int64_t vcsnap_delta_check(const int64_t* desc, int64_t desc_len,
+                           int64_t rows, int64_t row_bytes,
+                           int64_t payload_bytes,
+                           int64_t mirror_gen, int64_t base_gen) {
+  if (mirror_gen != base_gen) return -2;
+  if (desc_len < 1) return -1;
+  int64_t n = desc[0];
+  // `1 + 2 * n > desc_len` would wrap on a hostile count near
+  // INT64_MAX; the division form rejects without touching it.
+  if (n < 0 || n > (desc_len - 1) / 2) return -1;
+  int64_t total = 0;
+  int64_t prev_stop = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t s = desc[1 + 2 * i];
+    int64_t e = desc[2 + 2 * i];
+    if (s < prev_stop || s >= e || e > rows) return -1;
+    total += e - s;  // disjoint within [0, rows): total <= rows
+    prev_stop = e;
+  }
+  if (row_bytes <= 0) return payload_bytes != 0 ? -1 : total;
+  // `total * row_bytes == payload_bytes` in division form: the product
+  // of two trusted-positive values still has no business existing when
+  // a corrupt length could make the comparison the only guard.
+  if (payload_bytes % row_bytes != 0 ||
+      total != payload_bytes / row_bytes)
+    return -1;
+  return total;
+}
+
+// Validates, then scatters the payload rows into the caller's writable
+// mirror array.  Returns 0 on success or the vcsnap_delta_check error;
+// dst is untouched on any rejection.
+int32_t vcsnap_delta_apply(uint8_t* dst, int64_t rows, int64_t row_bytes,
+                           const int64_t* desc, int64_t desc_len,
+                           const uint8_t* payload, int64_t payload_bytes,
+                           int64_t mirror_gen, int64_t base_gen) {
+  int64_t total = vcsnap_delta_check(desc, desc_len, rows, row_bytes,
+                                     payload_bytes, mirror_gen, base_gen);
+  if (total < 0) return static_cast<int32_t>(total);
+  int64_t n = desc[0];
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t s = desc[1 + 2 * i];
+    int64_t e = desc[2 + 2 * i];
+    int64_t nb = (e - s) * row_bytes;
+    std::memcpy(dst + s * row_bytes, payload + off,
+                static_cast<size_t>(nb));
+    off += nb;
   }
   return 0;
 }

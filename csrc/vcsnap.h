@@ -33,6 +33,16 @@ int32_t vcsnap_frame_unpack(const uint8_t* buf, int64_t len,
                             int64_t* dims_flat, int64_t* data_off,
                             int64_t* nbytes);
 
+// Delta records (protocol v2 remote-solver frames; see vcsnap.cc).
+int64_t vcsnap_delta_check(const int64_t* desc, int64_t desc_len,
+                           int64_t rows, int64_t row_bytes,
+                           int64_t payload_bytes,
+                           int64_t mirror_gen, int64_t base_gen);
+int32_t vcsnap_delta_apply(uint8_t* dst, int64_t rows, int64_t row_bytes,
+                           const int64_t* desc, int64_t desc_len,
+                           const uint8_t* payload, int64_t payload_bytes,
+                           int64_t mirror_gen, int64_t base_gen);
+
 void* vcreclaim_ctx_new(
     const long long* node_ptr, const long long* node_rows,
     int16_t* p_status, const int32_t* p_job,

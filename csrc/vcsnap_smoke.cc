@@ -253,10 +253,90 @@ static void smoke_hostile_frames() {
   std::printf("hostile-frame unpack OK\n");
 }
 
+// Crafted-delta regression (protocol v2, ISSUE 10): the delta record
+// validator must reject every corruption a hostile peer can encode —
+// truncated descriptors/payloads, overlapping or unsorted row ranges,
+// bounds near INT64_MAX (where additive checks would wrap, UB under
+// the UBSan build), and a base-generation mismatch (which must fall
+// back to a full frame, never patch the wrong mirror) — and the apply
+// must leave the mirror untouched on every rejection.
+static void smoke_delta_records() {
+  const int64_t rows = 8, row_bytes = 4;
+  uint8_t mirror[8 * 4];
+  uint8_t orig[8 * 4];
+  for (int i = 0; i < 32; ++i) mirror[i] = orig[i] = (uint8_t)i;
+  // Valid delta: rows [1,3) and [5,6) replaced.
+  int64_t desc[] = {2, 1, 3, 5, 6};
+  uint8_t payload[3 * 4];
+  for (int i = 0; i < 12; ++i) payload[i] = (uint8_t)(100 + i);
+  assert(vcsnap_delta_check(desc, 5, rows, row_bytes, 12, 7, 7) == 3);
+  assert(vcsnap_delta_apply(mirror, rows, row_bytes, desc, 5, payload,
+                            12, 7, 7) == 0);
+  assert(mirror[0] == 0);                      // row 0 untouched
+  assert(mirror[4] == 100 && mirror[11] == 107);   // rows 1-2 patched
+  assert(std::memcmp(mirror + 12, orig + 12, 8) == 0);  // rows 3-4
+  assert(mirror[20] == 108 && mirror[23] == 111);  // row 5 patched
+  std::memcpy(mirror, orig, 32);
+
+  // (1) ack/base-generation mismatch: the mirror holds gen 7, the
+  // delta claims base 6 — must report -2 and touch nothing.
+  assert(vcsnap_delta_check(desc, 5, rows, row_bytes, 12, 7, 6) == -2);
+  assert(vcsnap_delta_apply(mirror, rows, row_bytes, desc, 5, payload,
+                            12, 7, 6) == -2);
+  assert(std::memcmp(mirror, orig, 32) == 0);
+
+  // (2) truncated descriptor: n_ranges claims more pairs than ride.
+  int64_t trunc[] = {2, 1, 3};
+  assert(vcsnap_delta_check(trunc, 3, rows, row_bytes, 12, 7, 7) == -1);
+  // n_ranges near INT64_MAX: `1 + 2 * n` would wrap; the division-form
+  // check must reject without the multiply ever happening.
+  int64_t huge_n[] = {INT64_MAX - 1, 1, 3};
+  assert(vcsnap_delta_check(huge_n, 3, rows, row_bytes, 12, 7, 7) == -1);
+
+  // (3) truncated payload: ranges sum to 3 rows but only 2 rows ride.
+  assert(vcsnap_delta_check(desc, 5, rows, row_bytes, 8, 7, 7) == -1);
+  // Payload not a whole number of rows.
+  assert(vcsnap_delta_check(desc, 5, rows, row_bytes, 11, 7, 7) == -1);
+
+  // (4) overlapping ranges ([1,4) then [3,6)) and unsorted ranges.
+  int64_t overlap[] = {2, 1, 4, 3, 6};
+  assert(vcsnap_delta_check(overlap, 5, rows, row_bytes, 24, 7, 7)
+         == -1);
+  int64_t unsorted[] = {2, 5, 6, 1, 3};
+  assert(vcsnap_delta_check(unsorted, 5, rows, row_bytes, 12, 7, 7)
+         == -1);
+
+  // (5) hostile bounds near INT64_MAX: stop past rows, start/stop both
+  // huge (s >= e and e > rows must each reject without `s + X`
+  // arithmetic), empty and negative ranges.
+  int64_t huge_e[] = {1, 0, INT64_MAX - 2};
+  assert(vcsnap_delta_check(huge_e, 3, rows, row_bytes, 4, 7, 7) == -1);
+  int64_t huge_se[] = {1, INT64_MAX - 2, INT64_MAX - 2};
+  assert(vcsnap_delta_check(huge_se, 3, rows, row_bytes, 0, 7, 7) == -1);
+  int64_t empty_r[] = {1, 2, 2};
+  assert(vcsnap_delta_check(empty_r, 3, rows, row_bytes, 0, 7, 7) == -1);
+  int64_t neg[] = {1, -1, 2};
+  assert(vcsnap_delta_check(neg, 3, rows, row_bytes, 12, 7, 7) == -1);
+
+  // (6) zero-range delta (a pure "nothing changed" record) is valid.
+  int64_t none[] = {0};
+  assert(vcsnap_delta_check(none, 1, rows, row_bytes, 0, 7, 7) == 0);
+  assert(vcsnap_delta_apply(mirror, rows, row_bytes, none, 1, payload,
+                            0, 7, 7) == 0);
+  assert(std::memcmp(mirror, orig, 32) == 0);
+
+  // (7) zero-width rows (row_bytes 0): only an empty payload passes.
+  assert(vcsnap_delta_check(desc, 5, rows, 0, 0, 7, 7) == 3);
+  assert(vcsnap_delta_check(desc, 5, rows, 0, 4, 7, 7) == -1);
+
+  std::printf("delta records OK\n");
+}
+
 int main() {
   std::printf("vcsnap_version=%d\n", vcsnap_version());
   smoke_serializer();
   smoke_hostile_frames();
+  smoke_delta_records();
 
   // Cluster: 4 nodes x 2 slots; queue 0 = "victim" (reclaimable),
   // queue 1 = "premium".  Rows 0-7: running victims (job per row, queue

@@ -54,6 +54,32 @@ assert tails["(devincr fallback)"]["full"] >= 1, tails
 assert tails["(devincr off)"]["null_delta_dispatches"] >= 1, tails
 print(f"BENCH_DEVINCR smoke OK ({len(rows)} rows)")
 '
+# BENCH_WIRE smoke (ISSUE 10): the remote-solver transport A/B at a
+# small shape — asserts all three modes (delta / full / forced
+# fallback) complete over real loopback TCP with the 5%-churn
+# pipelined feed and emit their wire JSON tails, the delta pass
+# actually ships delta frames for FEWER bytes/cycle than full frames,
+# and the fallback pass counts its forced full-frame fallbacks.
+BENCH_WIRE=1 BENCH_CONFIG=2 BENCH_NODES=128 BENCH_PODS=1024 \
+  BENCH_REPEATS=1 BENCH_PIPE_CYCLES=5 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+want = {"(wire delta)", "(wire full)", "(wire fallback)"}
+modes = {m for m in want for r in rows if m in r["metric"]}
+assert modes == want, f"missing BENCH_WIRE modes: {want - modes}"
+tails = {m: r["wire"] for m in want for r in rows
+         if m in r["metric"] and "wire" in r}
+assert tails["(wire delta)"]["frames"]["delta"] >= 1, tails
+assert tails["(wire full)"]["frames"]["delta"] == 0, tails
+assert tails["(wire fallback)"]["frames"]["delta"] == 0, tails
+assert tails["(wire fallback)"]["fallbacks"].get("forced", 0) >= 1, tails
+ratio = tails["(wire full)"]["bytes_per_cycle"] / max(
+    tails["(wire delta)"]["bytes_per_cycle"], 1)
+assert ratio > 2, f"delta frames did not shrink the wire: {ratio:.1f}x"
+print(f"BENCH_WIRE smoke OK ({len(rows)} rows, {ratio:.1f}x fewer "
+      "bytes/cycle on deltas)")
+'
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

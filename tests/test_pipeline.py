@@ -503,13 +503,20 @@ def test_dispatch_slot_is_exclusive_remote_contract():
     """The remote protocol allows one outstanding solve: a second
     dispatch without a fetch must fail loudly, and abandon must clear
     the slot."""
-    from volcano_tpu.solver_service import PendingSolve, RemoteSolver
+    from volcano_tpu.solver_service import (
+        PendingSolve,
+        RemoteSolver,
+        _WireCache,
+    )
 
     client = RemoteSolver.__new__(RemoteSolver)
     import threading
 
     client._lock = threading.Lock()
     client._sock = None
+    client._wire = _WireCache()
+    client._shm = None
+    client.wire_fallbacks = {}
     client._pending = PendingSolve(client)
     with pytest.raises(RuntimeError):
         client._roundtrip(b"x")

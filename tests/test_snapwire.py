@@ -117,3 +117,117 @@ def test_dims_nbytes_mismatch_rejected(monkeypatch, use_native):
     good[d0:d0 + 8] = np.int64(-1).tobytes()
     with pytest.raises(ValueError):
         sw.decode_frame(bytes(good))
+
+
+# ------------------------------------------------ delta records (ISSUE 10)
+
+
+def test_diff_rows_bitwise_identity():
+    """Row diffing is BIT identity: -0.0 vs 0.0 and NaN-payload changes
+    must register as changed rows (they alter wire bytes), while
+    bit-identical NaNs must not."""
+    old = np.zeros((6, 2), np.float64)
+    old[3, 0] = np.nan
+    new = old.copy()
+    assert len(sw.diff_rows(new, old)) == 0  # NaN == NaN bitwise
+    new[0, 1] = -0.0  # compares == 0.0 but differs bitwise
+    r = sw.diff_rows(new, old)
+    assert r.tolist() == [[0, 1]]
+    # Adjacent + separate changes coalesce into ascending ranges.
+    new[1, 0] = 7.0
+    new[5, 1] = 8.0
+    assert sw.diff_rows(new, old).tolist() == [[0, 2], [5, 6]]
+    # Shape/dtype drift is not row-diffable: the slot ships whole.
+    assert sw.diff_rows(new, old.astype(np.float32)) is None
+    assert sw.diff_rows(new[:5], old) is None
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_delta_check_native_numpy_parity(monkeypatch, use_native):
+    """The python fallback and the C++ validator agree verdict-for-
+    verdict on valid and hostile descriptors (same contract the csrc
+    ASAN smoke pins natively)."""
+    if not use_native:
+        monkeypatch.setattr(sw, "lib_or_none", lambda: None)
+    rows, row_bytes = 8, 4
+    ok = np.array([2, 1, 3, 5, 6], np.int64)
+    assert sw.delta_check(ok, rows, row_bytes, 12, 7, 7) == 3
+    # Base-generation mismatch -> -2 (fall back to a full frame).
+    assert sw.delta_check(ok, rows, row_bytes, 12, 7, 6) == -2
+    # Truncated descriptor / hostile count near INT64_MAX.
+    assert sw.delta_check(np.array([2, 1, 3], np.int64),
+                          rows, row_bytes, 12, 7, 7) == -1
+    huge = np.array([np.iinfo(np.int64).max - 1, 1, 3], np.int64)
+    assert sw.delta_check(huge, rows, row_bytes, 12, 7, 7) == -1
+    # Payload length mismatch / non-integral rows.
+    assert sw.delta_check(ok, rows, row_bytes, 8, 7, 7) == -1
+    assert sw.delta_check(ok, rows, row_bytes, 11, 7, 7) == -1
+    # Overlapping, unsorted, empty, negative and out-of-bounds ranges.
+    for bad in ([2, 1, 4, 3, 6], [2, 5, 6, 1, 3], [1, 2, 2],
+                [1, -1, 2], [1, 0, np.iinfo(np.int64).max - 2]):
+        n_rows = sum(max(0, int(bad[i + 2]) - int(bad[i + 1]))
+                     for i in range(0, 2 * int(bad[0]), 2)
+                     ) if bad[0] < 4 else 0
+        assert sw.delta_check(np.array(bad, np.int64), rows, row_bytes,
+                              n_rows * row_bytes, 7, 7) == -1
+    # Non-int64 / non-1d descriptors are rejected before either engine.
+    assert sw.delta_check(np.array([0], np.int32), rows, row_bytes,
+                          0, 7, 7) == -1
+    # Empty delta ("nothing changed") is valid.
+    assert sw.delta_check(np.array([0], np.int64), rows, row_bytes,
+                          0, 7, 7) == 0
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_delta_roundtrip_scatter(monkeypatch, use_native):
+    """diff_rows -> ranges_to_desc/gather_rows -> delta_apply recreates
+    the new array exactly, through both engines, and a rejected delta
+    leaves the mirror untouched."""
+    if not use_native:
+        monkeypatch.setattr(sw, "lib_or_none", lambda: None)
+    rng = np.random.RandomState(3)
+    for dtype, cols in ((np.float32, 5), (np.int64, 3), (np.uint8, 17)):
+        old = rng.randint(0, 200, (64, cols)).astype(dtype)
+        new = old.copy()
+        for row in (0, 1, 13, 14, 15, 63):
+            new[row] = rng.randint(0, 200, cols).astype(dtype)
+        r = sw.diff_rows(new, old)
+        assert len(r) >= 1
+        desc = sw.ranges_to_desc(r)
+        payload = sw.gather_rows(new, r)
+        mirror = old.copy()
+        sw.delta_apply(mirror, desc, payload, 5, 5)
+        assert np.array_equal(
+            mirror.view(np.uint8), new.view(np.uint8))
+        # Wrong base generation: ValueError, mirror untouched.
+        mirror2 = old.copy()
+        with pytest.raises(ValueError):
+            sw.delta_apply(mirror2, desc, payload, 5, 4)
+        assert np.array_equal(mirror2, old)
+        # Malformed descriptor: ValueError, mirror untouched.
+        bad = desc.copy()
+        bad[0] = np.iinfo(np.int64).max - 1
+        with pytest.raises(ValueError):
+            sw.delta_apply(mirror2, bad, payload, 5, 5)
+        assert np.array_equal(mirror2, old)
+
+
+def test_encode_frame_views_byte_identical():
+    """The scatter-gather encode produces the EXACT byte stream of
+    encode_frame — total length and concatenated buffers — without
+    copying any array payload (the data parts are memoryviews into the
+    caller's arrays)."""
+    arrays = _cases()
+    man = {"op": "solve", "wire": {"gen": 3}, "wave": None}
+    ref = sw.encode_frame(arrays, man)
+    total, parts = sw.encode_frame_views(arrays, man)
+    assert total == len(ref)
+    assert b"".join(bytes(p) for p in parts) == ref
+    # The payload parts alias the source arrays (zero-copy proof): a
+    # contiguous input's memoryview shares its buffer.
+    a = np.arange(32, dtype=np.int64)
+    _, pv = sw.encode_frame_views([a], {})
+    views = [p for p in pv if isinstance(p, memoryview)]
+    assert len(views) == 1
+    a[0] = 99  # mutating the array is visible through the view
+    assert bytes(views[0][:8]) == np.int64(99).tobytes()

@@ -203,6 +203,9 @@ SNAPWIRE_FIX = textwrap.dedent('''\
     _DTYPES = [
         np.dtype(np.float32), np.dtype(np.int32),
     ]
+    REC_FULL = 0
+    REC_SAME = 1
+    REC_DELTA = 2
 ''')
 
 CC_FIX_DRIFTED = textwrap.dedent('''\
@@ -213,6 +216,9 @@ CC_FIX_DRIFTED = textwrap.dedent('''\
     constexpr VcsnapDtype kVcsnapDtypes[] = {
         {0, "float32", 4}, {1, "int32", 8},
     };
+    constexpr int32_t kVcsnapRecFull = 0;
+    constexpr int32_t kVcsnapRecSame = 2;
+    constexpr int32_t kVcsnapRecExtra = 7;
 ''')
 
 SCHEMA_FIX = textwrap.dedent('''\
@@ -273,6 +279,14 @@ def test_schema_checker_catches_seeded_drift():
     assert "VCL303" in codes and "4 argtypes" in msgs
     # float16 is not a wire dtype -> VCL304
     assert "VCL304" in codes and "float16" in msgs
+    # Delta record tags (protocol v2, ISSUE 10) -> VCL305: value drift
+    # (REC_SAME 1 vs 2), a python tag with no C++ counterpart
+    # (REC_DELTA), and a C++ tag with no python counterpart
+    # (kVcsnapRecExtra) must each surface.
+    assert "VCL305" in codes
+    assert "REC_SAME=1 (python) != kVcsnapRecSame=2" in msgs
+    assert "REC_DELTA has no C++ counterpart" in msgs
+    assert "kVcsnapRecExtra has no python counterpart" in msgs
 
 
 def test_schema_checker_real_tree_is_clean():

@@ -20,6 +20,11 @@ Four independent comparisons, all static (nothing is imported or run):
   the NodeArrays/TaskArrays/JobArrays/QueueArrays NamedTuple field lists
   (1:1, same order), with every declared dtype present in the wire dtype
   table.
+- **VCL305 delta record tags**: ``cache/snapwire.py REC_*`` (protocol
+  v2 delta solve frames, ISSUE 10) vs ``csrc/vcsnap.cc kVcsnapRec*``.
+  The tag values are wire format between the scheduler and the solver
+  child — count, names and values must agree 1:1 in both directions,
+  exactly the drift class the dtype table check covers.
 """
 
 from __future__ import annotations
@@ -43,11 +48,14 @@ NP_WIDTH = {
 
 
 def parse_snapwire(source: str) -> Tuple[
-        List[str], Dict[str, int], Optional[int]]:
-    """(_DTYPES names in order, WIRE_* constants, _DTYPES line)."""
+        List[str], Dict[str, int], Dict[str, Tuple[int, int]],
+        Optional[int]]:
+    """(_DTYPES names in order, WIRE_* constants, REC_* delta record
+    tags as name -> (value, line), _DTYPES line)."""
     tree = ast.parse(source)
     names: List[str] = []
     consts: Dict[str, int] = {}
+    recs: Dict[str, Tuple[int, int]] = {}
     line: Optional[int] = None
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -69,7 +77,10 @@ def parse_snapwire(source: str) -> Tuple[
             elif tname.startswith("WIRE_") and isinstance(
                     node.value, ast.Constant):
                 consts[tname] = int(node.value.value)
-    return names, consts, line
+            elif tname.startswith("REC_") and isinstance(
+                    node.value, ast.Constant):
+                recs[tname] = (int(node.value.value), node.lineno)
+    return names, consts, recs, line
 
 
 def parse_wire_columns(source: str) -> Tuple[
@@ -333,7 +344,7 @@ def analyze(snapwire_path: str, snapwire_src: str,
     findings: List[Finding] = []
 
     # ---- VCL301: dtype table --------------------------------------
-    py_dtypes, py_consts, py_line = parse_snapwire(snapwire_src)
+    py_dtypes, py_consts, py_recs, py_line = parse_snapwire(snapwire_src)
     cc_rows, cc_consts, cc_line = parse_vcsnap_cc(cc_src)
     if not py_dtypes:
         findings.append(Finding(
@@ -402,6 +413,55 @@ def analyze(snapwire_path: str, snapwire_src: str,
                 f"{py_name}=0x{pv:X} (python) != {cc_name}=0x{cv:X} "
                 "(C++)",
             ))
+
+    # ---- VCL305: delta record tags ---------------------------------
+    # REC_FULL <-> kVcsnapRecFull etc.: the tag byte is wire format of
+    # the protocol-v2 delta solve frames (ISSUE 10), shared between the
+    # python codec and the C++ validator exactly like the dtype codes.
+    cc_recs = {k: v for k, v in cc_consts.items()
+               if k.startswith("kVcsnapRec")}
+    if not py_recs:
+        findings.append(Finding(
+            "VCL305", snapwire_path, 1,
+            "could not parse REC_* delta record tags (protocol v2 "
+            "table missing?)",
+        ))
+    if not cc_recs:
+        findings.append(Finding(
+            "VCL305", cc_path, 1,
+            "could not parse kVcsnapRec* delta record tags (protocol "
+            "v2 table missing?)",
+        ))
+    if py_recs and cc_recs:
+        py_to_cc = {
+            name: "kVcsnapRec" + "".join(
+                p.title() for p in name[len("REC_"):].split("_")
+            )
+            for name in py_recs
+        }
+        for name, (value, rline) in sorted(py_recs.items()):
+            cc_name = py_to_cc[name]
+            cv = cc_recs.get(cc_name)
+            if cv is None:
+                findings.append(Finding(
+                    "VCL305", snapwire_path, rline,
+                    f"delta record tag {name} has no C++ counterpart "
+                    f"{cc_name} in vcsnap.cc",
+                ))
+            elif cv != value:
+                findings.append(Finding(
+                    "VCL305", snapwire_path, rline,
+                    f"delta record tag drift: {name}={value} (python) "
+                    f"!= {cc_name}={cv} (C++)",
+                ))
+        known_cc = set(py_to_cc.values())
+        for cc_name in sorted(cc_recs):
+            if cc_name not in known_cc:
+                findings.append(Finding(
+                    "VCL305", cc_path, 1,
+                    f"C++ delta record tag {cc_name} has no python "
+                    "counterpart REC_* in snapwire.py",
+                ))
 
     # ---- VCL303: ctypes bindings vs header prototypes --------------
     protos = parse_header_protos(header_src)

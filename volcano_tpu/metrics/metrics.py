@@ -249,6 +249,31 @@ class Metrics:
             "derive, node-membership churn, compaction, dirty-set overflow "
             "past VOLCANO_TPU_DIRTY_CAP, or VOLCANO_TPU_INCREMENTAL=0)",
         )
+        self.remote_frame_bytes = _Counter(
+            f"{ns}_remote_frame_bytes_total",
+            "Remote-solver wire bytes shipped scheduler->solver "
+            "(length prefix included), by frame kind: full (the whole "
+            "materialized solve-args frame — first frame of a "
+            "connection, kill switch off, or any fallback) or delta "
+            "(only changed row ranges and changed planes against the "
+            "child's per-connection mirror, protocol v2)",
+        )
+        self.remote_frame_fallback = _Counter(
+            f"{ns}_remote_frame_fallback_total",
+            "Delta-lane frames forced back to a full frame, by "
+            "reason: reconnect (socket re-established, child mirror "
+            "gone), abandon (pipelined reply dropped, framing reset), "
+            "spec-change (the solve-args pytree shape drifted, slots "
+            "no longer align), gen-mismatch (child replied resync: "
+            "its mirror does not hold the delta's base), ack-mismatch "
+            "(reply acknowledged a different generation than "
+            "dispatched), child-error (the solve errored in the child "
+            "and poisoned its mirror), v1-child (the solver speaks "
+            "protocol v1 — no ack_gen in replies; the delta lane "
+            "self-disabled), shm (shared-memory segment unattachable; "
+            "lane disabled), forced (VOLCANO_TPU_WIRE=fallback A/B "
+            "lever)",
+        )
         self.pipeline_stale_drops = _Counter(
             f"{ns}_pipeline_stale_drop_rows_total",
             "In-flight solve rows that did not commit, by reason: the "

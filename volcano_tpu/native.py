@@ -71,6 +71,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.vcsnap_frame_unpack.argtypes = [
         _u8p, ctypes.c_int64, _u8p, _u8p, _i64p, _i64p, _i64p,
     ]
+    # Delta records (protocol v2 remote-solver frames, ISSUE 10).
+    lib.vcsnap_delta_check.restype = ctypes.c_int64
+    lib.vcsnap_delta_check.argtypes = [
+        _i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.vcsnap_delta_apply.restype = ctypes.c_int32
+    lib.vcsnap_delta_apply.argtypes = [
+        _u8p, ctypes.c_int64, ctypes.c_int64, _i64p, ctypes.c_int64,
+        _u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ]
     # Reclaim engine: all stable pointers are captured once into a C-side
     # context; the hot per-reclaimer call takes raw addresses (c_void_p)
     # to keep ctypes marshalling off the 20k-calls-per-cycle path.
