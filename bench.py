@@ -156,7 +156,7 @@ def _attach_remote(store):
 
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
           records=None, fallbacks=None, rebalance=None, devincr=None,
-          wire=None):
+          wire=None, preempt=None):
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
@@ -172,6 +172,10 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # BENCH_REBALANCE tail: frag-score before/after + plan stats
         # (docs/rebalance.md).
         payload["rebalance"] = dict(rebalance)
+    if preempt:
+        # BENCH_PREEMPT tail: what-if plan outcomes, evictions,
+        # convergence + zero-lost-pods proof (docs/preempt_reclaim.md).
+        payload["preempt"] = dict(preempt)
     if fallbacks:
         # Two-phase shortlist-fallback rescores over the measured
         # cycles, by reason (docs/metrics.md).
@@ -839,6 +843,102 @@ def config_rebalance():
     store.close()
 
 
+def config_preempt():
+    """BENCH_PREEMPT: device-native priority-tier preemption (ISSUE 11,
+    docs/preempt_reclaim.md).
+
+    BENCH_NODES worker nodes each fully occupied by a Running
+    low-priority batch pod (one single-member PodGroup per node — the
+    disruption budgets bite per group), plus a Pending high-priority
+    serving gang of BENCH_NODES/2 whole-node tasks.  Allocate alone can
+    never place the gang; the preempt lane plans victims via the
+    jitted kernel, proves the wave with a what-if solve, and commits.
+    Measures the plan+commit cycle and cycles to convergence through
+    the eviction grace window, and emits a "preempt" JSON tail (plans,
+    evictions, restores, zero-lost-pods) the run-e2e smoke asserts
+    device-lane engagement from."""
+    import time as _t
+
+    from volcano_tpu.cache import ClusterStore, FakeBinder, FakeEvictor
+    from volcano_tpu.metrics import metrics as _metrics
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.sim import ClusterSimulator
+
+    conf = """
+actions: "enqueue, allocate, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+    workers = int(os.environ.get("BENCH_NODES", 64))
+    gang = max(workers // 2, 1)
+    os.environ.setdefault("VOLCANO_TPU_EVICT_DEVICE", "1")
+    os.environ["VOLCANO_TPU_EVICT_CAP"] = str(workers)
+
+    store = ClusterStore(binder=FakeBinder(), evictor=FakeEvictor())
+    ClusterSimulator.priority_tier_workload(
+        store, workers=workers, serving_tasks=gang)
+    sched = Scheduler(store, conf_str=conf)
+    sim = ClusterSimulator(store, grace_steps=2)
+
+    def _plans():
+        return {
+            k[0][1] + "/" + k[1][1]: int(v)
+            for k, v in _metrics.whatif_plans.data.items()
+        }
+
+    def _evictions():
+        return int(sum(_metrics.preempt_evictions.data.values()))
+
+    ev_before = _evictions()
+    n_logical = len(store.pods)
+    t0 = _t.perf_counter()
+    sched.run_once()  # plans + proves + commits the preempt wave
+    plan_cycle_ms = (_t.perf_counter() - t0) * 1e3
+    converged_cycles = 0
+    bound = 0
+    for _ in range(24):
+        converged_cycles += 1
+        sim.step()
+        sched.run_once()
+        bound = sum(1 for p in store.pods.values()
+                    if p.name.startswith("serving-") and p.node_name)
+        if bound >= gang:
+            break
+    restored = sum(1 for p in store.pods.values() if "-mig" in p.uid)
+    ledger = store.migrations
+    _emit(
+        f"Preempt plan+prove+commit cycle @ {workers} nodes, "
+        f"{gang}-task serving gang over batch",
+        plan_cycle_ms, gang,
+        f"converged_in={converged_cycles} cycles bound={bound} "
+        f"evictions={_evictions() - ev_before} restored={restored}",
+        budget_ms=NORTH_STAR_MS,
+        lanes=store.last_cycle_lanes,
+        preempt={
+            "gang": gang,
+            "gang_bound": bound,
+            "plans": _plans(),
+            "evictions": int(_evictions() - ev_before),
+            "restored": restored,
+            "committed_plans": (ledger.committed_plans
+                                if ledger else 0),
+            "converged_cycles": converged_cycles,
+            "pods_before": n_logical,
+            "pods_after": len(store.pods),
+            "lost_pods": n_logical - len(store.pods),
+        },
+    )
+    store.close()
+
+
 def _emit_mesh_microbench(mesh):
     """One JSON line quantifying the cross-chip reduce of the sharded
     selection: the two-stage shard-local top-k (winner reduction over
@@ -925,6 +1025,11 @@ def main():
         # Fragmented-cluster defragmentation lane (ISSUE 5): its own
         # scenario, not a mode of the five configs.
         config_rebalance()
+        return
+    if os.environ.get("BENCH_PREEMPT"):
+        # Device-native priority-tier preemption lane (ISSUE 11): its
+        # own fragmented-priority scenario, not a mode of the configs.
+        config_preempt()
         return
     mesh_raw = os.environ.get("BENCH_MESH")
     if mesh_raw:

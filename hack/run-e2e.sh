@@ -80,6 +80,27 @@ assert ratio > 2, f"delta frames did not shrink the wire: {ratio:.1f}x"
 print(f"BENCH_WIRE smoke OK ({len(rows)} rows, {ratio:.1f}x fewer "
       "bytes/cycle on deltas)")
 '
+# BENCH_PREEMPT smoke (ISSUE 11): the device-native preempt lane on a
+# small fragmented-priority cluster — asserts the DEVICE lane actually
+# engaged (a committed what-if plan + evictions through the shared
+# ledger), the serving gang bound, and zero pods were lost (every
+# evicted batch pod restored as Pending and re-placed or parked).
+BENCH_PREEMPT=1 BENCH_NODES=8 JAX_PLATFORMS=cpu \
+  VOLCANO_TPU_EVICT_DEVICE=1 python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+tails = [r["preempt"] for r in rows if "preempt" in r]
+assert tails, "no preempt tail emitted"
+t = tails[0]
+assert t["committed_plans"] >= 1, f"device lane never committed: {t}"
+assert t["plans"].get("preempt/committed", 0) >= 1, t
+assert t["evictions"] >= 1, t
+assert t["gang_bound"] >= t["gang"], f"serving gang did not bind: {t}"
+assert t["lost_pods"] == 0, f"pods lost: {t}"
+assert t["restored"] == t["evictions"], t
+print(f"BENCH_PREEMPT smoke OK ({t[\"evictions\"]} evictions, "
+      f"{t[\"converged_cycles\"]} cycles to bind)")
+'
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

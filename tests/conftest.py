@@ -18,3 +18,12 @@ force_virtual_cpu_platform(8)
 # tests that pass via the fallback).  Tests that exercise the fallback
 # behavior itself override this with monkeypatch.setenv(..., "auto").
 os.environ.setdefault("VOLCANO_TPU_FALLBACK", "never")
+
+# The legacy preempt/reclaim suites (test_preempt_reclaim,
+# test_evict_oracle, test_reclaim_multiqueue, ...) assert the reference
+# host-walk semantics bind-for-bind against the object path; the
+# device-native plan-prove-commit lane (ISSUE 11, volcano_tpu/whatif.py)
+# is new semantics and its suites opt in explicitly with
+# monkeypatch.setenv("VOLCANO_TPU_EVICT_DEVICE", "1").  Outside tests
+# the device lane is the default.
+os.environ.setdefault("VOLCANO_TPU_EVICT_DEVICE", "0")

@@ -31,6 +31,7 @@ LOCK_FILES = [
     "volcano_tpu/solver_service.py",
     "volcano_tpu/fastpath.py",
     "volcano_tpu/fastpath_evict.py",
+    "volcano_tpu/whatif.py",
     "volcano_tpu/ops/devsnap.py",
     "volcano_tpu/obs/recorder.py",
 ]

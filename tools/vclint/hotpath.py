@@ -45,6 +45,10 @@ DEVICE_FNS = {
     # the warm-shortlist kernel, and the DeviceIncremental services
     # that return their cached device results.
     "_static_planes", "_warm_shortlist", "static_planes", "shortlist",
+    # Victim-selection kernel (ISSUE 11): eligibility/order/evictable
+    # planes come back device-resident; jax.device_get is the one
+    # sanctioned fetch before the host-side greedy runs.
+    "victim_scores",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -95,14 +99,36 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         # dispatch and the commit on every cycle.
         HotEntry("FastCycle._record_twophase_lanes"),
         HotEntry("FastCycle._count_shortlist_fb"),
-        # Rebalance lane (ISSUE 5): the frag-score kernel dispatch, the
-        # what-if solve dispatch, and the pipelined plan commit all sit
-        # on the cycle thread; an implicit sync here stalls every cycle
-        # the lane runs.
+        # Rebalance lane (ISSUE 5): the frag-score kernel dispatch and
+        # the pipelined plan commit sit on the cycle thread; an
+        # implicit sync here stalls every cycle the lane runs.  (The
+        # what-if dispatch/commit bodies moved to volcano_tpu/whatif.py
+        # in ISSUE 11 — see that file's entries below.)
         HotEntry("FastCycle._rebalance"),
         HotEntry("FastCycle._plan_rebalance"),
-        HotEntry("FastCycle._dispatch_plan"),
         HotEntry("FastCycle._commit_inflight_plan"),
+    ],
+    "volcano_tpu/whatif.py": [
+        # The what-if engine (ISSUE 11): hypothetical-solve dispatch,
+        # pipelined plan commit, verdict + eviction commit, and the
+        # preempt/reclaim planners that dispatch the victim kernel —
+        # all on the cycle thread.
+        HotEntry("whatif_inputs"),
+        HotEntry("dispatch_plan"),
+        HotEntry("commit_inflight_plan"),
+        HotEntry("apply_plan"),
+        HotEntry("commit_plan"),
+        HotEntry("_plan_evict"),
+        HotEntry("_plan_evict_gang"),
+        HotEntry("run_evict_action"),
+    ],
+    "volcano_tpu/ops/victim.py": [
+        # The jitted victim-selection kernel (a VCL201 taint source)
+        # and the host-only greedy selection over its fetched planes.
+        HotEntry("victim_scores"),
+        HotEntry("select_victims"),
+        HotEntry("fit_counts"),
+        HotEntry("queue_shares"),
     ],
     "volcano_tpu/ops/wave.py": [
         # The devsnap planes (allocatable/max_tasks/ready/label_bits/

@@ -13,13 +13,16 @@ This module also owns the **migration planner** state that outlives a
 single cycle:
 
 - ``MigrationLedger`` — the store-attached record of in-flight
-  migrations.  A committed plan registers every victim; when the
-  evicted pod finishes terminating (``store.delete_pod``, driven by the
-  simulator's graceful-termination ticks or a real kubelet), the ledger
-  *restores* it: an identical Pending pod re-enters the store, playing
-  the owning controller's recreate.  No pod is ever lost — the plan
-  proved a placement exists, and the restored pod schedules through the
-  ordinary allocate lane.
+  migrations, shared since ISSUE 11 by every what-if engine action
+  (rebalance, device-native preempt and reclaim — entries carry the
+  evicting ``action`` and the beneficiary gang).  A committed plan
+  registers every victim; when the evicted pod finishes terminating
+  (``store.delete_pod``, driven by the simulator's graceful-termination
+  ticks or a real kubelet), the ledger *restores* it: an identical
+  Pending pod re-enters the store, playing the owning controller's
+  recreate.  No pod is ever lost — rebalance proved a re-placement
+  exists; a preempted/reclaimed pod waits its turn through the ordinary
+  allocate lane.
 - disruption budgets — the PDB equivalent.  ``max_unavailable_of``
   resolves a PodGroup's ceiling (``PodGroup.max_unavailable``, else the
   ``VOLCANO_TPU_REBALANCE_MAX_UNAVAIL`` default); the ledger's
@@ -77,15 +80,23 @@ def max_unavailable_of(pg) -> int:
 class _Migration:
     """One victim's evict -> restore -> rebind lifecycle."""
 
-    __slots__ = ("uid", "group_uid", "planned_node", "restored_uid")
+    __slots__ = ("uid", "group_uid", "planned_node", "restored_uid",
+                 "action", "for_gang")
 
-    def __init__(self, uid: str, group_uid: str, planned_node: str):
+    def __init__(self, uid: str, group_uid: str, planned_node: str,
+                 action: str = "rebalance", for_gang: str = ""):
         self.uid = uid
         self.group_uid = group_uid
         self.planned_node = planned_node
         # uid of the restored Pending pod, set when the eviction's
         # termination completes and the ledger re-creates the pod.
         self.restored_uid: Optional[str] = None
+        # Which engine action evicted this victim (ISSUE 11: preempt,
+        # reclaim and rebalance share one ledger and one per-PodGroup
+        # disruption-budget pool) and which starved gang the wave
+        # served (``wave_pending`` keys re-plan suppression on it).
+        self.action = action
+        self.for_gang = for_gang
 
 
 class MigrationLedger:
@@ -105,9 +116,10 @@ class MigrationLedger:
 
     # ------------------------------------------------------------ commit
 
-    def register(self, uid: str, group_uid: str,
-                 planned_node: str) -> None:
-        self.entries[uid] = _Migration(uid, group_uid, planned_node)
+    def register(self, uid: str, group_uid: str, planned_node: str,
+                 action: str = "rebalance", for_gang: str = "") -> None:
+        self.entries[uid] = _Migration(uid, group_uid, planned_node,
+                                       action=action, for_gang=for_gang)
 
     def cancel(self, uid: str) -> None:
         """Drop a migration whose eviction never dispatched (the
@@ -151,10 +163,12 @@ class MigrationLedger:
         entry.restored_uid = restored.uid
         self.restored_pods += 1
         store.add_pod(restored)
+        planned = (f" (planned node {entry.planned_node})"
+                   if entry.planned_node else "")
         store.record_event(
             f"Pod/{pod.namespace}/{pod.name}", "MigrationRestored",
-            f"restored as {restored.uid} after rebalance eviction "
-            f"(planned node {entry.planned_node})",
+            f"restored as {restored.uid} after {entry.action} "
+            f"eviction{planned}",
         )
 
     # ----------------------------------------------------------- budgets
@@ -188,11 +202,27 @@ class MigrationLedger:
         return sum(1 for e in self.entries.values()
                    if e.group_uid == group_uid)
 
-    def active(self, store) -> bool:
-        """True while any migration is incomplete — the planner runs one
-        migration wave at a time."""
+    def active(self, store, action: Optional[str] = None) -> bool:
+        """True while any migration is incomplete — the rebalance
+        planner runs one migration wave at a time.  ``action`` filters
+        to one engine action's entries: a preempted batch pod may stay
+        Pending indefinitely (its entry pins its group's budget, which
+        is correct PDB accounting), and that must not wedge the
+        rebalance lane's own single-wave gate."""
         self.prune(store)
-        return bool(self.entries)
+        if action is None:
+            return bool(self.entries)
+        return any(e.action == action for e in self.entries.values())
+
+    def wave_pending(self, store, gang_uid: str) -> bool:
+        """True while a prior wave for ``gang_uid`` is still FREEING
+        capacity (victims evicted but not yet terminated): planning
+        another wave for the same gang before the capacity lands would
+        double-evict for the same need.  Once the victims are restored
+        the gang either binds or is legitimately starved again."""
+        self.prune(store)
+        return any(e.for_gang == gang_uid and e.restored_uid is None
+                   for e in self.entries.values())
 
 
 def ledger_of(store) -> MigrationLedger:

@@ -784,16 +784,32 @@ class FastCycle:
                                 # but node task slots moved).
                                 self.m.mutation_seq += 1
                         elif name == "preempt":
-                            self._evict_machinery().preempt()
-                            # Evictions write p_status directly; the
-                            # pipelined staleness guard keys off the
-                            # mirror's mutation counter, so stamp the
-                            # action (preempt/reclaim run AFTER the
-                            # allocate dispatch in the standard confs).
-                            self.m.mutation_seq += 1
+                            if self._evict_device_on():
+                                # Device-native lane (ISSUE 11): plan
+                                # victims via the jitted kernel, prove
+                                # with a what-if solve, commit (or park)
+                                # through the engine — which stamps the
+                                # mutation counter itself iff it evicts.
+                                from . import whatif
+
+                                whatif.run_evict_action(self, "preempt")
+                            else:
+                                self._evict_machinery().preempt()
+                                # Evictions write p_status directly; the
+                                # pipelined staleness guard keys off the
+                                # mirror's mutation counter, so stamp the
+                                # action (preempt/reclaim run AFTER the
+                                # allocate dispatch in the standard
+                                # confs).
+                                self.m.mutation_seq += 1
                         elif name == "reclaim":
-                            self._evict_machinery().reclaim()
-                            self.m.mutation_seq += 1
+                            if self._evict_device_on():
+                                from . import whatif
+
+                                whatif.run_evict_action(self, "reclaim")
+                            else:
+                                self._evict_machinery().reclaim()
+                                self.m.mutation_seq += 1
                         elif name == "rebalance":
                             # Defragmentation planner (ISSUE 5): a
                             # committed plan evicts through the same
@@ -861,6 +877,7 @@ class FastCycle:
             error=type(err).__name__ if err is not None else None,
             spans=self.tracer.drain(),
             rebalance=st.get("rebalance"),
+            whatif=st.get("whatif"),
         ))
 
     def _count_drops(self, reasons: Dict[str, int]) -> None:
@@ -950,6 +967,16 @@ class FastCycle:
                 "device_fine", "device", now - int(fine * 1e9),
                 int(fine * 1e9), tid="cycle", args=args,
             )
+
+    def _evict_device_on(self) -> bool:
+        """True when preempt/reclaim run the device-native
+        plan-prove-commit lane (volcano_tpu/whatif.py) instead of the
+        host-side victim walk.  ``VOLCANO_TPU_EVICT_DEVICE=0`` (or a
+        remote-solver deployment, whose scheduler process cannot run
+        the what-if solve) keeps the host walk bind-for-bind."""
+        from . import whatif
+
+        return whatif.evict_device_on(self.store)
 
     def _evict_machinery(self):
         self._flush_aggr()
@@ -3680,22 +3707,25 @@ class FastCycle:
         behind the staleness guard."""
         from .actions.rebalance import rebalance_enabled
 
+        from . import whatif
+
         store = self.store
         if not rebalance_enabled():
             return
-        if (getattr(store, "remote_solver", None) is not None
-                or getattr(store, "solve_mesh", None) is not None):
-            # The what-if solve runs on the local single-device backend;
-            # remote-solver and mesh deployments keep the lane off until
-            # it carries them.  (The ALLOCATE lane pipelines under a
-            # mesh since ISSUE 7 — only this hypothetical-solve lane
-            # still needs the local backend, because the what-if patches
-            # host arrays that the sharded devsnap owns on-device.)
+        if getattr(store, "remote_solver", None) is not None:
+            # The what-if solve runs on the scheduler's own backend;
+            # remote-solver deployments keep the lane off.  A mesh is
+            # fine since ISSUE 11: the engine's hypothetical patches
+            # touch only per-cycle host planes, so the sharded devsnap
+            # dispatch carries them unchanged.
             return
         ledger = store.migrations
-        if ledger is not None and ledger.active(store):
-            # One migration wave at a time: budgets stay trivially
-            # honest and a half-done wave never compounds.
+        if ledger is not None and ledger.active(store, "rebalance"):
+            # One REBALANCE wave at a time: budgets stay trivially
+            # honest and a half-done wave never compounds.  (Preempt/
+            # reclaim entries share the ledger but gate per gang —
+            # their victims may legitimately stay Pending for a long
+            # time and must not wedge this lane.)
             return
         if store._inflight_plan is not None:
             return
@@ -3705,7 +3735,7 @@ class FastCycle:
         plan = self._plan_rebalance(jrow)
         if plan is None:
             return
-        self._dispatch_plan(plan)
+        whatif.dispatch_plan(self, plan)
 
     def _find_starved_gang(self) -> Optional[int]:
         """Most-starved schedulable gang (largest min_available
@@ -3765,15 +3795,13 @@ class FastCycle:
 
     def _plan_rebalance(self, jrow: int):
         """Score fragmentation and select a drain set for one starved
-        gang; returns an ``ops.rebalance.RebalancePlan`` or None."""
+        gang; returns a ``whatif.WhatIfPlan`` (action "rebalance",
+        victims re-solved) or None."""
         import jax
 
+        from . import whatif
         from .actions.rebalance import drain_cap, max_unavailable_of
-        from .ops.rebalance import (
-            RebalancePlan,
-            frag_scores,
-            select_drain_set,
-        )
+        from .ops.rebalance import frag_scores, select_drain_set
 
         m = self.m
         store = self.store
@@ -3880,8 +3908,9 @@ class FastCycle:
             )
             if not nodes:
                 if budget_blocked:
-                    self._count_rebalance(
-                        "rejected-budget", gang=m.j_uid[jrow],
+                    whatif.count_plan(
+                        self, "rebalance", "rejected-budget",
+                        gang=m.j_uid[jrow],
                         need=need, frag=round(frag_mean, 4),
                     )
                 # Cooldown either way: no drain set can form until the
@@ -3896,293 +3925,24 @@ class FastCycle:
             for r in victim_rows.tolist():
                 g = victim_group[r]
                 budgets[g] = budgets.get(g, 0) + 1
-            return RebalancePlan(
+            return whatif.WhatIfPlan(
+                action="rebalance",
                 gang_job=int(jrow), gang_uid=m.j_uid[jrow],
                 gang_rows=gang_rows, victim_rows=victim_rows,
                 victim_jobs=self.jobr[victim_rows].astype(np.int64),
                 drain_nodes=np.asarray(nodes, np.int64), need=need,
                 frag_before=frag_mean, budgets=budgets,
+                resolve_victims=True,
             )
-
-    def _plan_task_order(self, plan):
-        """(solve_jobs, task_rows, victims-in-solve-order) for a plan's
-        what-if solve: the starved gang's pending rows first (it is the
-        point of the migration), then the victims job-contiguously —
-        the order the assignment vector is aligned to."""
-        vorder = np.argsort(plan.victim_jobs, kind="stable")
-        vr = plan.victim_rows[vorder]
-        task_rows = np.concatenate(
-            [plan.gang_rows, vr]).astype(np.int64)
-        solve_jobs = [plan.gang_job]
-        seen = {plan.gang_job}
-        for j in plan.victim_jobs[vorder].tolist():
-            if j not in seen:
-                seen.add(j)
-                solve_jobs.append(int(j))
-        return solve_jobs, task_rows, vr
-
-    def _whatif_inputs(self, plan):
-        """Solver inputs for the hypothetically drained cluster: the
-        drained victims' capacity returns to idle, their rows leave the
-        resident set (ports / affinity counts / task slots), their
-        jobs' ready counts drop and their queues' allocations shrink by
-        the drained members, and queue-deserved gating is lifted for
-        the VICTIM queues only — a victim's re-placement frees exactly
-        what it claims, so re-arbitrating its share would veto a
-        capacity-neutral move, but the starved gang's placement is a
-        genuinely new allocation and keeps the live lane's gating (a
-        share-capped gang must not trigger an eviction wave the live
-        allocate would then veto anyway).  Everything else (devsnap
-        planes, two-phase shortlists, profile dedup) rides
-        ``_solve_inputs`` unchanged, so the plan solve hits the same
-        jit as the live allocate lane."""
-        m = self.m
-        # Deferred aggregate scatters must land on the REAL q_alloc
-        # before it is copied, or they would be lost to the patch.
-        self._flush_aggr()
-        solve_jobs, task_rows, vr = self._plan_task_order(plan)
-        vnode = m.p_node[:self.Pn][plan.victim_rows].astype(np.int64)
-        er, si, v = m.c_req.gather(plan.victim_rows)
-        idle_patch = self.n_idle.copy()
-        np.add.at(idle_patch, (vnode[er], si), v)
-        ntasks_patch = self.n_ntasks - np.bincount(
-            vnode, minlength=self.Nn).astype(I)
-        ready_patch = self.j_ready_base.copy()
-        np.add.at(ready_patch, plan.victim_jobs, -1)
-        resident_patch = self.resident.copy()
-        resident_patch[plan.victim_rows] = False
-        deserved_patch = self.q_deserved.copy()
-        q_alloc_patch = self.q_alloc.copy()
-        vq = self.q_of_job[plan.victim_jobs]
-        vq_ok = vq >= 0
-        if vq_ok.any():
-            deserved_patch[np.unique(vq[vq_ok])] = 3.0e38
-            # Un-charge the drained victims so a gang sharing a
-            # victim's queue is not double-gated against allocations
-            # the solve itself will re-charge on re-placement.
-            er_q = vq_ok[er]
-            np.add.at(q_alloc_patch,
-                      (vq[er][er_q], si[er_q]), -v[er_q])
-        saved = (self.n_idle, self.n_ntasks, self.j_ready_base,
-                 self.resident, self.q_deserved, self.q_alloc)
-        (self.n_idle, self.n_ntasks, self.j_ready_base, self.resident,
-         self.q_deserved, self.q_alloc) = (
-            idle_patch, ntasks_patch, ready_patch, resident_patch,
-            deserved_patch, q_alloc_patch)
-        # The what-if's encode must not POLLUTE the allocate lane's
-        # encode cache: its task rows differ, so caching its entry
-        # would (a) evict the live entry and (b) bump the profile
-        # generation — needlessly invalidating the device-incremental
-        # static planes and warm candidates (ISSUE 9) on every cycle
-        # that plans a rebalance.  Save/restore both slots; the what-if
-        # entry would never hit for the live lane anyway.
-        store = self.store
-        saved_cache = store._encode_cache
-        saved_gen = getattr(store, "_encode_gen", 0)
-        try:
-            inputs, pid, profiles, ncls = self._solve_inputs(
-                solve_jobs, task_rows, slim=True)
-        finally:
-            (self.n_idle, self.n_ntasks, self.j_ready_base,
-             self.resident, self.q_deserved, self.q_alloc) = saved
-            store._encode_cache = saved_cache
-            store._encode_gen = saved_gen
-        return inputs, pid, profiles, ncls
-
-    def _dispatch_plan(self, plan) -> None:
-        """Run (or pipeline) the plan's what-if solve."""
-        from .ops.wave import solve_wave
-
-        m = self.m
-        store = self.store
-        # No lanes= here: the action:rebalance span already accumulates
-        # the lane seconds; a second accumulation would double-count.
-        with self.tracer.span(
-                "rebalance_whatif", cat="rebalance",
-                args={"gang": plan.gang_uid,
-                      "victims": len(plan.victim_rows),
-                      "drain_nodes": len(plan.drain_nodes)}):
-            inputs, pid, profiles, ncls = self._whatif_inputs(plan)
-            payload = solve_wave(*inputs, pid=pid, profiles=profiles,
-                                 taint_any=self._taint_any,
-                                 node_classes=ncls)
-            if self._pipeline_on:
-                from .pipeline import InflightPlan
-
-                for arr in (payload.assigned, payload.never_ready):
-                    try:
-                        arr.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                store._solve_seq += 1
-                store._inflight_plan = InflightPlan(
-                    payload, plan, m.mutation_seq, m.epoch,
-                    m.compact_gen, self.Nn, plan_id=store._solve_seq,
-                )
-                return
-            import jax
-
-            assigned, never_ready = jax.device_get(
-                (payload.assigned, payload.never_ready)
-            )
-        self._apply_plan(plan, np.asarray(assigned),
-                         np.asarray(never_ready))
 
     def _commit_inflight_plan(self) -> None:
-        """Land (or void) the previous cycle's pipelined rebalance plan.
-        A whole-cluster what-if has no per-row salvage, so ANY drift —
-        mutation counter, node-table epoch, compaction generation, node
-        count — voids the plan wholesale (it mutated nothing; the
-        planner re-forms against fresh state)."""
-        from .pipeline import take_inflight_plan
+        """Land (or void) the previous cycle's pipelined what-if plan —
+        rebalance, preempt or reclaim — through the shared engine
+        (``whatif.commit_inflight_plan``): any mutation/epoch/compaction
+        /node-count drift voids the plan wholesale."""
+        from . import whatif
 
-        inflight = take_inflight_plan(self.store)
-        if inflight is None:
-            return
-        m = self.m
-        plan = inflight.plan
-        with self.tracer.span(
-                "rebalance_commit", cat="rebalance", lanes=self.lanes,
-                lane="rebalance",
-                args={"plan_id": inflight.plan_id,
-                      "gang": plan.gang_uid,
-                      "victims": len(plan.victim_rows)}):
-            if (m.mutation_seq != inflight.mutation_seq
-                    or m.epoch != inflight.epoch
-                    or m.compact_gen != inflight.compact_gen
-                    or self.Nn != inflight.n_nodes):
-                inflight.abandon()
-                self._count_rebalance(
-                    "stale-voided", gang=plan.gang_uid,
-                    victims=len(plan.victim_rows))
-                return
-            assigned, never_ready = inflight.fetch()
-            self._apply_plan(plan, assigned, never_ready)
-
-    def _apply_plan(self, plan, assigned: np.ndarray,
-                    never_ready: np.ndarray) -> None:
-        """Judge the what-if verdict and commit iff it strictly improves
-        binds: the gang reaches ready, every victim re-places, and the
-        gain clears the threshold."""
-        from .actions.rebalance import min_gain
-
-        m = self.m
-        _, task_rows, vr_sorted = self._plan_task_order(plan)
-        assigned = assigned[:len(task_rows)].astype(np.int64)
-        G = len(plan.gang_rows)
-        # The gang must still be the pending work the plan targeted
-        # (a pipelined solve landing just above may have bound or a
-        # delete removed rows during the overlap).
-        gr = plan.gang_rows
-        if not bool((m.p_alive[gr]
-                     & (m.p_status[gr] == ST_PENDING)).all()):
-            self._count_rebalance(
-                "stale-voided", gang=plan.gang_uid,
-                victims=len(plan.victim_rows))
-            return
-        gang_assigned = int((assigned[:G] >= 0).sum())
-        victims_ok = (bool((assigned[G:] >= 0).all())
-                      if len(assigned) > G else True)
-        gang_ready = (
-            not bool(never_ready[0])
-            and self.j_ready_base[plan.gang_job] + gang_assigned
-            >= int(m.j_minav[plan.gang_job])
-        )
-        if not (victims_ok and gang_ready
-                and gang_assigned >= min_gain()):
-            self._count_rebalance(
-                "rejected-no-gain", gang=plan.gang_uid,
-                need=plan.need, victims=len(plan.victim_rows),
-                gang_placed=gang_assigned,
-                frag=round(plan.frag_before, 4),
-            )
-            # The identical plan would re-form (and re-fail) next
-            # cycle; cool down until the cluster has had time to move.
-            self._rebalance_backoff_set(plan.gang_uid)
-            return
-        self._commit_rebalance(plan, vr_sorted, assigned[G:])
-
-    def _commit_rebalance(self, plan, victim_rows: np.ndarray,
-                          victim_nodes: np.ndarray) -> None:
-        """Execute a proven plan: evict every victim through the
-        fastpath_evict machinery (flushed to the store at cycle end,
-        exactly as preempt/reclaim evictions are) and register each
-        restore with the migration ledger so no pod is ever lost."""
-        from .actions.rebalance import ledger_of, max_unavailable_of
-
-        m = self.m
-        store = self.store
-        # Exact commit re-check behind the staleness guard: victims
-        # must still be the Running residents the plan drained.
-        ok = (m.p_alive[victim_rows]
-              & (m.p_status[victim_rows] == ST_RUNNING))
-        if not bool(ok.all()):
-            self._count_rebalance(
-                "stale-voided", gang=plan.gang_uid,
-                victims=len(victim_rows))
-            return
-        ledger = ledger_of(store)
-        # Budget re-check at commit time.  Under today's one-wave-at-a-
-        # time gate ``disrupted()`` is structurally 0 here (no plan
-        # forms while entries are live, and nothing else creates
-        # entries), so this reduces to the per-plan victims-per-group
-        # cap — the ledger charge is kept anyway so the invariant
-        # survives a future relaxation of the single-wave gate without
-        # anyone having to remember to add it back.
-        for uid, n_new in plan.budgets.items():
-            row = m.j_row.get(uid, -1)
-            pg = m.j_pg[row] if row >= 0 else None
-            if (ledger.disrupted(store, uid) + n_new
-                    > max_unavailable_of(pg)):
-                self._count_rebalance(
-                    "rejected-budget", gang=plan.gang_uid,
-                    victims=len(victim_rows))
-                return
-        ev = self._evict_machinery()
-        st = ev.st
-        events = []
-        for row, tgt in zip(victim_rows.tolist(),
-                            victim_nodes.tolist()):
-            st.evict(int(row), None)
-            st.evicted_rows.append(int(row))
-            tgt_name = (m.n_name[int(tgt)]
-                        if 0 <= int(tgt) < self.Nn else "")
-            ledger.register(m.p_uid[row],
-                            m.j_uid[int(self.jobr[row])], tgt_name)
-            events.append((
-                f"Pod/{m.p_key[row]}", "Rebalance",
-                f"migrating for gang {plan.gang_uid} "
-                f"(planned node {tgt_name})",
-            ))
-        ledger.committed_plans += 1
-        # Evictions moved mirror state: an overlapping solve dispatch
-        # must re-validate (same stamp preempt/reclaim apply).
-        # volcano_rebalance_evictions_total is counted at the cycle-end
-        # evictor DISPATCH (EvictState.flush), not here — a failed
-        # dispatch reverts the victim, and a counter bumped at commit
-        # would overstate evictions that never happened.
-        m.mutation_seq += 1
-        store.record_events_deferred(events)
-        self._count_rebalance(
-            "committed", gang=plan.gang_uid, need=plan.need,
-            victims=len(victim_rows),
-            drain_nodes=len(plan.drain_nodes),
-            frag=round(plan.frag_before, 4),
-        )
-
-    def _count_rebalance(self, outcome: str, **info) -> None:
-        """Fold a plan outcome into the counter series and the cycle's
-        flight-recorder accounting.  A cycle can see TWO outcomes — a
-        pipelined plan voiding at the top AND the lane's same-cycle
-        re-plan — so earlier outcomes are preserved under ``prior``
-        (the record and the Prometheus counter must agree on totals)."""
-        metrics.rebalance_plans.inc(outcome=outcome)
-        d = {"outcome": outcome}
-        d.update(info)
-        existing = self.stats.get("rebalance")
-        if existing is not None:
-            d["prior"] = existing.pop("prior", []) + [existing]
-        self.stats["rebalance"] = d
+        whatif.commit_inflight_plan(self)
 
     # --------------------------------------------------------------- close
 

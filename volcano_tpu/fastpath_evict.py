@@ -342,18 +342,27 @@ class EvictState:
             log.warning("%d evictions failed; pods revert to Running",
                         len(failed))
         if ledger is not None:
-            # Rebalance victims whose eviction actually dispatched
-            # (failed ones were cancelled above): the counter must
+            # Ledgered victims whose eviction actually dispatched
+            # (failed ones were cancelled above): the counters must
             # reflect evictions that happened, not plans that intended
-            # them.
-            n_migrated = sum(
-                1 for _row, key, pod in entries
-                if key not in failed and pod.uid in ledger.entries
-            )
-            if n_migrated:
+            # them.  Preempt, reclaim and rebalance waves share the
+            # ledger (ISSUE 11); each counts in its own series.
+            by_action: Dict[str, int] = {}
+            for _row, key, pod in entries:
+                if key in failed:
+                    continue
+                entry = ledger.entries.get(pod.uid)
+                if entry is not None:
+                    a = getattr(entry, "action", "rebalance")
+                    by_action[a] = by_action.get(a, 0) + 1
+            if by_action:
                 from .metrics import metrics
 
-                metrics.rebalance_evictions.inc(n_migrated)
+                n_reb = by_action.pop("rebalance", 0)
+                if n_reb:
+                    metrics.rebalance_evictions.inc(n_reb)
+                for a, n in by_action.items():
+                    metrics.preempt_evictions.inc(n, action=a)
         store.record_events_deferred(events)
         store.mark_objects_stale()
 

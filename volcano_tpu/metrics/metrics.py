@@ -292,6 +292,26 @@ class Metrics:
             "sufficient drain set), stale-voided (store mutated "
             "between the pipelined plan dispatch and its commit)",
         )
+        self.whatif_plans = _Counter(
+            f"{ns}_whatif_plans_total",
+            "What-if engine plans by action (preempt | reclaim | "
+            "rebalance) and outcome: committed (the hypothetical solve "
+            "proved the wave's goal; evictions dispatched), "
+            "rejected-no-gain (the solve failed the action's bar), "
+            "rejected-budget (per-PodGroup disruption budgets blocked "
+            "an otherwise sufficient wave), stale-voided (store "
+            "mutated between the pipelined plan dispatch and its "
+            "commit).  Rebalance outcomes also count in the historical "
+            "volcano_rebalance_plans_total series",
+        )
+        self.preempt_evictions = _Counter(
+            f"{ns}_preempt_evictions_total",
+            "Pods evicted by committed device-native preempt/reclaim "
+            "plans, by action; counted at the cycle-end evictor "
+            "dispatch.  Each victim is restored as Pending by the "
+            "migration ledger when its termination completes — zero "
+            "lost pods unconditionally",
+        )
         self.rebalance_evictions = _Counter(
             f"{ns}_rebalance_evictions_total",
             "Pods evicted by committed rebalance plans (each is "

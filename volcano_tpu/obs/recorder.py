@@ -45,7 +45,7 @@ class CycleRecord:
         "inflight_fetch_wait_ms", "dispatched_solve_id",
         "committed_solve_id", "mutation_seq_at_dispatch",
         "mutation_seq_at_commit", "epoch_at_dispatch", "epoch_at_commit",
-        "device_events", "error", "spans", "rebalance",
+        "device_events", "error", "spans", "rebalance", "whatif",
     )
 
     def __init__(self, session: str = "", path: str = "fast",
@@ -64,7 +64,8 @@ class CycleRecord:
                  device_events: Optional[List[str]] = None,
                  error: Optional[str] = None,
                  spans: Optional[list] = None,
-                 rebalance: Optional[dict] = None):
+                 rebalance: Optional[dict] = None,
+                 whatif: Optional[dict] = None):
         self.seq = -1  # assigned by FlightRecorder.record
         self.session = session
         self.path = path
@@ -89,6 +90,10 @@ class CycleRecord:
         # outcome, gang uid, need, drain/victim counts, frag score
         # (fastpath.FastCycle._rebalance).  None when the lane was idle.
         self.rebalance = rebalance
+        # Device-native preempt/reclaim plan accounting (ISSUE 11,
+        # volcano_tpu/whatif.py): action, outcome, gang uid, victim
+        # counts.  None when neither lane planned anything.
+        self.whatif = whatif
 
     def to_dict(self, include_spans: bool = False) -> dict:
         d = {
@@ -115,6 +120,8 @@ class CycleRecord:
             "error": self.error,
             "rebalance": (dict(self.rebalance)
                           if self.rebalance is not None else None),
+            "whatif": (dict(self.whatif)
+                       if self.whatif is not None else None),
         }
         if include_spans:
             d["spans"] = [s.to_dict() for s in self.spans]

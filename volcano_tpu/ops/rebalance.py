@@ -53,20 +53,9 @@ class FragScores(NamedTuple):
     fit_freed: jnp.ndarray  # [N] i32 gang tasks after draining evictables
 
 
-class RebalancePlan(NamedTuple):
-    """A drain set plus the what-if solve's bookkeeping, built host-side
-    by ``FastCycle._rebalance`` and either committed synchronously or
-    parked as ``pipeline.InflightPlan`` for the next cycle."""
-
-    gang_job: int                # mirror job row of the starved gang
-    gang_uid: str                # its PodGroup uid (events / ledger)
-    gang_rows: np.ndarray        # [G] pending mirror rows entering the solve
-    victim_rows: np.ndarray      # [V] running mirror rows to migrate
-    victim_jobs: np.ndarray      # [V] mirror job rows of the victims
-    drain_nodes: np.ndarray      # [K] node rows hypothetically drained
-    need: int                    # gang tasks outstanding at plan time
-    frag_before: float           # mean frag score over alive nodes
-    budgets: Dict[str, int]      # group uid -> victims this plan takes
+# The plan container lives with the engine since ISSUE 11:
+# ``volcano_tpu.whatif.WhatIfPlan`` (action-agnostic — rebalance builds
+# it with ``resolve_victims=True`` so victims re-enter the solve).
 
 
 @partial(jax.jit, static_argnames=())

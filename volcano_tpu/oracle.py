@@ -558,3 +558,223 @@ def oracle_backfill(be_feasible, group_inqueue, task_group):
         if len(feas):
             out[t] = int(feas[0])
     return out
+
+
+class EvictWaveVerdict(NamedTuple):
+    """``oracle_preempt``/``oracle_reclaim`` output: the victim planes
+    and the selected wave, re-derived naively."""
+
+    eligible: np.ndarray   # [V] bool tier-gated victim mask
+    order: np.ndarray      # [V] eviction order (eligible first)
+    q_share: np.ndarray    # [Q] queue share = max alloc/deserved
+    chosen: np.ndarray     # selected victim indices, eviction order
+    feasible: bool         # freed capacity covers the need
+    budget_blocked: bool   # budgets (not capacity/cap) blocked it
+    gain: int              # gang tasks the chosen wave frees
+
+
+def _oracle_victim_wave(mode, v_ok, v_jprio, v_crank, v_tie, v_queue,
+                        v_node, v_req, p_prio, p_queue, q_alloc,
+                        q_deserved, q_reclaimable, idle, prof_req, eps,
+                        need, v_job, v_group, j_ready, j_minav,
+                        budget_left, cap) -> EvictWaveVerdict:
+    """Go-shaped reference for the device victim kernel + greedy
+    selection (``ops/victim.py``): object-at-a-time loops, the order
+    re-derived as a repeated best-next scan instead of a lexsort, the
+    fit/slack arithmetic as per-slot loops.  ``mode``: 0 = preempt,
+    1 = reclaim.  Shared spec (tests require exact agreement):
+
+    - queue share = max over capped slots (deserved < 1e30) of
+      allocated/deserved, 0 with no capped slot.
+    - preempt eligibility: base-valid AND same queue as the preemptor
+      AND victim job priority strictly lower.
+    - reclaim eligibility: base-valid AND a DIFFERENT queue that is
+      Reclaimable and overused (share > 1 + 1e-6).
+    - eviction order: job priority asc, creation rank desc (youngest
+      first), tie asc; ineligible rows order last.
+    - selection: victims in order; skip nodes whose full drain gains no
+      gang capacity; gang floor (job stays >= minAvailable unless
+      minAvailable == 1); one budget charge per victim per PodGroup;
+      reclaim keeps the victim queue's share >= 1 - 1e-6 after each
+      eviction; stop at need covered or cap victims; prune victims on
+      nodes whose final fit never improved; budget_blocked iff the same
+      walk with unlimited budgets covers the need.
+    """
+    v_ok = np.asarray(v_ok, bool)
+    v_jprio = np.asarray(v_jprio, np.int64)
+    v_crank = np.asarray(v_crank, np.int64)
+    v_tie = np.asarray(v_tie, np.int64)
+    v_queue = np.asarray(v_queue, np.int64)
+    v_node = np.asarray(v_node, np.int64)
+    v_req = np.asarray(v_req, np.float32)
+    q_alloc = np.asarray(q_alloc, np.float32)
+    q_deserved = np.asarray(q_deserved, np.float32)
+    idle = np.asarray(idle, np.float32)
+    prof_req = np.asarray(prof_req, np.float32)
+    eps = np.asarray(eps, np.float32)
+    V = len(v_ok)
+    Q, R = q_alloc.shape
+    U = prof_req.shape[0]
+
+    def share_of(alloc_row, des_row):
+        s = np.float32(0.0)
+        for r in range(R):
+            if des_row[r] < np.float32(1.0e30):
+                ratio = np.float32(alloc_row[r]) / np.float32(
+                    max(des_row[r], np.float32(1e-9)))
+                if ratio > s:
+                    s = ratio
+        return np.float32(s)
+
+    q_share = np.array([share_of(q_alloc[q], q_deserved[q])
+                        for q in range(Q)], np.float32)
+
+    eligible = np.zeros(V, bool)
+    for i in range(V):
+        if not v_ok[i]:
+            continue
+        q = int(v_queue[i])
+        if mode == 0:
+            eligible[i] = (q == int(p_queue)
+                           and int(v_jprio[i]) < int(p_prio))
+        else:
+            eligible[i] = (q != int(p_queue) and 0 <= q < Q
+                           and bool(q_reclaimable[q])
+                           and float(q_share[q]) > 1.0 + 1e-6)
+
+    # Eviction order: repeated best-next scan by the shared key spec.
+    remaining = list(range(V))
+    order = []
+    while remaining:
+        best = None
+        for i in remaining:
+            # Ineligible rows share one sentinel priority key (the
+            # kernel masks their priority before sorting), so their
+            # relative order still follows (-crank, tie).
+            prio_key = (int(v_jprio[i]) if eligible[i]
+                        else np.iinfo(np.int32).max)
+            key = (0 if eligible[i] else 1, prio_key,
+                   -int(v_crank[i]), int(v_tie[i]))
+            if best is None or key < best[0]:
+                best = (key, i)
+        order.append(best[1])
+        remaining.remove(best[1])
+    order = np.asarray(order, np.int64)
+
+    def fit_one(plane_row):
+        best = 0
+        for u in range(U):
+            cnt = None
+            any_req = False
+            for r in range(R):
+                if prof_req[u][r] <= eps[r]:
+                    continue
+                any_req = True
+                c = int(np.floor((plane_row[r] + eps[r])
+                                 / max(prof_req[u][r], 1e-9)))
+                cnt = c if cnt is None else min(cnt, c)
+            if any_req:
+                best = max(best, max(cnt, 0))
+        return best
+
+    evictable = np.zeros_like(idle)
+    for i in range(V):
+        if eligible[i]:
+            evictable[int(v_node[i])] += v_req[i]
+    fit0 = {}
+    gain_ok = {}
+    for n in set(int(v_node[i]) for i in range(V) if eligible[i]):
+        fit0[n] = fit_one(idle[n])
+        gain_ok[n] = fit_one(idle[n] + evictable[n]) > fit0[n]
+
+    def walk(budgets):
+        freed = {}
+        cur_fit = {}
+        occupancy = {}
+        qa = np.array(q_alloc, np.float32)
+        chosen = []
+        gain = 0
+        skipped = False
+        for i in order.tolist():
+            if not eligible[i]:
+                break
+            if gain >= need or len(chosen) >= cap:
+                break
+            n = int(v_node[i])
+            if not gain_ok.get(n, False):
+                continue
+            j = int(v_job[i])
+            cnt = occupancy.get(j)
+            if cnt is None:
+                cnt = int(j_ready[j]) if 0 <= j < len(j_ready) else 0
+            minav = int(j_minav[j]) if 0 <= j < len(j_minav) else 1
+            if not (minav <= cnt - 1 or minav == 1):
+                continue
+            g = v_group[i]
+            if budgets.get(g, 0) < 1:
+                skipped = True
+                continue
+            if mode == 1:
+                q = int(v_queue[i])
+                after = share_of(qa[q] - v_req[i], q_deserved[q])
+                if float(after) < 1.0 - 1e-6:
+                    continue
+                qa[q] = qa[q] - v_req[i]
+            occupancy[j] = cnt - 1
+            budgets[g] = budgets.get(g, 0) - 1
+            f = freed.get(n)
+            if f is None:
+                f = freed[n] = np.zeros(R, np.float32)
+            old = cur_fit.get(n, fit0[n])
+            f += v_req[i]
+            new = fit_one(idle[n] + f)
+            cur_fit[n] = new
+            gain += new - old
+            chosen.append(i)
+        dead = {n for n in freed if cur_fit.get(n, fit0[n]) <= fit0[n]}
+        if dead:
+            chosen = [i for i in chosen if int(v_node[i]) not in dead]
+        return chosen, gain, skipped
+
+    chosen, gain, skipped = walk(dict(budget_left))
+    if gain >= need:
+        return EvictWaveVerdict(
+            eligible=eligible, order=order, q_share=q_share,
+            chosen=np.asarray(chosen, np.int64), feasible=True,
+            budget_blocked=False, gain=gain)
+    blocked = False
+    if skipped:
+        inf = {g: 1 << 30 for g in set(v_group)}
+        _, ugain, _ = walk(inf)
+        blocked = ugain >= need
+    return EvictWaveVerdict(
+        eligible=eligible, order=order, q_share=q_share,
+        chosen=np.zeros(0, np.int64), feasible=False,
+        budget_blocked=blocked, gain=gain)
+
+
+def oracle_preempt(v_ok, v_jprio, v_crank, v_tie, v_queue, v_node,
+                   v_req, p_prio, p_queue, q_alloc, q_deserved,
+                   q_reclaimable, idle, prof_req, eps, need, v_job,
+                   v_group, j_ready, j_minav, budget_left,
+                   cap) -> EvictWaveVerdict:
+    """Preempt-mode victim wave (same-queue, strictly lower priority)."""
+    return _oracle_victim_wave(
+        0, v_ok, v_jprio, v_crank, v_tie, v_queue, v_node, v_req,
+        p_prio, p_queue, q_alloc, q_deserved, q_reclaimable, idle,
+        prof_req, eps, need, v_job, v_group, j_ready, j_minav,
+        budget_left, cap)
+
+
+def oracle_reclaim(v_ok, v_jprio, v_crank, v_tie, v_queue, v_node,
+                   v_req, p_prio, p_queue, q_alloc, q_deserved,
+                   q_reclaimable, idle, prof_req, eps, need, v_job,
+                   v_group, j_ready, j_minav, budget_left,
+                   cap) -> EvictWaveVerdict:
+    """Reclaim-mode victim wave (cross-queue, Reclaimable + overused,
+    never below deserved)."""
+    return _oracle_victim_wave(
+        1, v_ok, v_jprio, v_crank, v_tie, v_queue, v_node, v_req,
+        p_prio, p_queue, q_alloc, q_deserved, q_reclaimable, idle,
+        prof_req, eps, need, v_job, v_group, j_ready, j_minav,
+        budget_left, cap)

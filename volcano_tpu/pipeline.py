@@ -167,20 +167,21 @@ def abandon_inflight(store) -> bool:
 
 
 class InflightPlan:
-    """A dispatched-but-uncommitted rebalance what-if solve (the plan of
-    cycle N, committed — or voided — at the top of cycle N+1).
+    """A dispatched-but-uncommitted what-if solve (the plan of cycle N
+    — rebalance, preempt or reclaim (``whatif.WhatIfPlan``) — committed
+    or voided at the top of cycle N+1).
 
     The what-if ``solve_wave`` over the hypothetically drained cluster
     rides the same pipelining as the allocate dispatch: the device round
     trip overlaps the dispatching cycle's close and the next cycle's
     host lanes.  Unlike ``InflightSolve``, a stale plan commits NOTHING
     — a whole-cluster what-if has no per-row salvage (partial commit
-    would evict victims whose replacement placement was never proven),
-    so any ``mutation_seq``/``epoch``/``compact_gen``/node-count drift
-    voids it wholesale (``volcano_rebalance_plans_total``
-    outcome=stale-voided) and the planner simply re-plans against fresh
-    state next cycle.  Nothing is lost either way: a plan only mutates
-    the store at COMMIT time.
+    would evict victims whose proven outcome no longer holds), so any
+    ``mutation_seq``/``epoch``/``compact_gen``/node-count drift voids
+    it wholesale (``volcano_whatif_plans_total`` outcome=stale-voided)
+    and the planner simply re-plans against fresh state next cycle.
+    Nothing is lost either way: a plan only mutates the store at COMMIT
+    time.
     """
 
     __slots__ = (
@@ -192,7 +193,7 @@ class InflightPlan:
                  compact_gen: int, n_nodes: int, plan_id: int = 0):
         # A local jax AllocResult (copy_to_host_async already issued).
         self.payload = payload
-        # ops.rebalance.RebalancePlan (host-side drain bookkeeping).
+        # whatif.WhatIfPlan (host-side wave bookkeeping).
         self.plan = plan
         self.mutation_seq = mutation_seq
         self.epoch = epoch

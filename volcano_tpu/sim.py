@@ -95,6 +95,81 @@ class ClusterSimulator:
             "terminating": terminating,
         }
 
+    @staticmethod
+    def priority_tier_workload(store: ClusterStore, workers: int = 4,
+                               node_cpu: str = "4", batch_cpu: str = "4",
+                               serving_tasks: int = 2,
+                               serving_cpu: str = "4",
+                               serving_priority: int = 1000,
+                               batch_priority: int = 10,
+                               namespace: str = "default"
+                               ) -> Dict[str, object]:
+        """Populate ``store`` with the priority-tiered production mix
+        the preempt acceptance e2e needs (ISSUE 11,
+        docs/preempt_reclaim.md): ``workers`` nodes each fully occupied
+        by a Running low-priority batch pod (one single-member PodGroup
+        per node, so per-group disruption budgets bite), plus a Pending
+        high-priority serving gang of ``serving_tasks`` whole-node
+        tasks that cannot bind until batch capacity is preempted.
+        Driven with ``ClusterSimulator(store, grace_steps=N)`` the
+        evicted batch pods pass through Terminating, so the serving
+        gang exercises the real capacity-not-yet-free preemption
+        window before it binds.
+
+        Returns ``{"serving_group", "batch_groups", "nodes"}`` name
+        lists for assertions."""
+        from .api import (
+            GROUP_NAME_ANNOTATION,
+            Node,
+            Pod,
+            PodGroup,
+            PodGroupPhase,
+            PriorityClass,
+        )
+
+        store.add_priority_class(
+            PriorityClass(name="tier-serving", value=serving_priority))
+        store.add_priority_class(
+            PriorityClass(name="tier-batch", value=batch_priority))
+        nodes = []
+        for i in range(workers):
+            name = f"tier-n{i}"
+            store.add_node(Node(name=name, allocatable={
+                "cpu": node_cpu, "memory": "16Gi", "pods": 110}))
+            nodes.append(name)
+        batch_groups = []
+        for i in range(workers):
+            gname = f"batch{i}"
+            store.add_pod_group(PodGroup(
+                name=gname, namespace=namespace, min_member=1,
+                priority_class="tier-batch"))
+            store.pod_groups[
+                f"{namespace}/{gname}"
+            ].status.phase = PodGroupPhase.Running.value
+            store.add_pod(Pod(
+                name=f"batch-{i}", namespace=namespace,
+                annotations={GROUP_NAME_ANNOTATION: gname},
+                containers=[{"cpu": batch_cpu, "memory": "1Gi"}],
+                phase=PodPhase.Running, node_name=f"tier-n{i}",
+                priority=batch_priority,
+            ))
+            batch_groups.append(f"{namespace}/{gname}")
+        store.add_pod_group(PodGroup(
+            name="serving", namespace=namespace,
+            min_member=serving_tasks, priority_class="tier-serving"))
+        for i in range(serving_tasks):
+            store.add_pod(Pod(
+                name=f"serving-{i}", namespace=namespace,
+                annotations={GROUP_NAME_ANNOTATION: "serving"},
+                containers=[{"cpu": serving_cpu, "memory": "1Gi"}],
+                priority=serving_priority,
+            ))
+        return {
+            "serving_group": f"{namespace}/serving",
+            "batch_groups": batch_groups,
+            "nodes": nodes,
+        }
+
     def fail_pod(self, uid: str, exit_code: int = 1) -> None:
         """Inject a pod failure (fault injection; the reference's e2e kills
         pods to trigger policies, job_error_handling.go:145-276)."""
