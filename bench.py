@@ -64,6 +64,18 @@ emitting its JSON tail with the usual lane split, plus one extra
 sharded plane).  Host-device simulation quantifies the decomposition;
 the real win is the per-chip memory/compute split on a TPU slice.
 
+BENCH_COMPOSED=1 (ISSUE 12) runs the authoritative north-star
+composition: one "(plain)" synchronous pass (the BENCH_r05-comparable
+row) followed by one "(composed)" pipelined steady state with the mesh
+(BENCH_COMPOSED_MESH devices, virtual-CPU-forced unless
+BENCH_COMPOSED_VIRTUAL=0), VOLCANO_TPU_DEVINCR, VOLCANO_TPU_INCREMENTAL
+and a BENCH_COMPOSED_FRAC (default 5%) churn feed all engaged together,
+ending with the null-delta probe.  The "composed" JSON tail carries the
+engagement proof (mesh shards, devincr warm/full/skip, incremental
+derive modes, plain-vs-composed ratio, knob matrix); every tail now
+also reports compile/warmup separately from steady state (compile_ms +
+warmup_cycles_ms).
+
 BENCH_WIRE=1 (ISSUE 10) A/Bs the remote-solver transport in one run:
 an in-process ``SolverServer`` thread serves solves over the REAL
 loopback TCP stack (the solve shares this process's jit cache, so the
@@ -156,7 +168,8 @@ def _attach_remote(store):
 
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
           records=None, fallbacks=None, rebalance=None, devincr=None,
-          wire=None, preempt=None):
+          wire=None, preempt=None, compile_ms=None, warmup_cycles=None,
+          composed=None):
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
@@ -168,6 +181,21 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
             budget_ms / value_ms if value_ms > 0 else 0.0, 4
         ),
     }
+    if compile_ms is not None:
+        # Compile/warmup time reported SEPARATELY from steady-state
+        # (ISSUE 12 satellite: the r05 tail carried a 17.4 s cycle-2
+        # jit spike inside cycles_ms, polluting the distribution —
+        # steady-state numbers now NEVER include warmup cycles, and
+        # this field is where the jit cost lives).
+        payload["compile_ms"] = round(compile_ms, 1)
+    if warmup_cycles is not None:
+        payload["warmup_cycles_ms"] = [
+            round(t * 1e3, 1) for t in warmup_cycles
+        ]
+    if composed:
+        # BENCH_COMPOSED tail (ISSUE 12): the authoritative north-star
+        # composition — which lanes engaged and what each mode counted.
+        payload["composed"] = dict(composed)
     if rebalance:
         # BENCH_REBALANCE tail: frag-score before/after + plan stats
         # (docs/rebalance.md).
@@ -356,9 +384,18 @@ def _pipelined_bench(make_store, conf, cycles=None):
 
     store.cycle_feed = feed
     sched = Scheduler(store, conf_str=conf)
-    t0 = time.perf_counter()
-    sched.run_once()  # warm-up: compile + first dispatch (no commit yet)
-    sched.run_once()  # pipeline fill: first commit lands
+    # Warm-up cycles are timed INDIVIDUALLY so compile/jit spikes are
+    # reported per cycle in the warmup_cycles_ms tail, never inside
+    # the steady-state cycles_ms (ISSUE 12 satellite).
+    warm_cycles = []
+
+    def _warm_once():
+        t0 = time.perf_counter()
+        sched.run_once()
+        warm_cycles.append(time.perf_counter() - t0)
+
+    _warm_once()  # warm-up: compile + first dispatch (no commit yet)
+    _warm_once()  # pipeline fill: first commit lands
     if _DEVINCR_PROBE or client is not None:
         # Device-incremental / wire A/B: the warm-shortlist kernel
         # compiles on its FIRST warm-eligible cycle (the pending set
@@ -367,8 +404,8 @@ def _pipelined_bench(make_store, conf, cycles=None):
         # state, in every mode (the extra cycles are mode-symmetric —
         # without this the A/B's first mode eats the compile alone).
         for _ in range(3):
-            sched.run_once()
-    warm_s = time.perf_counter() - t0
+            _warm_once()
+    warm_s = sum(warm_cycles)
     # Steady-state seam reset: the re-pend feed keeps the backlog
     # constant, but the two warm-up cycles already accumulated
     # two-phase shortlist-fallback counts (cold jit, first fill) —
@@ -464,14 +501,14 @@ def _pipelined_bench(make_store, conf, cycles=None):
     if client is not None:
         client.close()
     return (amortized_ms, bound_per_cycle, warm_s, times, lanes, records,
-            fallbacks, devincr, wire)
+            fallbacks, devincr, wire, warm_cycles)
 
 
 def _emit_pipelined(label, mk, conf, n_pods):
     if os.environ.get("BENCH_PIPELINE", "1") == "0":
         return
     (amortized_ms, bound, warm_s, times, lanes, records,
-     fallbacks, devincr, wire) = _pipelined_bench(mk, conf)
+     fallbacks, devincr, wire, warm_cycles) = _pipelined_bench(mk, conf)
     _emit(
         f"{label} (pipelined steady-state, amortized {len(times)} cycles)",
         amortized_ms, n_pods,
@@ -484,6 +521,8 @@ def _emit_pipelined(label, mk, conf, n_pods):
         fallbacks=fallbacks,
         devincr=devincr,
         wire=wire,
+        compile_ms=warm_s * 1e3,
+        warmup_cycles=warm_cycles,
     )
 
 
@@ -600,6 +639,7 @@ def config_2(n_nodes, n_pods, gang, repeats):
         + _lane_note(lanes),
         lanes=lanes,
         records=recs,
+        compile_ms=warm_s * 1e3,
     )
     _emit_pipelined(
         f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending pods "
@@ -629,6 +669,7 @@ def config_3(repeats):
         + _lane_note(lanes),
         lanes=lanes,
         records=recs,
+        compile_ms=warm_s * 1e3,
     )
     _emit_pipelined(
         f"DRF multi-queue e2e @ {n_nodes} nodes x {n_pods} pods, 4 queues",
@@ -658,6 +699,7 @@ def config_4(repeats):
         + _lane_note(lanes),
         lanes=lanes,
         records=recs,
+        compile_ms=warm_s * 1e3,
     )
 
 
@@ -683,6 +725,7 @@ def config_5(repeats):
         + _lane_note(lanes),
         lanes=lanes,
         records=recs,
+        compile_ms=warm_s * 1e3,
     )
     _emit_pipelined(
         f"hyperscale binpack+affinity e2e @ {n_nodes} nodes x "
@@ -712,6 +755,7 @@ def config_north(repeats):
         + _lane_note(lanes),
         lanes=lanes,
         records=recs,
+        compile_ms=warm_s * 1e3,
     )
     _emit_pipelined(
         f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} pending "
@@ -939,6 +983,148 @@ tiers:
     store.close()
 
 
+def config_composed():
+    """BENCH_COMPOSED=1 (ISSUE 12): the authoritative north-star run.
+
+    Every fast lane built since PR 6 — mesh-sharded solve, persistent
+    device incrementality (``VOLCANO_TPU_DEVINCR``), incremental host
+    lanes (``VOLCANO_TPU_INCREMENTAL``), pipelined double-buffered
+    sessions, and a steady sparse churn feed — engaged TOGETHER in one
+    configuration at the north-star shape, instead of each A/B'd in
+    isolation.  Two passes:
+
+    - "(plain)": the synchronous single-device cycle, directly
+      comparable to the BENCH_r05 272 ms row;
+    - "(composed)": pipelined steady state with the mesh, both
+      incrementality lanes, and a ``BENCH_COMPOSED_FRAC`` (default 5%)
+      churn feed, ending with the null-delta probe.
+
+    The composed JSON tail carries the engagement proof the e2e smoke
+    asserts: mesh shard count, devincr warm/full/skip counts,
+    host-incremental derive modes (delta counted from the metrics
+    registry), the plain-vs-composed ratio, and the knob matrix.
+
+    ``BENCH_COMPOSED_MESH`` (default 4) sizes the mesh;
+    ``BENCH_COMPOSED_VIRTUAL=0`` skips the virtual-CPU platform force
+    for real multi-chip hosts (the default forces it, like BENCH_MESH —
+    it must happen before anything touches jax)."""
+    global _MODE_SUFFIX, _MESH, _FEED_FRACTION, _DEVINCR_PROBE
+
+    try:
+        n_dev = max(0, int(os.environ.get("BENCH_COMPOSED_MESH", "4")))
+    except ValueError:
+        n_dev = 4
+    mesh = None
+    if n_dev >= 2:
+        if os.environ.get("BENCH_COMPOSED_VIRTUAL", "1") != "0":
+            from volcano_tpu.virtualcpu import force_virtual_cpu_platform
+
+            force_virtual_cpu_platform(n_dev)
+            from volcano_tpu.parallel import make_mesh
+
+            mesh = make_mesh(n_dev, platform="cpu")
+        else:
+            from volcano_tpu.parallel import make_mesh
+
+            try:
+                mesh = make_mesh(n_dev)
+            except RuntimeError as err:
+                print(f"# composed: no mesh ({err}); single device",
+                      file=sys.stderr)
+    # Pin the composed knob matrix explicitly (docs/tuning.md "Composed
+    # profile"): every lane ON — the point is the interaction, not the
+    # A/B.
+    os.environ["VOLCANO_TPU_TWOPHASE"] = "1"
+    os.environ["VOLCANO_TPU_INCREMENTAL"] = "1"
+    os.environ["VOLCANO_TPU_DEVINCR"] = "1"
+    try:
+        frac = float(os.environ.get("BENCH_COMPOSED_FRAC", "0.05"))
+    except ValueError:
+        frac = 0.05
+    n_nodes = int(os.environ.get("BENCH_NODES", 10000))
+    n_pods = int(os.environ.get("BENCH_PODS", 100000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    from volcano_tpu.synth import synthetic_cluster
+
+    mk = lambda r: synthetic_cluster(
+        n_nodes=n_nodes, n_pods=n_pods, gang_size=8, zones=16, seed=r,
+    )
+    label = (f"OpenSession->Bind e2e @ {n_nodes} nodes x {n_pods} "
+             f"pending pods (north star")
+
+    # ---- pass 1: plain — the r05-comparable synchronous cycle.
+    _MESH = None
+    _MODE_SUFFIX = ""
+    plain_ms, bound, _, warm_s, times, lanes, recs = _cycle_bench(
+        mk, CONF_BASE, repeats)
+    _emit(
+        label + ", plain)", plain_ms, n_pods,
+        f"warmup={warm_s:.2f}s bound={bound} "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
+        lanes=lanes, records=recs, compile_ms=warm_s * 1e3,
+    )
+
+    # ---- pass 2: composed — everything on, one pipelined steady state.
+    from volcano_tpu.metrics import metrics as _metrics
+
+    def _derive_modes():
+        return {
+            dict(k).get("mode", "?"): int(v)
+            for k, v in _metrics.host_incremental_derives.data.items()
+        }
+
+    derives0 = _derive_modes()
+    _MESH = mesh
+    _FEED_FRACTION = min(max(frac, 0.0), 1.0)
+    _DEVINCR_PROBE = True
+    try:
+        (amortized_ms, bound_pc, warm_s, times, lanes, records,
+         fallbacks, devincr, wire, warm_cycles) = _pipelined_bench(
+            mk, CONF_BASE)
+    finally:
+        _MESH = None
+        _FEED_FRACTION = 1.0
+        _DEVINCR_PROBE = False
+    derives1 = _derive_modes()
+    comp = {
+        "mesh_shards": int(mesh.devices.size) if mesh is not None else 1,
+        "feed_fraction": _round_frac(frac),
+        "plain_ms": round(plain_ms, 2),
+        "pipelined_ms": round(amortized_ms, 2),
+        "speedup_vs_plain": round(plain_ms / amortized_ms, 2)
+        if amortized_ms > 0 else 0.0,
+        "incremental_derives": {
+            m: derives1.get(m, 0) - derives0.get(m, 0)
+            for m in set(derives0) | set(derives1)
+        },
+        "knobs": {
+            "VOLCANO_TPU_MESH": (int(mesh.devices.size)
+                                 if mesh is not None else 0),
+            "VOLCANO_TPU_TWOPHASE": 1,
+            "VOLCANO_TPU_INCREMENTAL": 1,
+            "VOLCANO_TPU_DEVINCR": 1,
+            "pipeline": 1,
+            "wire": "remote" if _REMOTE_PORT is not None else "local",
+        },
+    }
+    _emit(
+        label + f", composed, {len(times)} steady cycles)",
+        amortized_ms, n_pods,
+        f"warmup={warm_s:.2f}s bound_per_cycle={bound_pc} "
+        f"plain={plain_ms:.1f}ms composed={amortized_ms:.1f}ms "
+        f"cycles_ms={[round(t * 1e3, 1) for t in times]}"
+        + _lane_note(lanes),
+        lanes=lanes, records=records, fallbacks=fallbacks,
+        devincr=devincr, wire=wire, compile_ms=warm_s * 1e3,
+        warmup_cycles=warm_cycles, composed=comp,
+    )
+
+
+def _round_frac(f):
+    return round(min(max(f, 0.0), 1.0), 4)
+
+
 def _emit_mesh_microbench(mesh):
     """One JSON line quantifying the cross-chip reduce of the sharded
     selection: the two-stage shard-local top-k (winner reduction over
@@ -1030,6 +1216,12 @@ def main():
         # Device-native priority-tier preemption lane (ISSUE 11): its
         # own fragmented-priority scenario, not a mode of the configs.
         config_preempt()
+        return
+    if os.environ.get("BENCH_COMPOSED"):
+        # The authoritative north-star composition (ISSUE 12): mesh +
+        # device incrementality + incremental host lanes + pipelining
+        # + steady churn, engaged together in one run.
+        config_composed()
         return
     mesh_raw = os.environ.get("BENCH_MESH")
     if mesh_raw:

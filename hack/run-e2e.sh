@@ -98,8 +98,74 @@ assert t["evictions"] >= 1, t
 assert t["gang_bound"] >= t["gang"], f"serving gang did not bind: {t}"
 assert t["lost_pods"] == 0, f"pods lost: {t}"
 assert t["restored"] == t["evictions"], t
-print(f"BENCH_PREEMPT smoke OK ({t[\"evictions\"]} evictions, "
-      f"{t[\"converged_cycles\"]} cycles to bind)")
+# (%-formatting: a backslash inside an f-string expression is a
+# SyntaxError before Python 3.12.)
+print("BENCH_PREEMPT smoke OK (%s evictions, %s cycles to bind)"
+      % (t["evictions"], t["converged_cycles"]))
+'
+# BENCH_COMPOSED smoke (ISSUE 12): every fast lane engaged TOGETHER —
+# virtual 4-device mesh + devincr + incremental host lanes + pipelining
+# + 5% churn — in one run.  Asserts the composed tail proves engagement
+# of every lane (mesh shards > 1, devincr warm counted, null-delta
+# skips with ZERO dispatches, incremental derives in delta mode) and
+# that the composed pipelined cycle beats the plain pass.
+BENCH_COMPOSED=1 BENCH_COMPOSED_MESH=4 BENCH_NODES=256 BENCH_PODS=2048 \
+  BENCH_REPEATS=1 BENCH_PIPE_CYCLES=5 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+comp = [r for r in rows if "composed" in r]
+assert comp, "no composed tail emitted"
+r = comp[0]
+c = r["composed"]
+assert c["mesh_shards"] > 1, c
+assert c["pipelined_ms"] < c["plain_ms"], c
+assert c["incremental_derives"].get("delta", 0) >= 1, c
+dv = r["devincr"]
+assert dv["warm"] >= 1, dv
+assert dv["null_delta_dispatches"] == 0, dv
+assert dv["null_delta_skips"] >= 1, dv
+assert "compile_ms" in r and "warmup_cycles_ms" in r, sorted(r)
+print("BENCH_COMPOSED smoke OK (%sms plain -> %sms composed, "
+      "%s shards)" % (c["plain_ms"], c["pipelined_ms"],
+                      c["mesh_shards"]))
+'
+# Composed bind parity (ISSUE 12): the everything-on configuration
+# (mesh + devincr + incremental + pipelining) must land bit-for-bit
+# the same binds as the everything-off configuration once both reach
+# quiescence on the same seeded backlog.
+JAX_PLATFORMS=cpu python -c '
+from volcano_tpu.virtualcpu import force_virtual_cpu_platform
+force_virtual_cpu_platform(4)
+import os
+from volcano_tpu.parallel import make_mesh
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+def run(on):
+    os.environ.update({
+        "VOLCANO_TPU_DEVINCR": "1" if on else "0",
+        "VOLCANO_TPU_INCREMENTAL": "1" if on else "0",
+        "VOLCANO_TPU_TWOPHASE": "1" if on else "0",
+    })
+    store = synthetic_cluster(n_nodes=256, n_pods=2048, gang_size=4,
+                              zones=4, seed=9)
+    if on:
+        store.pipeline = True
+        store.solve_mesh = make_mesh(4, platform="cpu")
+    sched = Scheduler(store)
+    for _ in range(4 if on else 2):
+        sched.run_once()
+    store.flush_binds()
+    binds = {p.name: p.node_name for p in store.pods.values()}
+    assert all(binds.values()), "backlog did not fully bind"
+    store.close()
+    return binds
+
+on = run(True)
+off = run(False)
+assert on == off, "composed binds differ from the everything-off run"
+print(f"composed bind parity OK ({len(on)} pods bit-for-bit)")
 '
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \

@@ -1,0 +1,135 @@
+"""Index-dtype overflow audit for the 100k-node x 1M-pod tier.
+
+Synthetic small-array / large-offset harness: no 1M-row allocation in
+tier-1.  Three index families are pinned exact at >= 2^31 logical
+ranges:
+
+- the solver's flattened (term x domain) scatter keys — past the int32
+  key space the kernel must take the 2-D (term, domain) address form
+  (``VOLCANO_TPU_KEYSPACE_MAX`` forces it at toy shapes; binds must be
+  bit-identical either way);
+- wire range descriptors (protocol v2 deltas): int64 end-to-end, with
+  the validator's bounds arithmetic exact at multi-GB logical frames
+  and hostile INT64_MAX-adjacent bounds still rejected — in BOTH the
+  csrc and the numpy implementations;
+- host-side flattened bincount indices (the incremental aggregates):
+  the (row * width + col) products are computed in int64 BEFORE the
+  multiply, so they stay exact past 2^31.
+"""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.cache import snapwire
+
+
+def test_keyspace_gate_default_and_override(monkeypatch):
+    from volcano_tpu.ops import wave
+
+    assert wave._keyspace_max() == 2**31 - 2
+    monkeypatch.setenv("VOLCANO_TPU_KEYSPACE_MAX", "12345")
+    assert wave._keyspace_max() == 12345
+    monkeypatch.setenv("VOLCANO_TPU_KEYSPACE_MAX", "junk")
+    assert wave._keyspace_max() == 2**31 - 2
+
+
+def test_forced_2d_keyspace_binds_bit_identical(monkeypatch):
+    """The 2-D (term, domain) scatter form — what the kernel takes when
+    EW * D crosses 2^31 — produces bit-identical solves at a toy shape
+    where both forms compile."""
+    import jax
+
+    from volcano_tpu.ops.wave import solve_wave
+    from volcano_tpu.synth import solve_args_from_store, synthetic_cluster
+
+    def run():
+        store = synthetic_cluster(
+            n_nodes=64, n_pods=256, gang_size=4, zones=4,
+            affinity_fraction=0.3, anti_affinity_fraction=0.3,
+            spread_fraction=0.2, seed=3)
+        args, _ = solve_args_from_store(store)
+        res = solve_wave(*args, wave=64)
+        return jax.device_get((res.assigned, res.pipelined,
+                               res.never_ready, res.fit_failed))
+
+    monkeypatch.delenv("VOLCANO_TPU_KEYSPACE_MAX", raising=False)
+    flat = run()
+    monkeypatch.setenv("VOLCANO_TPU_KEYSPACE_MAX", "1")  # force 2-D
+    two_d = run()
+    for f, t in zip(flat, two_d):
+        assert np.array_equal(np.asarray(f), np.asarray(t))
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_delta_check_exact_past_2e31(monkeypatch, native):
+    """Wire range-descriptor validation at >= 2^31 logical byte offsets:
+    totals exact, in-bounds accepted, off-by-one and INT64_MAX-adjacent
+    bounds rejected — no array anywhere near that size is allocated
+    (the validator only does arithmetic on trusted dims)."""
+    if not native:
+        monkeypatch.setattr(snapwire, "lib_or_none", lambda: None)
+    elif snapwire.lib_or_none() is None:
+        pytest.skip("native vcsnap library unavailable")
+    rows = 1 << 28  # 268M logical rows x 64 B/row = 16 GiB logical
+    row_bytes = 64
+    lo, hi = (1 << 27) - 3, (1 << 28) - 1  # offsets cross 2^31 bytes
+    desc = np.asarray([2, 5, 9, lo, hi], np.int64)
+    total = 4 + (hi - lo)
+    got = snapwire.delta_check(desc, rows, row_bytes,
+                               total * row_bytes, 7, 7)
+    assert got == total
+    # One row past the table: rejected.
+    bad = np.asarray([1, rows - 1, rows + 1], np.int64)
+    assert snapwire.delta_check(bad, rows, row_bytes,
+                                2 * row_bytes, 7, 7) == -1
+    # INT64_MAX-adjacent hostile bounds: rejected, no wrap to "valid".
+    big = np.iinfo(np.int64).max
+    hostile = np.asarray([1, big - 1, big], np.int64)
+    assert snapwire.delta_check(hostile, rows, row_bytes,
+                                row_bytes, 7, 7) == -1
+    # Payload-length cross-check stays exact at the big total.
+    assert snapwire.delta_check(desc, rows, row_bytes,
+                                total * row_bytes - 1, 7, 7) == -1
+
+
+def test_diff_rows_descriptor_dtype_and_roundtrip():
+    """diff_rows -> ranges_to_desc emits int64 descriptors whose
+    values survive a gather/apply roundtrip bitwise (including -0.0 /
+    NaN payload bits)."""
+    old = np.zeros((32, 4), np.float32)
+    new = old.copy()
+    new[3, 0] = -0.0
+    new[3, 1] = np.nan
+    new[30] = 7.0
+    ranges = snapwire.diff_rows(new, old)
+    desc = snapwire.ranges_to_desc(ranges)
+    assert desc.dtype == np.int64
+    payload = snapwire.gather_rows(new, ranges)
+    dst = old.copy()
+    snapwire.delta_apply(dst, desc, payload, 1, 1)
+    assert np.array_equal(dst.view(np.uint8), new.view(np.uint8))
+
+
+def test_incremental_flat_bincount_indices_are_int64():
+    """The incremental aggregates compute flattened (row, col) bincount
+    indices as int64 BEFORE the multiply; a 32-bit product at the same
+    magnitudes would wrap negative.  Synthetic large-offset check of
+    the exact arithmetic shape the module uses (see
+    fastpath_incr._build_aggregates req_scatter)."""
+    R = 64
+    jb = np.asarray([(1 << 26) + 3], np.int32)  # job row near 2^26
+    si = np.asarray([R - 1], np.int64)
+    idx = jb.astype(np.int64) * R + si  # the module's index form
+    assert idx.dtype == np.int64
+    assert int(idx[0]) == ((1 << 26) + 3) * R + R - 1 > 2**31
+    # The int32 form WOULD wrap — the property the audit pins.
+    with np.errstate(over="ignore"):
+        wrapped = (jb * np.int32(R) + si.astype(np.int32))[0]
+    assert int(wrapped) != int(idx[0])
+    # And the committed code actually takes the int64 form.
+    import inspect
+
+    from volcano_tpu import fastpath_incr
+
+    src = inspect.getsource(fastpath_incr)
+    assert ".astype(np.int64) * R" in src

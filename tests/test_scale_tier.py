@@ -1,0 +1,124 @@
+"""The 100k-node x 1M-pod scale tier (ISSUE 12).
+
+Tier-1 runs a scaled-down proxy of the tier generator plus the
+devsnap chunk-budget machinery at toy shapes; the full shape is
+``@pytest.mark.slow`` (CI-class tier-1 hosts budget ~15 minutes for
+the whole suite — the 1M-pod build alone is minutes).
+"""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.ops.devsnap import DeviceSnapshot
+from volcano_tpu.synth import tier_cluster
+
+
+class _FakeMirror:
+    """Just enough mirror for DeviceSnapshot.node_planes."""
+
+    def __init__(self):
+        self.rows = None
+
+    def node_delta_rows(self, epoch):
+        return self.rows
+
+    def reset_node_delta(self):
+        self.rows = None
+
+
+def test_devsnap_chunked_delta_scatter(monkeypatch):
+    """A delta past the staging budget scatters in bounded chunks and
+    lands bit-identical to the unchunked result; the resident-bytes
+    model matches the committed planes."""
+    monkeypatch.setenv("VOLCANO_TPU_DEVSNAP_BUDGET_MB", "0.000001")
+    snap = DeviceSnapshot()
+    N, R = 64, 1024  # 4 KB f32 rows against the 4 KB budget floor
+    base = np.arange(N * R, dtype=np.float32).reshape(N, R)
+    m = _FakeMirror()
+    build = {"p": lambda rows, b=base: b if rows is None else b[rows]}
+    planes = snap.node_planes(m, (0, N), build)
+    assert snap.full_uploads == 1 and snap.delta_chunks == 0
+    base[5:13] += 1000.0
+    m.rows = np.arange(5, 13)
+    planes = snap.node_planes(m, (1, N), build)
+    assert snap.delta_uploads == 1
+    assert snap.delta_chunks >= 7  # 8 rows / 1-row chunks
+    assert np.array_equal(np.asarray(planes["p"]), base)
+    assert snap.resident_bytes() == base.nbytes
+
+
+def test_devsnap_default_budget_single_scatter():
+    """Under the default budget a small delta stays one scatter (the
+    chunking must not tax the steady-state path)."""
+    snap = DeviceSnapshot()
+    N, R = 64, 8
+    base = np.zeros((N, R), np.float32)
+    m = _FakeMirror()
+    build = {"p": lambda rows, b=base: b if rows is None else b[rows]}
+    snap.node_planes(m, (0, N), build)
+    base[4] = 9.0
+    m.rows = np.asarray([4])
+    snap.node_planes(m, (1, N), build)
+    assert snap.delta_uploads == 1 and snap.delta_chunks == 0
+
+
+def test_tier_generator_memory_frugal_sharing():
+    """The chunked pod-table fill shares sub-objects: one annotations
+    dict per gang, one containers list per pod shape — the per-pod
+    Python-object overhead the 1M build cannot afford."""
+    store = tier_cluster(n_nodes=32, n_pods=256, gang_size=8, zones=4,
+                         chunk_pods=64)
+    pods = sorted(store.pods.values(), key=lambda p: p.name)
+    assert len(pods) == 256
+    by_gang = {}
+    for p in pods:
+        by_gang.setdefault(p.job_id(), []).append(p)
+    assert len(by_gang) == 32
+    for members in by_gang.values():
+        first = members[0]
+        for p in members[1:]:
+            assert p.annotations is first.annotations
+            assert p.containers is first.containers
+    # Containers lists dedupe ACROSS gangs too (one per shape).
+    distinct = {id(p.containers) for p in pods}
+    assert len(distinct) <= 9  # |cpu choices| x |mem choices|
+    store.close()
+
+
+def test_tier_proxy_cycle_binds():
+    """Scaled-down tier proxy: one full cycle completes, gangs bind,
+    and the devsnap footprint stays within the modeled envelope."""
+    from volcano_tpu.scheduler import Scheduler
+
+    store = tier_cluster(n_nodes=256, n_pods=2048, gang_size=8,
+                         zones=8, chunk_pods=1024)
+    Scheduler(store).run_once()
+    bound = sum(1 for p in store.pods.values() if p.node_name)
+    assert bound == 2048  # 256 x 64cpu swallows 2048 small pods
+    snap = getattr(store, "device_snapshot", None)
+    if snap is not None:
+        # Node planes at the proxy shape: well under a few MB; the
+        # model (sum of committed plane nbytes) must agree with what
+        # the cycle actually left resident.
+        assert 0 < snap.resident_bytes() < 32 * 1024 * 1024
+    store.close()
+
+
+@pytest.mark.slow
+def test_tier_100k_x_1m_full_cycle_under_budget():
+    """The full 100k x 1M shape: chunked build completes on a CI-class
+    host, one cycle binds a nonzero wave, and peak RSS stays under the
+    modeled envelope (the generator's shared sub-objects + the chunked
+    encode/scatter paths are what make this fit)."""
+    import resource
+
+    from volcano_tpu.scheduler import Scheduler
+
+    store = tier_cluster()  # 100_000 x 1_000_000
+    assert len(store.pods) == 1_000_000
+    Scheduler(store).run_once()
+    bound = sum(1 for p in store.pods.values() if p.node_name)
+    assert bound > 0
+    peak_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    assert peak_gb < 64, f"peak RSS {peak_gb:.1f} GB exceeds the budget"
+    store.close()

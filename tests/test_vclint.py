@@ -178,6 +178,63 @@ def test_hotpath_checker_catches_seeded_violations():
     assert 24 not in lines
 
 
+BUDGET_FIXTURE = textwrap.dedent('''\
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+
+    @partial(jax.jit, static_argnames=("k",))
+    def unbudgeted(nodes, prof, k):
+        N = nodes.idle.shape[0]
+        U = int(prof.req.shape[0])
+        tmp = jnp.zeros((N, 64), jnp.float32)
+        tmp2 = jnp.ones((U, N), bool)
+        small = jnp.zeros((k, 4), jnp.float32)
+        return tmp, tmp2, small
+
+
+    @partial(jax.jit, static_argnames=())
+    def registered_ok(nodes):
+        N = nodes.idle.shape[0]
+        return jnp.zeros((N, 8), jnp.float32)
+''')
+
+
+def test_chunk_budget_checker_catches_full_n_temporaries(monkeypatch):
+    # Route the fixture through a budget-checked path name, with
+    # `registered_ok` registered (its budget reviewed) and
+    # `unbudgeted` not.
+    rel = "volcano_tpu/ops/wave.py"
+    monkeypatch.setitem(
+        hotpath.CHUNK_BUDGET_REGISTRY, rel,
+        set(hotpath.CHUNK_BUDGET_REGISTRY[rel]) | {"registered_ok"},
+    )
+    raw = hotpath.analyze_file(rel, BUDGET_FIXTURE, [])
+    findings = finish(rel, BUDGET_FIXTURE, raw)
+    got = _codes(findings)
+    # The full-N and full-U temporaries of the unregistered jit.
+    assert ("VCL204", 11) in got
+    assert ("VCL204", 12) in got
+    # Static-sized arrays and registered fns stay clean.
+    vcl204_lines = {l for c, l in got if c == "VCL204"}
+    assert 13 not in vcl204_lines  # (k, 4) is not shape[0]-derived
+    assert 21 not in vcl204_lines  # registered_ok is registered
+
+
+def test_chunk_budget_registry_matches_tree():
+    # Registered fns must exist and be jitted in their files — a
+    # renamed kernel must update the registry.
+    for rel, names in hotpath.CHUNK_BUDGET_REGISTRY.items():
+        src = (REPO_ROOT / rel).read_text()
+        import ast as _ast
+
+        jits = hotpath.collect_jits(_ast.parse(src))
+        for name in names:
+            assert name in jits, (rel, name)
+
+
 def test_hotpath_registry_matches_tree():
     # Every registry entry must resolve to a real function — a renamed
     # lane must update the registry, not silently drop out of analysis.

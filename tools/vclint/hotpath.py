@@ -49,6 +49,9 @@ DEVICE_FNS = {
     # planes come back device-resident; jax.device_get is the one
     # sanctioned fetch before the host-side greedy runs.
     "victim_scores",
+    # Hierarchical block->shard->global selection (ISSUE 12): the
+    # merge helper returns device id planes.
+    "_merge_block_cands",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -191,6 +194,117 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         HotEntry("InflightPlan.fetch"),
     ],
 }
+
+
+# ---- VCL204: chunk-budget routing of full-N device temporaries ------
+# A jitted function in these files that materializes a fresh device
+# array whose LEADING dimension is a parameter's ``.shape[0]`` (a
+# full-N node plane / full-P pod plane temporary) must appear in
+# ``CHUNK_BUDGET_REGISTRY`` — registration records that its peak
+# footprint is bounded by a reviewed chunk/budget mechanism (the
+# lax.map profile streams and DOM_MM_MAX_MB size gate in ops/wave.py,
+# the devsnap delta-scatter budget, pow2-padded fixed planes in the
+# victim/rebalance kernels).  A NEW device fn declaring [N, *] planes
+# trips VCL204 until it routes through the chunk-budget machinery and
+# is registered here — the scale-tier guard: at 100k nodes x 1M pods
+# an unbudgeted full-N temporary is the difference between fitting a
+# chip and OOMing it.
+BUDGET_FILES = {
+    "volcano_tpu/ops/wave.py",
+    "volcano_tpu/ops/devsnap.py",
+    "volcano_tpu/ops/devincr.py",
+    "volcano_tpu/ops/victim.py",
+    "volcano_tpu/ops/rebalance.py",
+}
+CHUNK_BUDGET_REGISTRY: Dict[str, Set[str]] = {
+    "volcano_tpu/ops/wave.py": {
+        # Profile axes stream through lax.map in COARSE_CHUNK rows;
+        # the [N, D] domain one-hot sits behind the DOM_MM_MAX_MB
+        # size gate; conflict buffers behind the keyspace gate.
+        "_solve_wave", "_coarse_shortlist", "_warm_shortlist",
+        "_static_planes",
+    },
+    "volcano_tpu/ops/victim.py": {
+        # Planes are pow2-padded to the _solve_inputs buckets — fixed
+        # [N]-bounded state, no [N, N]-class temporaries.
+        "victim_scores",
+    },
+    "volcano_tpu/ops/rebalance.py": {
+        "frag_scores",
+    },
+}
+
+_ARRAY_CREATE_FNS = {"zeros", "ones", "full", "empty"}
+
+
+def _shape0_param_root(node: ast.AST, params: Set[str]):
+    """The parameter name when ``node`` is ``<param>[.attrs...].shape[0]``
+    (optionally wrapped in ``int(...)``), else None."""
+    if isinstance(node, ast.Call) and _leaf_name(node.func) == "int" \
+            and len(node.args) == 1:
+        node = node.args[0]
+    if not isinstance(node, ast.Subscript):
+        return None
+    sl = node.slice
+    if isinstance(sl, ast.Index):  # pragma: no cover - py<3.9 form
+        sl = sl.value
+    if not (isinstance(sl, ast.Constant) and sl.value == 0):
+        return None
+    base = node.value
+    if not (isinstance(base, ast.Attribute) and base.attr == "shape"):
+        return None
+    root = _dotted(base.value)
+    if root is None:
+        return None
+    head = root.split(".")[0]
+    return head if head in params else None
+
+
+def check_chunk_budget(path: str, tree: ast.Module,
+                       jits: Dict[str, JitInfo]) -> List[Finding]:
+    """VCL204: unchunked full-N temporaries in unregistered jitted fns
+    of the solve-lane files (see BUDGET_FILES)."""
+    findings: List[Finding] = []
+    if path not in BUDGET_FILES:
+        return findings
+    allowed = CHUNK_BUDGET_REGISTRY.get(path, set())
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = jits.get(fn.name)
+        if info is None or fn.name in allowed:
+            continue
+        params = set(info.params)
+        size_vars: Set[str] = set()
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                if _shape0_param_root(stmt.value, params) is not None:
+                    size_vars.add(stmt.targets[0].id)
+        if not size_vars:
+            continue
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            if _leaf_name(call.func) not in _ARRAY_CREATE_FNS \
+                    or not call.args:
+                continue
+            shape = call.args[0]
+            first = None
+            if isinstance(shape, (ast.Tuple, ast.List)) and shape.elts:
+                first = shape.elts[0]
+            elif isinstance(shape, ast.Name):
+                first = shape
+            if isinstance(first, ast.Name) and first.id in size_vars:
+                findings.append(Finding(
+                    "VCL204", path, call.lineno,
+                    f"jitted fn {fn.name} materializes a full-"
+                    f"{first.id} temporary outside the chunk-budget "
+                    "registry (route it through the chunk/budget "
+                    "machinery and register it in "
+                    "CHUNK_BUDGET_REGISTRY)",
+                ))
+    return findings
 
 
 @dataclass
@@ -538,6 +652,7 @@ def analyze_file(path: str, source: str,
                         f"file does not parse: {err.msg}")]
     jits = collect_jits(tree)
     findings.extend(check_jit_declarations(path, jits))
+    findings.extend(check_chunk_budget(path, tree, jits))
     for entry in entries:
         fn = _find_function(tree, entry.qualname)
         if fn is None:

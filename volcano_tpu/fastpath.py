@@ -1597,8 +1597,23 @@ class FastCycle:
     # solve; warm shortlists simply disable (full re-rank — today's
     # behavior) there.  8 MB ≈ 8 ms of blake2b worst case on the cycle
     # thread, a bounded fraction of the warm win; beyond it the hash
-    # itself would eat the saving.
+    # itself would eat the saving.  Env-overridable
+    # (VOLCANO_TPU_DEVINCR_CNT0_HASH_MAX, bytes): at the 100k-node
+    # tier the [E, D] pair outgrows 8 MB while the warm win ALSO grows
+    # with N, so deployments whose device lane dwarfs the hash cost
+    # raise the cap instead of silently losing warm shortlists at the
+    # exact scale they matter most.
     _DEVINCR_CNT0_HASH_MAX = 8_000_000
+
+    @staticmethod
+    def _devincr_cnt0_hash_max() -> int:
+        raw = os.environ.get("VOLCANO_TPU_DEVINCR_CNT0_HASH_MAX")
+        if raw:
+            try:
+                return max(0, int(raw))
+            except ValueError:
+                pass
+        return FastCycle._DEVINCR_CNT0_HASH_MAX
 
     def _devincr_prepare(self, inputs, mesh, remote: bool):
         """Assemble the device-incremental cache keys + dirty superset
@@ -1631,7 +1646,7 @@ class FastCycle:
         aff = inputs[7]
         cnt0 = np.asarray(aff.cnt0)
         warm_key = None
-        if cnt0.nbytes <= self._DEVINCR_CNT0_HASH_MAX:
+        if cnt0.nbytes <= self._devincr_cnt0_hash_max():
             if cnt0.any():
                 h = hashlib.blake2b(digest_size=16)
                 h.update(repr(cnt0.shape).encode())

@@ -74,6 +74,23 @@ def warm_blocks() -> int:
     return p
 
 
+def warm_block_rows() -> int:
+    """Upper bound on node rows per warm block (pow2).  At the 100k-node
+    tier the fixed default block count would leave 8k+ rows per block —
+    one dirty node then re-ranks 8k rows; bounding rows/block instead
+    keeps the warm re-rank cost proportional to churn, and the
+    block->shard->global merge (ops.wave._merge_block_cands) keeps the
+    extra blocks' reduce shard-local."""
+    try:
+        r = int(os.environ.get("VOLCANO_TPU_WARM_BLOCK_ROWS", 8192))
+    except ValueError:
+        r = 8192
+    p = 1
+    while p * 2 <= max(1, r):
+        p *= 2
+    return p
+
+
 # Past this fraction of blocks dirty, a full re-rank beats the gather +
 # scatter machinery (and seeds fresh candidates anyway).
 WARM_MAX_BLOCK_FRACTION = 0.5
@@ -280,6 +297,14 @@ class DeviceIncremental:
         U = int(prof.req.shape[0])
         n_sh = max(1, int(mesh_shards))
         B = max(warm_blocks(), n_sh)
+        # Scale-tier growth: bound rows per block so the per-dirty-node
+        # re-rank cost stays fixed as N grows (the merge stays cheap —
+        # block->shard->global, ops.wave._merge_block_cands).  Doubling
+        # from max(warm_blocks, n_sh) keeps B a multiple of the shard
+        # count, so blocks always subdivide shards.
+        max_rows = warm_block_rows()
+        while N % (B * 2) == 0 and N // B > max_rows:
+            B *= 2
         B = min(B, N)
         while N % B:  # N is pow2-padded in practice; belt and braces
             B //= 2
@@ -324,7 +349,7 @@ class DeviceIncremental:
                     sl_k=int(sl_k), klb=klb, nlb=nlb, chunk=chunk,
                     features=tuple(features), cnt0_any=bool(cnt0_any),
                     cls_identity=bool(cls_identity),
-                    static_ext=stat is not None,
+                    static_ext=stat is not None, mesh_shards=n_sh,
                 )
                 self._cand = (cand_s, cand_i, sl)
                 self.last_mode = "warm"

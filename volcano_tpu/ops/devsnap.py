@@ -32,6 +32,7 @@ device state).
 from __future__ import annotations
 
 import logging
+import os
 from functools import partial
 from typing import Callable, Dict, Optional, Tuple
 
@@ -42,6 +43,39 @@ log = logging.getLogger(__name__)
 
 # Above this fraction of rows dirty, a full re-upload beats the scatter.
 DELTA_MAX_FRACTION = 0.25
+
+
+def budget_bytes() -> int:
+    """Per-scatter host-staging budget for delta uploads
+    (``VOLCANO_TPU_DEVSNAP_BUDGET_MB``, default 256 MB).
+
+    The delta path materializes one host values array per plane before
+    the device scatter; at the 100k-node tier a churn burst can mark a
+    quarter of the table dirty, and building every plane's full delta
+    at once would spike the host (and transfer-staging) footprint by
+    the sum of the planes.  Chunking each plane's delta to this budget
+    bounds the peak at (largest single chunk) instead — the same
+    degrade-the-burst discipline as the affinity chunk budget
+    (fastpath._solve_chunks)."""
+    try:
+        mb = float(os.environ.get("VOLCANO_TPU_DEVSNAP_BUDGET_MB", 256))
+    except ValueError:
+        mb = 256.0
+    # Fractional MB are accepted so tests can force the chunked path at
+    # toy shapes; the 4 KB floor keeps a hostile/typo'd value from
+    # degenerating to row-at-a-time scatters.
+    return max(4096, int(mb * 1_000_000))
+
+
+def _chunk_rows_for(row_nbytes: int) -> int:
+    """Rows per delta-scatter chunk under the budget (pow2 so repeated
+    bursts reuse one compiled scatter per plane instead of one per
+    distinct chunk length)."""
+    rows = max(1, budget_bytes() // max(1, row_nbytes))
+    p = 1
+    while p * 2 <= rows:
+        p *= 2
+    return p
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -105,6 +139,9 @@ class DeviceSnapshot:
         self.hits = 0
         self.class_uploads = 0
         self.class_hits = 0
+        # Extra scatter passes taken because a delta exceeded the
+        # per-scatter staging budget (see budget_bytes).
+        self.delta_chunks = 0
 
     # ------------------------------------------------------------ placement
 
@@ -167,8 +204,11 @@ class DeviceSnapshot:
             return self._planes
         if delta_rows is not None:
             for name, fn in build.items():
-                dvals = fn(delta_rows)
-                if dvals is None:
+                # One-row probe sizes the plane's delta chunks (and
+                # detects the delta-unprovable answer) without
+                # materializing the full values array first.
+                probe = fn(delta_rows[:1])
+                if probe is None:
                     # Plane-level delta unprovable — a build fn returns
                     # None when its rows cannot be patched in place
                     # (class ids after the class SET changed: unrelated
@@ -179,11 +219,49 @@ class DeviceSnapshot:
                         np.asarray(fn(None))
                     )
                     continue
-                rows, vals = _pad_delta(delta_rows, np.asarray(dvals))
-                rows, vals = self._put_delta(rows, vals)
-                self._planes[name] = _scatter_rows(
-                    self._planes[name], rows, vals
-                )
+                # Chunked delta scatter (the scale-tier memory budget):
+                # each chunk's host values stay under budget_bytes(),
+                # so a churn burst at 100k nodes peaks at one chunk of
+                # staging memory per plane, not the whole delta.
+                row_nb = max(1, np.asarray(probe).nbytes)
+                chunk = _chunk_rows_for(row_nb)
+                if len(delta_rows) <= chunk:
+                    dvals = probe if len(delta_rows) == 1 \
+                        else fn(delta_rows)
+                    rows, vals = _pad_delta(delta_rows,
+                                            np.asarray(dvals))
+                    rows, vals = self._put_delta(rows, vals)
+                    self._planes[name] = _scatter_rows(
+                        self._planes[name], rows, vals
+                    )
+                    continue
+                # Multi-chunk: pad every chunk (incl. the last) to
+                # exactly ``chunk`` rows with idempotent duplicates —
+                # one compiled scatter per plane shape AND the staging
+                # footprint stays AT the budget (_pad_delta's +25%
+                # headroom bucket would double a full pow2 chunk past
+                # it).
+                n_chunks = 0
+                for lo in range(0, len(delta_rows), chunk):
+                    crows = delta_rows[lo:lo + chunk]
+                    vals = np.asarray(fn(crows))
+                    pad = chunk - len(crows)
+                    if pad:
+                        crows = np.concatenate(
+                            [crows, np.full(pad, crows[0], crows.dtype)]
+                        )
+                        vals = np.concatenate(
+                            [vals, np.repeat(vals[:1], pad, axis=0)],
+                            axis=0,
+                        )
+                    rows, vals = self._put_delta(
+                        crows.astype(np.int32), vals
+                    )
+                    self._planes[name] = _scatter_rows(
+                        self._planes[name], rows, vals
+                    )
+                    n_chunks += 1
+                self.delta_chunks += max(0, n_chunks - 1)
             m.reset_node_delta()
             self._key = key
             self.delta_uploads += 1
@@ -196,6 +274,22 @@ class DeviceSnapshot:
         self._key = key
         self.full_uploads += 1
         return self._planes
+
+    def resident_bytes(self) -> int:
+        """Modeled device-resident footprint of the snapshot: the sum
+        of every committed plane's (and class table's) nbytes.  The
+        scale-tier budget test asserts this stays within the modeled
+        envelope at 100k nodes, and peak TRANSIENT staging adds at most
+        one ``budget_bytes()`` chunk on top (the chunked delta
+        scatter)."""
+        total = 0
+        for group in (self._planes, self._cls_planes):
+            for arr in group.values():
+                size = int(np.prod(getattr(arr, "shape", ()) or (1,)))
+                total += size * int(
+                    np.dtype(getattr(arr, "dtype", np.uint8)).itemsize
+                )
+        return total
 
     def class_tables(self, key: Tuple,
                      build: Dict[str, Callable[[], np.ndarray]]):

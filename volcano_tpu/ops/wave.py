@@ -66,7 +66,7 @@ the predicate fan-out on the MXU instead of the vector units.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -123,6 +123,22 @@ AFF_STEER = _env_int("VOLCANO_TPU_AFF_STEER", 0)
 # instead of every attempt.  Exact (same values); knob exists for A/B
 # measurement.
 AFF_ACACHE = _env_int("VOLCANO_TPU_AFF_ACACHE", 1)
+# Flattened (term x domain) scatter keys index an [EW * D + 1] buffer
+# with int32 device arithmetic (jax's default index width).  At the
+# 100k-node x 1M-pod tier the PRODUCT crosses 2^31 while each axis
+# stays far below it, so past this bound the conflict/count machinery
+# switches to 2-D (term, domain) indexing — identical values,
+# overflow-free.  Env-overridable so the 2-D form is exercised (and
+# parity-tested) at small shapes.
+def _keyspace_max() -> int:
+    try:
+        return int(
+            _os.environ.get("VOLCANO_TPU_KEYSPACE_MAX", 2**31 - 2)
+        )
+    except ValueError:
+        return 2**31 - 2
+
+
 # Per-attempt count-window gathers cnt[e, node_dom[n, key(e)]] run as
 # ~10 ns/element serialized gathers on TPU (21 ms per attempt at
 # 10k x 100k); below this [D, N] f32 footprint they run instead as one
@@ -367,52 +383,167 @@ def _static_planes(nodes: SolveNodes, prof: SolveProfiles,
     return ok.reshape(U, C), sc.reshape(U, C)
 
 
-def _topk_nodes(scores, k: int, n_shards: int = 1):
-    """Top-``k`` node ids per profile row — shard-local under a mesh.
+def _hier_pin() -> int:
+    """The pinned ``VOLCANO_TPU_TOPK_BLOCKS`` value (0 = adaptive).
+    Read OUTSIDE the jits — ``solve_wave`` resolves it per call and
+    threads it through as a static argument, so flipping the knob
+    in-process actually re-specializes the kernels (an env read at
+    trace time would silently hit the jit cache instead)."""
+    try:
+        return max(0, int(_os.environ.get("VOLCANO_TPU_TOPK_BLOCKS",
+                                          "0")))
+    except ValueError:
+        return 0
+
+
+def _hier_blocks(n: int, k: int, n_shards: int = 1,
+                 pin: Optional[int] = None) -> int:
+    """Block count of the hierarchical block->shard->global top-k for
+    an [*, n] ranking (trace-static; n, k, n_shards are static inside
+    every caller's jit).
+
+    ``pin`` is the resolved ``VOLCANO_TPU_TOPK_BLOCKS`` (0 = adaptive;
+    ``None`` reads the env — only sound for EAGER callers, jitted
+    callers must thread ``solve_wave``'s static through).  A pinned
+    count is pow2-clamped to a divisor of ``n`` (1 disables the block
+    stage).  The adaptive default engages the block stage only when
+    each shard's node slice is large and the ranking depth is a small
+    fraction of it — one top_k over [*, n] at 100k+ nodes sorts the
+    whole plane, while per-block top_k + the winner merge sorts ~k
+    rows per block.  Blocks are sized toward TOPK_BLOCK_ROWS (pow2,
+    floor 4 * k so the merged candidate set stays well under n)."""
+    n_sh = max(1, n_shards)
+    if pin is None:
+        pin = _hier_pin()
+    if pin:
+        p = 1
+        while p * 2 <= pin:
+            p *= 2
+        nb = max(p, n_sh)
+        while nb > n_sh and n % nb:
+            nb //= 2
+        if n % nb:
+            # The pinned count (and the shard count) do not divide the
+            # node axis: the global form is both correct and what GSPMD
+            # would fall back to anyway.
+            return 1
+        return max(nb, 1)
+    if n < TOPK_HIER_MIN or k * 4 > n // max(n_sh, 1):
+        return max(n_sh, 1)
+    rows = TOPK_BLOCK_ROWS
+    while rows < 4 * k:
+        rows *= 2
+    nb = max(n_sh, 1)
+    while n % (nb * 2) == 0 and n // nb > rows:
+        nb *= 2
+    return nb
+
+
+# Node-axis thresholds of the adaptive hierarchical selection (see
+# _hier_blocks): below TOPK_HIER_MIN nodes a single top_k wins; above,
+# blocks aim at TOPK_BLOCK_ROWS rows each.
+TOPK_HIER_MIN = _env_int("VOLCANO_TPU_TOPK_HIER_MIN", 65536)
+TOPK_BLOCK_ROWS = _env_int("VOLCANO_TPU_TOPK_BLOCK_ROWS", 8192)
+
+
+def _merge_block_cands(cand_s, cand_i, k: int, n_shards: int = 1):
+    """Merge per-block (score, global node id) candidate lists into the
+    global top-``k`` id set — the shard->global tail of the
+    block->shard->global hierarchy (arxiv 2002.07062's tiling, applied
+    to the selection reduce).
+
+    ``cand_s``/``cand_i`` are [U, B, klb] with blocks ascending-id node
+    ranges and each block's list in local rank order.  When the blocks
+    subdivide ``n_shards`` mesh shards evenly, the merge runs in two
+    stages: a SHARD-LOCAL reduce of each shard's blocks (zero
+    cross-chip traffic), then the cross-chip winner reduction over the
+    [U, n_shards * min(k, ...)] survivors — communication stays at the
+    two-stage form's volume no matter how many blocks subdivide a
+    shard.  Otherwise one flat reduce over [U, B * klb].
+
+    The result is EXACTLY the top-k of the blocks' union with
+    ``jax.lax.top_k`` tie-breaking (lower node id first): within a
+    block, equal-score candidates sit in ascending-id order (top_k's
+    own tie-break); blocks (and shards) concatenate in ascending-id
+    range order; every merge stage's top_k prefers the earlier
+    position — so within any score class, position order is ascending
+    node id order at every stage."""
+    U, B, klb = cand_s.shape
+    if n_shards > 1 and B > n_shards and B % n_shards == 0:
+        bps = B // n_shards
+        ksh = min(k, bps * klb)
+        sh_s = cand_s.reshape(U, n_shards, bps * klb)
+        sh_i = cand_i.reshape(U, n_shards, bps * klb)
+        ms, pos = jax.lax.top_k(sh_s, ksh)  # shard-local block merge
+        mi = jnp.take_along_axis(sh_i, pos, axis=2)
+        flat_s = ms.reshape(U, n_shards * ksh)
+        flat_i = mi.reshape(U, n_shards * ksh)
+    else:
+        flat_s = cand_s.reshape(U, B * klb)
+        flat_i = cand_i.reshape(U, B * klb)
+    kf = min(k, flat_s.shape[1])
+    _s, pos = jax.lax.top_k(flat_s, kf)  # cross-chip winner reduction
+    out = jnp.take_along_axis(flat_i, pos, axis=1)
+    if kf < k:
+        # Degenerate: fewer candidates than k (tiny blocks).  Pad by
+        # repeating the last winner — callers either never hit this
+        # (klb == min(k, nlb) keeps B*klb >= k whenever N >= k) or
+        # tolerate duplicate trailing ids.
+        out = jnp.concatenate(
+            [out, jnp.broadcast_to(out[:, -1:], (U, k - kf))], axis=1
+        )
+    return out
+
+
+def _topk_nodes(scores, k: int, n_shards: int = 1,
+                pin: Optional[int] = None):
+    """Top-``k`` node ids per profile row — hierarchical
+    block->shard->global under a mesh and/or at large node counts.
+    ``pin`` threads the resolved TOPK_BLOCKS static from jitted
+    callers (see ``_hier_pin``); eager callers may leave it None.
 
     ``scores`` is [U, N] with the node axis optionally sharded over
-    ``n_shards`` mesh devices.  With ``n_shards == 1`` this is exactly
-    ``jax.lax.top_k`` (ties prefer the lower node index).  With more,
-    the selection restructures into the mesh-native two-stage form the
-    batch-on-NN-processor architecture prescribes (arxiv 2002.07062 —
-    the reduction step is the only cross-device communication):
+    ``n_shards`` mesh devices.  The selection runs in up to three
+    stages (each optional, all exact):
 
-    1. each shard ranks ONLY its own node slice (the reshape puts the
-       mesh axis on a leading dimension, so the inner ``top_k`` runs
-       shard-local with zero communication);
-    2. the per-shard winner lists reduce across chips as
-       (score, global node id) pairs — an all-reduce over the tiny
-       [U, n_shards * k] candidate set instead of a global sort/gather
-       of the full [U, N] plane.
+    1. per-BLOCK top_k inside each shard's slice (``_hier_blocks``
+       picks the block count; blocks are ascending-id node ranges, so
+       the reshape keeps every block within its owning shard and the
+       stage runs with zero communication) — at the 100k-node tier this
+       replaces one full-plane sort with ~k-deep sorts per block;
+    2. a shard-local merge of each shard's block candidates;
+    3. the cross-chip winner reduction over (score, global node id)
+       pairs — the only cross-device communication (arxiv 2002.07062).
 
     The result is EXACTLY ``jax.lax.top_k(scores, k)``: a global top-k
-    element is necessarily a top-k element of its own shard, and the
-    tie-break matches because candidate positions order by (shard,
-    local rank) — shards are ascending-id node blocks and the local
-    ``top_k`` already breaks ties by ascending id, so within any score
-    class candidate position order IS ascending node id order.
+    element is necessarily a top-k element of its own block (a block
+    can contribute at most min(k, block_rows) winners), and the
+    tie-break matches because candidate positions order by (block,
+    local rank) — ascending node id within any score class at every
+    stage (see ``_merge_block_cands``).
     """
-    if n_shards <= 1 or scores.shape[1] % n_shards:
+    U, N = scores.shape
+    if n_shards > 1 and N % n_shards:
+        n_shards = 1
+    nb = _hier_blocks(N, k, n_shards, pin)
+    if nb <= 1 or N % nb:
         _s, idx = jax.lax.top_k(scores, k)
         return idx.astype(jnp.int32)
-    U, N = scores.shape
-    nl = N // n_shards
-    kl = min(k, nl)
-    loc = scores.reshape(U, n_shards, nl)
-    loc_s, loc_i = jax.lax.top_k(loc, kl)  # shard-local ranking
+    nlb = N // nb
+    klb = min(k, nlb)
+    loc = scores.reshape(U, nb, nlb)
+    loc_s, loc_i = jax.lax.top_k(loc, klb)  # block-local ranking
     gid = loc_i.astype(jnp.int32) + (
-        jnp.arange(n_shards, dtype=jnp.int32) * nl
+        jnp.arange(nb, dtype=jnp.int32) * nlb
     )[None, :, None]
-    cand_s = loc_s.reshape(U, n_shards * kl)
-    cand_i = gid.reshape(U, n_shards * kl)
-    _s, pos = jax.lax.top_k(cand_s, k)  # cross-chip winner reduction
-    return jnp.take_along_axis(cand_i, pos, axis=1)
+    return _merge_block_cands(loc_s, gid, k, n_shards)
 
 
 @partial(jax.jit, static_argnames=("sl_k", "chunk", "features",
                                    "cnt0_any", "cls_identity",
                                    "mesh_shards", "n_blocks",
-                                   "with_cand", "static_ext"))
+                                   "with_cand", "static_ext",
+                                   "hier_pin"))
 def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
                       score_prof, cls: NodeClasses, aff: AffinityArgs,
                       weights: ScoreWeights, eps, scalar_slot,
@@ -420,7 +551,7 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
                       cnt0_any: bool, cls_identity: bool,
                       mesh_shards: int = 1, n_blocks: int = 1,
                       with_cand: bool = False, static_ext: bool = False,
-                      stat_ok=None, stat_score=None):
+                      stat_ok=None, stat_score=None, hier_pin: int = 0):
     """Phase 1 + shortlist selection of the two-phase solve.
 
     Evaluates the wave-0-attempt-1 live mask + score for every profile
@@ -532,9 +663,14 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
             score = score + jnp.matmul(t_soft, cv0_f.T)
         masked = jnp.where(feas, score, NEG)
         if with_cand:
-            # Per-block top-k + winner merge (ISSUE 9): identical
-            # membership to the direct top-k (see the docstring), and
-            # the block candidates become the warm-start state.
+            # Per-block top-k + hierarchical winner merge (ISSUE 9 +
+            # the 100k-node tier): identical membership to the direct
+            # top-k (see the docstring), the block candidates become
+            # the warm-start state, and under a mesh the merge reduces
+            # shard-local before the cross-chip winner reduction
+            # (_merge_block_cands — blocks subdivide shards because
+            # the caller keeps n_blocks a multiple of the shard
+            # count).
             u_ = masked.shape[0]
             nlb = N // n_blocks
             klb = min(sl_k, nlb)
@@ -544,16 +680,11 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
             gid = loc_i.astype(jnp.int32) + (
                 jnp.arange(n_blocks, dtype=jnp.int32) * nlb
             )[None, :, None]
-            _s, pos = jax.lax.top_k(
-                loc_s.reshape(u_, n_blocks * klb), sl_k
-            )
-            idx = jnp.take_along_axis(
-                gid.reshape(u_, n_blocks * klb), pos, axis=1
-            )
+            idx = _merge_block_cands(loc_s, gid, sl_k, mesh_shards)
             return (jnp.sort(idx, axis=1).astype(jnp.int32), loc_s, gid)
         # Shard-local ranking + cross-chip winner reduction under a
         # mesh; identical membership to a global top_k (see _topk_nodes).
-        idx = _topk_nodes(masked, sl_k, mesh_shards)
+        idx = _topk_nodes(masked, sl_k, mesh_shards, hier_pin)
         return jnp.sort(idx, axis=1).astype(jnp.int32)
 
     ones_u = jnp.ones((U, 1), bool)
@@ -585,14 +716,15 @@ def _coarse_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
 
 @partial(jax.jit, static_argnames=("sl_k", "klb", "nlb", "chunk",
                                    "features", "cnt0_any",
-                                   "cls_identity", "static_ext"))
+                                   "cls_identity", "static_ext",
+                                   "mesh_shards"))
 def _warm_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
                     score_prof, cls: NodeClasses, aff: AffinityArgs,
                     weights: ScoreWeights, eps, scalar_slot,
                     stat_ok, stat_score, db_rows, cand_s, cand_i,
                     sl_k: int, klb: int, nlb: int, chunk: int,
                     features: tuple, cnt0_any: bool, cls_identity: bool,
-                    static_ext: bool):
+                    static_ext: bool, mesh_shards: int = 1):
     """Warm-started shortlist selection (ISSUE 9): re-rank ONLY the node
     blocks whose rows are in the cycle's dirty set, patch their
     candidates into the carried per-block lists, and merge winners.
@@ -711,13 +843,11 @@ def _warm_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
         i_new = i_new.reshape(U, ndb, klb)
     # Patch the dirty blocks' candidates (duplicate padded block ids
     # rewrite identical values — idempotent) and merge winners exactly
-    # like the coarse pass's with_cand tail.
+    # like the coarse pass's with_cand tail: block->shard->global under
+    # a mesh, one flat reduce otherwise (_merge_block_cands).
     cand_s = cand_s.at[:, db_rows].set(s_new)
     cand_i = cand_i.at[:, db_rows].set(i_new)
-    flat_s = cand_s.reshape(U, -1)
-    flat_i = cand_i.reshape(U, -1)
-    _s, pos = jax.lax.top_k(flat_s, sl_k)
-    idx = jnp.take_along_axis(flat_i, pos, axis=1)
+    idx = _merge_block_cands(cand_s, cand_i, sl_k, mesh_shards)
     sl = jnp.sort(idx, axis=1).astype(jnp.int32)
     return sl, cand_s, cand_i
 
@@ -725,7 +855,8 @@ def _warm_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
 @partial(jax.jit, static_argnames=("wave", "n_waves", "ew", "features",
                                    "terms_disjoint", "two_phase",
                                    "cls_identity", "fb_cap",
-                                   "mesh_shards", "static_ext"))
+                                   "mesh_shards", "static_ext",
+                                   "hier_pin", "flat_keys"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -755,6 +886,8 @@ def _solve_wave(
     static_ext: bool = False,
     stat_ok=None,  # [U, C] bool persistent static planes (ISSUE 9)
     stat_score=None,  # [U, C] f32
+    hier_pin: int = 0,  # resolved TOPK_BLOCKS (0 = adaptive)
+    flat_keys: bool = True,  # (term x domain) key space fits int32
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
@@ -784,6 +917,14 @@ def _solve_wave(
     EW = ew
     S = shortlists.shape[1] if two_phase else N
     K = min(TOPK, S)
+    # int32 index audit (the 100k x 1M tier): flattened (term, domain)
+    # keys are only sound while EW * D + 1 fits the int32 device index
+    # space; past the gate every keyed scatter/gather below runs in
+    # its 2-D form.  The verdict arrives as the ``flat_keys`` STATIC —
+    # resolved by solve_wave outside the jit (_keyspace_max is an env
+    # read; reading it at trace time would pin the first verdict into
+    # the jit cache).
+    flat_keys_ok = flat_keys
     JP = J + W  # job axis padded so any wave's window slice stays in range
     f32 = jnp.float32
     BIG = jnp.float32(1.0e9)
@@ -1143,7 +1284,7 @@ def _solve_wave(
             # winner reduction crossing chips (_topk_nodes) — this is
             # the full-N path, so it also keeps the two-phase fallback
             # rescore shard-local.
-            return _topk_nodes(p_score, K, mesh_shards)
+            return _topk_nodes(p_score, K, mesh_shards, hier_pin)
 
         def live_parts_sl(s: GState, cw_a, cw_p, aff_ok_c, aff_soft_c,
                           aff_dirty_a):
@@ -1678,10 +1819,6 @@ def _solve_wave(
                         gmask = (gives & live[:, None]
                                  & term_req_w[None, :])  # [W, EW]
                         grow = jnp.any(gmask, axis=1)  # [W]
-                        keyv = (
-                            term_arange[None, :] * D + jnp.maximum(dw, 0)
-                        )
-                        scratch = EW * D
 
                         # TPU scatters serialize per update: the full
                         # [W, EW] key scatter costs ~2 ms/sub-round at
@@ -1689,29 +1826,83 @@ def _solve_wave(
                         # compact to the earliest <=GCAP of them (min
                         # over a superset of rows with no giver entries
                         # is unchanged); overflow falls back exactly.
-                        def _gm_full(_):
-                            keys_g = jnp.where(gmask, keyv, scratch)
-                            return (
-                                jnp.full((EW * D + 1,), W, jnp.int32)
-                                .at[keys_g.reshape(-1)]
-                                .min(jnp.broadcast_to(
-                                    jidx[:, None], (W, EW)
-                                ).reshape(-1))
+                        # Two address forms, identical values: the
+                        # flattened [EW * D + 1] buffer (scratch slot
+                        # EW * D for masked entries) while the key
+                        # space fits int32, the 2-D [EW, D + 1] buffer
+                        # (scratch COLUMN D) past it — the scale-tier
+                        # int32 audit.
+                        if flat_keys_ok:
+                            keyv = (
+                                term_arange[None, :] * D
+                                + jnp.maximum(dw, 0)
                             )
+                            scratch = EW * D
 
-                        def _gm_compact(_):
-                            gidx, gvalid = _earliest_rows(grow)
-                            keys_c = jnp.where(
-                                gmask[gidx] & gvalid[:, None],
-                                keyv[gidx], scratch,
-                            )
-                            return (
-                                jnp.full((EW * D + 1,), W, jnp.int32)
-                                .at[keys_c.reshape(-1)]
-                                .min(jnp.broadcast_to(
-                                    jidx[gidx][:, None], (GCAP, EW)
-                                ).reshape(-1))
-                            )
+                            def _gm_full(_):
+                                keys_g = jnp.where(gmask, keyv, scratch)
+                                return (
+                                    jnp.full((EW * D + 1,), W, jnp.int32)
+                                    .at[keys_g.reshape(-1)]
+                                    .min(jnp.broadcast_to(
+                                        jidx[:, None], (W, EW)
+                                    ).reshape(-1))
+                                )
+
+                            def _gm_compact(_):
+                                gidx, gvalid = _earliest_rows(grow)
+                                keys_c = jnp.where(
+                                    gmask[gidx] & gvalid[:, None],
+                                    keyv[gidx], scratch,
+                                )
+                                return (
+                                    jnp.full((EW * D + 1,), W, jnp.int32)
+                                    .at[keys_c.reshape(-1)]
+                                    .min(jnp.broadcast_to(
+                                        jidx[gidx][:, None], (GCAP, EW)
+                                    ).reshape(-1))
+                                )
+
+                            def _gm_at(dwv):
+                                kv = (
+                                    term_arange[None, :] * D
+                                    + jnp.maximum(dwv, 0)
+                                )
+                                return gm[kv]
+                        else:
+                            def _gm_full(_):
+                                cols = jnp.where(
+                                    gmask, jnp.maximum(dw, 0), D
+                                )
+                                return (
+                                    jnp.full((EW, D + 1), W, jnp.int32)
+                                    .at[jnp.broadcast_to(
+                                        term_arange[None, :], (W, EW)
+                                    ), cols]
+                                    .min(jnp.broadcast_to(
+                                        jidx[:, None], (W, EW)
+                                    ))
+                                )
+
+                            def _gm_compact(_):
+                                gidx, gvalid = _earliest_rows(grow)
+                                cols = jnp.where(
+                                    gmask[gidx] & gvalid[:, None],
+                                    jnp.maximum(dw[gidx], 0), D,
+                                )
+                                return (
+                                    jnp.full((EW, D + 1), W, jnp.int32)
+                                    .at[jnp.broadcast_to(
+                                        term_arange[None, :], (GCAP, EW)
+                                    ), cols]
+                                    .min(jnp.broadcast_to(
+                                        jidx[gidx][:, None], (GCAP, EW)
+                                    ))
+                                )
+
+                            def _gm_at(dwv):
+                                return gm[term_arange[None, :],
+                                          jnp.maximum(dwv, 0)]
 
                         gm = jax.lax.cond(
                             jnp.sum(grow) > GCAP, _gm_full, _gm_compact,
@@ -1738,7 +1929,7 @@ def _solve_wave(
                         )  # [W]
 
                         def _conf_full(_):
-                            gm_my = gm[keyv]  # [W, EW]
+                            gm_my = _gm_at(dw)  # [W, EW]
                             c_anti = jnp.any(
                                 anti_inv & (gm_my < jidx[:, None]),
                                 axis=1,
@@ -1753,7 +1944,7 @@ def _solve_wave(
 
                         def _conf_compact(_):
                             ci, cvalid = _earliest_rows(inv_rows)
-                            gm_my_c = gm[keyv[ci]]  # [GCAP, EW]
+                            gm_my_c = _gm_at(dw[ci])  # [GCAP, EW]
                             ji_c = jidx[ci]
                             c_anti = jnp.any(
                                 anti_inv[ci]
@@ -1845,10 +2036,34 @@ def _solve_wave(
                     def _cnt_update(op):
                         cwa, cwp = op
                         dw = node_dom_t[choice]  # [W, EW]
-                        flat_dom = (
-                            term_arange[None, :] * D + jnp.maximum(dw, 0)
-                        )
                         inc_base = t_matches_w & (dw >= 0)
+
+                        # Count-scatter address forms (the scale-tier
+                        # int32 audit, see _gm_full): flattened keys
+                        # while EW * D fits int32, 2-D (term, domain)
+                        # indices past it.  Masked rows carry value 0
+                        # and land on domain 0 — a no-op either way.
+                        if flat_keys_ok:
+                            def _cnt_add(cw, dwv, vals):
+                                fd = (
+                                    term_arange[None, :] * D
+                                    + jnp.maximum(dwv, 0)
+                                )
+                                return (
+                                    cw.reshape(-1)
+                                    .at[fd.reshape(-1)]
+                                    .add(vals.reshape(-1))
+                                    .reshape(EW, D)
+                                )
+                        else:
+                            def _cnt_add(cw, dwv, vals):
+                                rows = vals.shape[0]
+                                return cw.at[
+                                    jnp.broadcast_to(
+                                        term_arange[None, :], (rows, EW)
+                                    ),
+                                    jnp.maximum(dwv, 0),
+                                ].add(vals)
 
                         def cnt_apply(cw, acc):
                             # Accepted matching tasks are few per
@@ -1860,14 +2075,10 @@ def _solve_wave(
                             rows_m = jnp.any(inc_base, axis=1) & acc
 
                             def _full(_):
-                                return (
-                                    cw.reshape(-1)
-                                    .at[flat_dom.reshape(-1)]
-                                    .add(
-                                        (inc_base & acc[:, None])
-                                        .astype(jnp.int32).reshape(-1)
-                                    )
-                                    .reshape(EW, D)
+                                return _cnt_add(
+                                    cw, dw,
+                                    (inc_base & acc[:, None])
+                                    .astype(jnp.int32),
                                 )
 
                             def _compact(_):
@@ -1877,12 +2088,7 @@ def _solve_wave(
                                     & acc[ci][:, None]
                                     & cval[:, None]
                                 ).astype(jnp.int32)
-                                return (
-                                    cw.reshape(-1)
-                                    .at[flat_dom[ci].reshape(-1)]
-                                    .add(vals.reshape(-1))
-                                    .reshape(EW, D)
-                                )
+                                return _cnt_add(cw, dw[ci], vals)
 
                             return jax.lax.cond(
                                 jnp.sum(rows_m) > GCAP, _full, _compact,
@@ -2760,6 +2966,14 @@ def solve_wave(
     chunk = 1
     while chunk * 2 <= max(1, min(COARSE_CHUNK, U_rows)):
         chunk *= 2
+    # Trace-static knob verdicts resolved OUTSIDE the jits (an env read
+    # at trace time would pin the first verdict into the jit cache and
+    # make in-process knob flips no-ops): the hierarchical-selection
+    # pin, and the int32 key-space verdict for the kernel's windowed
+    # [EW, D] (term x domain) scatters — ``ew`` and the domain width
+    # are exactly the kernel's EW and D.
+    hier_pin = _hier_pin()
+    flat_keys = (ew * int(cnt0_host.shape[1]) + 1) <= _keyspace_max()
     # Device-incremental context (ISSUE 9): only the two-phase slim
     # path qualifies — custom-plugin solves carry per-solve [U, N]
     # planes the cache keys cannot cover.
@@ -2796,6 +3010,7 @@ def solve_wave(
                     sl_k=sl_k, chunk=chunk,
                     features=features, cnt0_any=bool(cnt0_any),
                     cls_identity=cls_identity, mesh_shards=n_sh,
+                    hier_pin=hier_pin,
                 )
             t_coarse = _time.perf_counter() - t0
         else:
@@ -2812,6 +3027,8 @@ def solve_wave(
             static_ext=stat is not None,
             stat_ok=stat[0] if stat is not None else None,
             stat_score=stat[1] if stat is not None else None,
+            hier_pin=hier_pin,
+            flat_keys=flat_keys,
         )
         t_fine = _time.perf_counter() - t0
     # Dispatch-side sub-lane telemetry (the cycle driver folds it into

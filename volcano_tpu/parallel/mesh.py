@@ -220,11 +220,15 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
         if isinstance(x, jax.Array) and not isinstance(x, np.ndarray):
             return x
         # Persistent per-device plane: re-ship only when the node table
-        # (epoch) or the padded shape moved.
+        # (epoch) or the padded shape moved.  The mesh IDENTITY is part
+        # of the key (not just its size): a store whose solve_mesh is
+        # replaced by a different same-sized mesh must not hand the jit
+        # arrays committed to the old mesh's sharding — the composed
+        # profile swaps meshes within one process.
         if plane_cache is None or epoch is None:
             return put_node(x)
         a = np.asarray(x)
-        key = (epoch, a.shape, a.dtype.str, mesh.devices.size)
+        key = (epoch, a.shape, a.dtype.str, mesh.devices.size, id(mesh))
         hit = plane_cache.get(name)
         if hit is not None and hit[0] == key:
             return hit[1]
