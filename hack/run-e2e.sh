@@ -167,6 +167,32 @@ off = run(False)
 assert on == off, "composed binds differ from the everything-off run"
 print(f"composed bind parity OK ({len(on)} pods bit-for-bit)")
 '
+# Endurance smoke (ISSUE 13): >= 200 churn cycles at a small shape
+# with the full fault schedule — a mid-run solver-child kill/restart,
+# node flaps, preempt waves, and enough lifecycle churn to force at
+# least one real pod-table compaction — auditors on every cycle.  The
+# gate exits nonzero on any anomaly; the tail assertion additionally
+# proves the faults actually fired and the audit verdict is clean.
+BENCH_ENDURANCE=1 BENCH_NODES=64 BENCH_PODS=1024 \
+  BENCH_ENDURANCE_CYCLES=200 BENCH_ENDURANCE_DELETE_FRAC=0.03 \
+  VOLCANO_TPU_AUDIT_SAMPLE=8 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+tails = [r["endurance"] for r in rows if "endurance" in r]
+assert tails, "no endurance tail emitted"
+e = tails[0]
+assert e["anomalies"] == 0, f"endurance anomalies: {e}"
+assert e["cycles"] >= 200, e
+assert e["solver_kills"] >= 1, f"no solver kill exercised: {e}"
+assert e["compactions"] >= 1, f"no compaction exercised: {e}"
+assert e["node_flaps"] >= 1 and e["preempt_waves"] >= 1, e
+audits = [r["audit"] for r in rows if "audit" in r]
+assert audits and audits[0]["sampled_cycles"] >= 1, audits
+c, k, n = e["cycles"], e["solver_kills"], e["compactions"]
+print(f"endurance smoke OK ({c} cycles, {k} kills, "
+      f"{n} compactions, 0 anomalies)")
+'
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Endurance gate (ISSUE 13, docs/observability.md): a compressed-hours
+# simulator run — pipelined steady state under sustained churn, node
+# flaps, solver-child kills/restarts, preempt waves and pod-table
+# compactions — with the runtime conservation auditor ON and SLO
+# budgets declared from a calibration window.  Exits nonzero on ANY
+# anomaly; the JSON tail carries cycles survived, the anomaly verdict,
+# p99s vs budgets, and the measured audit overhead (<2% envelope).
+#
+# Defaults run the 2k x 20k shape (~minutes on one chip / CPU);
+# BENCH_FULL=1 runs the slow 10k x 100k tier.  All BENCH_ENDURANCE_*
+# knobs (cycles, churn fraction, delete fraction, budget multiplier)
+# and VOLCANO_TPU_AUDIT_SAMPLE pass straight through.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${BENCH_ENDURANCE_CYCLES:=300}"
+: "${VOLCANO_TPU_AUDIT_SAMPLE:=16}"
+export BENCH_ENDURANCE_CYCLES VOLCANO_TPU_AUDIT_SAMPLE
+
+BENCH_ENDURANCE=1 python bench.py "$@"
+echo "endurance gate OK (0 anomalies)"

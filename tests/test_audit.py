@@ -1,0 +1,454 @@
+"""Runtime conservation auditor + SLO layer (ISSUE 13).
+
+Pins the acceptance contracts of obs/audit.py and obs/slo.py:
+
+- a clean churn run (binds, unbinds, deletes, adds, compactions)
+  produces ZERO anomalies with the auditor sampling every cycle;
+- each anomaly class, seeded deliberately, is detected within <= 2
+  cycles with its exact catalogued reason, increments
+  ``volcano_audit_anomalies_total``, lands in the cycle's flight
+  record and in ``/debug/anomalies``, and shows in ``/debug/health``;
+- ``/debug/health`` never blocks the cycle thread: it answers while
+  another thread HOLDS the store lock (the non-blocking contract);
+- the Perfetto export emits an instant event per anomaly.
+
+All CPU-only (conftest pins JAX_PLATFORMS=cpu); tier-1.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Pod,
+    PodGroup,
+    TaskStatus,
+)
+from volcano_tpu.metrics import metrics
+from volcano_tpu.obs import export
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+pytestmark = pytest.mark.tier1
+
+ST_BOUND = int(TaskStatus.Bound)
+ST_PENDING = int(TaskStatus.Pending)
+
+
+@pytest.fixture(autouse=True)
+def _dense_sampling(monkeypatch):
+    """Audit every cycle: the seeded-corruption contracts are
+    '<= 2 cycles to detection', which needs the sample gate open."""
+    monkeypatch.setenv("VOLCANO_TPU_AUDIT_SAMPLE", "1")
+
+
+def _churn_store(n_nodes=16, n_pods=64, frac=3):
+    store = synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods,
+                              gang_size=4, seed=3)
+    store.pipeline = True
+
+    def feed(fc):
+        m = fc.m
+        rows = np.flatnonzero(
+            (m.p_status[:fc.Pn] == ST_BOUND) & m.p_alive[:fc.Pn]
+        )
+        if len(rows):
+            fc._unbind_rows(rows[:max(1, len(rows) // frac)])
+
+    store.cycle_feed = feed
+    return store
+
+
+def _anomaly_metric(reason):
+    return metrics.audit_anomalies.data.get((("reason", reason),), 0.0)
+
+
+# --------------------------------------------------------- clean runs
+
+
+def test_clean_churn_run_has_zero_anomalies():
+    """Sustained bind/unbind churn plus store-edge add/delete churn,
+    audited every cycle, reconciles clean — the endurance gate's
+    baseline invariant."""
+    store = _churn_store()
+    sched = Scheduler(store)
+    sched.run_once()
+    sched.run_once()  # pipeline fill: first commit lands
+    store.flush_binds()
+    # Store-edge churn: delete one bound pod, add a fresh one.
+    victim = next(p for p in store.pods.values() if p.node_name)
+    store.delete_pod(victim)
+    store.add_pod_group(PodGroup(name="fresh", min_member=1))
+    store.add_pod(Pod(name="fresh-0",
+                      annotations={GROUP_NAME_ANNOTATION: "fresh"},
+                      containers=[{"cpu": "1", "memory": "1Gi"}]))
+    for _ in range(6):
+        sched.run_once()
+    store.flush_binds()
+    a = store.auditor
+    assert a.total_anomalies() == 0, [
+        x.to_dict() for x in a.anomalies()]
+    stats = a.audit_stats()
+    assert stats["reconciles"] >= 6
+    assert stats["sampled_cycles"] >= 6
+    # Flows were actually declared (double-entry, not vacuous).
+    health = a.health()
+    assert health["status"] == "ok"
+    assert health["flow_totals"].get("commit-bind", 0) > 0
+    assert health["flow_totals"].get("unbind", 0) > 0
+    assert health["flow_totals"].get("pod-deleted", 0) >= 1
+    assert health["flow_totals"].get("pod-added", 0) >= 1
+    assert health["verifiers"]["audit"] is True
+    store.close()
+
+
+def test_idle_cycles_skip_census():
+    """An idle store (no flows, unmoved mutation_seq) skips the census
+    on unsampled cycles — the null-delta cost contract."""
+    import os
+
+    os.environ["VOLCANO_TPU_AUDIT_SAMPLE"] = "64"
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2, seed=5)
+    assert store.auditor.sample == 64
+    sched = Scheduler(store)
+    for _ in range(5):
+        sched.run_once()
+    store.flush_binds()
+    stats = store.auditor.audit_stats()
+    assert stats["census_skips"] >= 1
+    assert store.auditor.total_anomalies() == 0
+    store.close()
+
+
+# ----------------------------------------------- seeded anomaly classes
+
+
+def test_seeded_conservation_mismatch():
+    """A silent status flip (no flow, no mutation stamp) surfaces as
+    conservation-mismatch within <= 2 cycles, with the per-class diff
+    in the detail, the metrics counter bumped, and the anomaly in the
+    cycle's flight record."""
+    store = _churn_store()
+    sched = Scheduler(store)
+    for _ in range(3):
+        sched.run_once()
+    assert store.auditor.total_anomalies() == 0
+    before = _anomaly_metric("conservation-mismatch")
+    m = store.mirror
+    n = len(m.p_uid)
+    rows = np.flatnonzero(m.p_alive[:n] & (m.p_status[:n] == ST_BOUND))
+    m.p_status[rows[0]] = ST_PENDING  # the silent corruption
+    sched.run_once()
+    sched.run_once()
+    counts = dict(store.auditor.anomaly_counts)
+    assert counts.get("conservation-mismatch", 0) >= 1, counts
+    assert _anomaly_metric("conservation-mismatch") > before
+    anom = next(a for a in store.auditor.anomalies()
+                if a.reason == "conservation-mismatch")
+    assert anom.detail["classes"], anom.detail
+    # The cycle that detected it carries it in its flight record.
+    assert any(
+        any(d["reason"] == "conservation-mismatch"
+            for d in rec.anomalies)
+        for rec in store.flight.recent()
+    )
+    store.close()
+
+
+def test_seeded_aggregate_plane_corruption():
+    """Corrupting one persistent aggregate cell surfaces as
+    aggregate-divergence at the next sampled derive (<= 2 cycles)."""
+    store = _churn_store()
+    sched = Scheduler(store)
+    for _ in range(3):
+        sched.run_once()
+    assert store.auditor.total_anomalies() == 0
+    store.mirror._cycle_aggr.n_used[0, 0] += 5.0
+    sched.run_once()
+    sched.run_once()
+    counts = dict(store.auditor.anomaly_counts)
+    assert counts.get("aggregate-divergence", 0) >= 1, counts
+    anom = next(a for a in store.auditor.anomalies()
+                if a.reason == "aggregate-divergence")
+    assert "n_used" in anom.detail["message"]
+    store.close()
+
+
+def test_seeded_ledger_restore_drop():
+    """Dropping a migration restore (the pod_deleted hook bypassed)
+    surfaces as ledger-restore-lost naming the victim."""
+    from volcano_tpu.actions.rebalance import MigrationLedger
+
+    store = _churn_store()
+    sched = Scheduler(store)
+    sched.run_once()
+    sched.run_once()  # pipeline fill: first commit lands
+    store.flush_binds()
+    victim = next(p for p in store.pods.values() if p.node_name)
+    gang = (victim.annotations or {}).get(GROUP_NAME_ANNOTATION)
+    ledger = store.migrations = MigrationLedger()
+    ledger.register(victim.uid, f"default/{gang}", "", action="preempt")
+    # The corruption: terminate the victim with the restore hook dead.
+    ledger.pod_deleted = lambda *a, **kw: None
+    victim.deleting = True
+    store.delete_pod(victim)
+    sched.run_once()
+    counts = dict(store.auditor.anomaly_counts)
+    assert counts.get("ledger-restore-lost", 0) >= 1, counts
+    anom = next(a for a in store.auditor.anomalies()
+                if a.reason == "ledger-restore-lost")
+    assert anom.detail["victim"] == victim.uid
+    store.close()
+
+
+class _CycStub:
+    """The end_cycle surface of a FastCycle, for audit passes driven
+    between real cycles (the cycle itself would re-dispatch and move
+    the very wire generation the seed corrupts)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.m = store.mirror
+        self.stats = {"dispatched_solve_id": None}
+        self.lanes = {}
+
+
+def _wire_store():
+    """A store whose solves really ship over loopback TCP, so the wire
+    mirror the audit guards is the production one."""
+    import threading
+
+    from volcano_tpu.solver_service import RemoteSolver, SolverServer
+
+    store = _churn_store(n_nodes=8, n_pods=16)
+    server = SolverServer(port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = RemoteSolver(f"127.0.0.1:{server.port}")
+    store.remote_solver = client
+    sched = Scheduler(store)
+    for _ in range(3):
+        sched.run_once()  # real frames ship; the sentinel anchors
+    store.flush_binds()
+    assert client._wire.arrays is not None, "no wire mirror to audit"
+    assert store.auditor.total_anomalies() == 0
+    return store, server, client
+
+
+def test_seeded_wire_generation_skew():
+    """A wire-mirror generation regression surfaces as
+    wire-mirror-divergence (kind=key-regressed), through the real
+    end_cycle pathway (ring + counter)."""
+    store, server, client = _wire_store()
+    before = _anomaly_metric("wire-mirror-divergence")
+    client._gen -= 1  # the corruption: generation went backward
+    anoms = store.auditor.end_cycle(_CycStub(store), 0.01)
+    assert [a.reason for a in anoms] == ["wire-mirror-divergence"]
+    assert anoms[0].detail["kind"] == "key-regressed"
+    assert _anomaly_metric("wire-mirror-divergence") > before
+    assert any(a.reason == "wire-mirror-divergence"
+               for a in store.auditor.anomalies())
+    client.close()
+    server.shutdown()
+    store.close()
+
+
+def test_seeded_wire_mirror_mutation():
+    """Mirror bytes changing under a HELD generation (the delta-frame
+    poison) surface as wire-mirror-divergence."""
+    store, server, client = _wire_store()
+    # Anchor the sentinel at the current (gen, content) pair.
+    assert store.auditor.end_cycle(_CycStub(store), 0.01) == []
+    arr = client._wire.arrays[0]
+    arr.reshape(-1)[0] += 1  # in-place mutation, same gen
+    anoms = store.auditor.end_cycle(_CycStub(store), 0.01)
+    assert [a.reason for a in anoms] == ["wire-mirror-divergence"]
+    assert anoms[0].detail["kind"] == "content-changed-under-key"
+    client.close()
+    server.shutdown()
+    store.close()
+
+
+def test_replaced_wire_client_reanchors_not_regresses():
+    """Solver failover to a FRESH client (generation restarts at 0)
+    must re-anchor the wire sentinel, not read as a generation
+    regression — client replacement is recovery, not corruption."""
+    from volcano_tpu.solver_service import RemoteSolver
+
+    store, server, client = _wire_store()
+    assert client._gen > 0
+    fresh = RemoteSolver(f"127.0.0.1:{server.port}")
+    store.remote_solver = fresh  # failover: brand-new client, gen 0
+    assert store.auditor.end_cycle(_CycStub(store), 0.01) == []
+    assert store.auditor.end_cycle(_CycStub(store), 0.01) == []
+    assert store.auditor.total_anomalies() == 0
+    fresh.close()
+    client.close()
+    server.shutdown()
+    store.close()
+
+
+def test_seeded_slo_budget_breach():
+    """An impossible declared budget breaches once the window fills:
+    exact reason, burn-rate gauge set, breach visible in
+    /debug/health's slo section, and re-emitted only on the edge."""
+    from volcano_tpu.obs.slo import MIN_SAMPLES
+
+    store = _churn_store()
+    store.auditor.slo.declare("cycle", 0.0001, allowed_frac=0.001)
+    sched = Scheduler(store)
+    for _ in range(MIN_SAMPLES + 2):
+        sched.run_once()
+    counts = dict(store.auditor.anomaly_counts)
+    assert counts.get("slo-budget-exceeded", 0) == 1, counts
+    anom = next(a for a in store.auditor.anomalies()
+                if a.reason == "slo-budget-exceeded")
+    assert anom.detail["lane"] == "cycle"
+    assert anom.detail["burn_rate"] >= 1.0
+    health = store.auditor.health()
+    lane = health["slo"]["cycle"]
+    assert lane["breached"] is True
+    assert lane["budget_remaining"] == 0.0
+    assert metrics.slo_burn_rate.data[(("lane", "cycle"),)] >= 1.0
+    store.close()
+
+
+def test_seeded_encode_cache_mutation():
+    """In-place mutation of the encode cache's arrays under a held key
+    surfaces as cache-content-mutated naming the slot."""
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2, seed=5)
+    store.pipeline = True
+    sched = Scheduler(store)
+    sched.run_once()
+    # Pin an unschedulable pending pod so the encode cache persists
+    # with a stable key across idle cycles (the null-probe idiom).
+    store.add_pod_group(PodGroup(name="probe", min_member=1))
+    store.add_pod(Pod(
+        name="probe-0", annotations={GROUP_NAME_ANNOTATION: "probe"},
+        containers=[{"cpu": "900000", "memory": "900000Gi"}],
+    ))
+    for _ in range(3):
+        sched.run_once()
+    cached = store._encode_cache
+    assert cached is not None
+    assert store.auditor.total_anomalies() == 0
+    cached["pid"][0] += 1  # the corruption
+    sched.run_once()
+    sched.run_once()
+    counts = dict(store.auditor.anomaly_counts)
+    assert counts.get("cache-content-mutated", 0) >= 1, counts
+    anom = next(a for a in store.auditor.anomalies()
+                if a.reason == "cache-content-mutated")
+    assert anom.detail["slot"] == "encode"
+    store.close()
+
+
+# ------------------------------------------------------ /debug surface
+
+
+def test_debug_health_and_anomalies_endpoints_never_block():
+    """/debug/health and /debug/anomalies serve while another thread
+    HOLDS the store lock mid-churn — the handlers read only the
+    auditor's own snapshots, so a scrape can never stall the cycle."""
+    from volcano_tpu.service import Service
+
+    store = _churn_store()
+    sched = Scheduler(store)
+    for _ in range(3):
+        sched.run_once()
+    # Seed one anomaly so the ring serves real content.
+    m = store.mirror
+    n = len(m.p_uid)
+    rows = np.flatnonzero(m.p_alive[:n] & (m.p_status[:n] == ST_BOUND))
+    m.p_status[rows[0]] = ST_PENDING
+    sched.run_once()
+
+    svc = Service(store=store, schedule_period=30.0,
+                  controller_period=5.0)
+    port = svc.start(http_port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return json.loads(r.read())
+
+        # Scrape WITH the store lock held elsewhere: must not block.
+        result = {}
+        with store._lock:
+            t = threading.Thread(
+                target=lambda: result.update(get("/debug/health")))
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive(), \
+                "/debug/health blocked on the store lock"
+        assert result["status"] == "anomalous"
+        assert result["anomalies_by_reason"].get(
+            "conservation-mismatch", 0) >= 1
+        assert result["audit"]["cycles"] >= 4
+        assert "verifiers" in result and "slo" in result
+
+        ring = get("/debug/anomalies")
+        assert any(a["reason"] == "conservation-mismatch" for a in ring)
+        assert get("/debug/anomalies?n=1")[-1]["reason"] == \
+            ring[-1]["reason"]
+
+        # The detecting cycle's record serializes its anomalies.
+        cycles = get("/debug/cycles")
+        flagged = [c for c in cycles if c["anomalies"]]
+        assert flagged, "no cycle record carries the anomaly"
+        flag_seq = next(c["seq"] for c in cycles
+                        if any(d["reason"] == "conservation-mismatch"
+                               for d in c["anomalies"]))
+        one = get(f"/debug/cycles/{flag_seq}")
+        assert any(d["reason"] == "conservation-mismatch"
+                   for d in one["anomalies"])
+        # The ring entry cross-references its flight cycle: an operator
+        # can walk /debug/anomalies -> /debug/cycles/<seq>.
+        ring_seqs = {a["cycle_seq"] for a in ring
+                     if a["reason"] == "conservation-mismatch"}
+        assert flag_seq in ring_seqs, (ring, flag_seq)
+    finally:
+        svc.stop()
+        store.close()
+
+
+def test_perfetto_export_emits_anomaly_instants():
+    """Every recorded anomaly becomes one instant event on the trace
+    timeline (cat=audit, name=anomaly:<reason>)."""
+    store = _churn_store()
+    sched = Scheduler(store)
+    for _ in range(3):
+        sched.run_once()
+    m = store.mirror
+    n = len(m.p_uid)
+    rows = np.flatnonzero(m.p_alive[:n] & (m.p_status[:n] == ST_BOUND))
+    m.p_status[rows[0]] = ST_PENDING
+    sched.run_once()
+    trace = export.perfetto_trace(store.flight.recent())
+    instants = [e for e in trace["traceEvents"]
+                if e.get("cat") == "audit" and e.get("ph") == "i"]
+    assert instants, "no anomaly instant in the export"
+    assert any(e["name"] == "anomaly:conservation-mismatch"
+               for e in instants)
+    json.dumps(trace)  # round-trips as JSON
+    store.close()
+
+
+def test_audit_disable_and_reenable_reanchors():
+    """VOLCANO_TPU_AUDIT A/B seam: disabling records nothing; the
+    re-enable re-anchors so unrecorded mutations never read as a
+    phantom conservation mismatch."""
+    store = _churn_store()
+    sched = Scheduler(store)
+    sched.run_once()
+    store.auditor.set_enabled(False)
+    sched.run_once()  # churn with no flow bookkeeping
+    store.auditor.set_enabled(True)
+    sched.run_once()
+    sched.run_once()
+    assert store.auditor.total_anomalies() == 0, [
+        x.to_dict() for x in store.auditor.anomalies()]
+    store.close()

@@ -560,6 +560,81 @@ def test_aggcheck_registry_covers_tree_slots():
 # ------------------------------------------------------------- the gate
 
 
+# ------------------------------------------- anomaly catalog (VCL6xx)
+
+
+ANOMALY_FIXTURE = textwrap.dedent('''\
+    class Auditor:
+        def checks(self, anomalies, reason):
+            anomalies.append(Anomaly("documented-reason", {"a": 1}))
+            anomalies.append(Anomaly("brand-new-reason", {}))
+            anomalies.append(Anomaly(reason, {}))
+            anomalies.append(Anomaly())
+''')
+
+ANOMALY_DOC_FIXTURE = textwrap.dedent("""\
+    # Catalog
+
+    | Reason | Meaning | First response |
+    |---|---|---|
+    | `documented-reason` | fine | none |
+    | `ghost-reason` | never emitted | none |
+""")
+
+
+def test_anomalycheck_catches_seeded_drift():
+    from tools.vclint import anomalycheck
+
+    raw = anomalycheck.analyze(
+        [("audit.py", ANOMALY_FIXTURE)], "obs.md", ANOMALY_DOC_FIXTURE
+    )
+    got = [(f.code, f.path, f.line) for f in raw]
+    msgs = "\n".join(f.message for f in raw)
+    # the uncatalogued emit -> VCL601 at the Anomaly() call
+    assert ("VCL601", "audit.py", 4) in got
+    assert "brand-new-reason" in msgs
+    # the docs-only reason -> VCL602 at its table row
+    assert ("VCL602", "obs.md", 6) in got
+    assert "ghost-reason" in msgs
+    # non-literal and missing reasons -> VCL603 at each call
+    assert ("VCL603", "audit.py", 5) in got
+    assert ("VCL603", "audit.py", 6) in got
+    # the in-sync reason produces nothing
+    assert not any("documented-reason" in f.message for f in raw)
+
+
+def test_anomalycheck_real_tree_is_clean():
+    from tools.vclint import anomalycheck
+
+    sources = [
+        (rel, (REPO_ROOT / rel).read_text())
+        for rel in anomalycheck.SCAN_FILES
+    ]
+    raw = anomalycheck.analyze(
+        sources, "docs/observability.md",
+        (REPO_ROOT / "docs/observability.md").read_text(),
+    )
+    assert raw == [], [f.render() for f in raw]
+
+
+def test_anomalycheck_covers_every_runtime_reason(monkeypatch):
+    """Every reason the audit surface can construct at runtime is a
+    literal the static scan sees — the catalog check cannot be
+    bypassed by an emit path the AST walk misses."""
+    from tools.vclint import anomalycheck
+
+    reasons = set()
+    for rel in anomalycheck.SCAN_FILES:
+        got, findings = anomalycheck.emitted_reasons(
+            rel, (REPO_ROOT / rel).read_text())
+        assert findings == [], [f.render() for f in findings]
+        reasons.update(got)
+    # The documented catalog and the emitted set are identical.
+    docs = anomalycheck.documented_reasons(
+        (REPO_ROOT / "docs/observability.md").read_text())
+    assert reasons == set(docs)
+
+
 def test_vclint_exits_zero_on_committed_tree(tmp_path):
     # Library-level run (what hack/run-checks.sh invokes via -m).
     out = (tmp_path / "out.txt").open("w")

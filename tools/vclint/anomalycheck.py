@@ -1,0 +1,114 @@
+"""Anomaly-catalog drift analyzer (VCL6xx): obs/audit.py ↔ docs.
+
+The runtime auditor (``volcano_tpu/obs/audit.py``, ISSUE 13) emits
+structured anomalies whose ``reason`` strings are the operator-facing
+contract: alerts route on them, the endurance gate greps for them, and
+docs/observability.md catalogs what each one means and what to do
+about it.  Nothing kept the catalog honest — a new anomaly class added
+to the auditor (or one renamed/removed) silently rotted the docs, the
+exact failure mode VCL401 closes for metrics.  Same pattern here:
+
+- **VCL601** — an ``Anomaly("reason", ...)`` constructed in the audit
+  surface has no row in the docs catalog (reported at the call).
+- **VCL602** — a catalog row names a reason the audit surface never
+  constructs (reported at the table row).
+- **VCL603** — an ``Anomaly(...)`` call whose reason is not a string
+  literal: the catalog check (and alert routing) needs static names.
+
+Extraction is pure AST: every ``Anomaly(...)`` call in the scanned
+files contributes its first argument.  Docs extraction matches the
+markdown table rows ``| `reason` | ...`` inside
+docs/observability.md's anomaly-catalog section (the whole file is
+scanned; only backticked first-cell rows whose cell looks like a
+kebab-case reason participate, so SLO/endpoint tables elsewhere in the
+file do not collide).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .findings import Finding
+
+# Files whose Anomaly(...) constructions define the emitted set.
+SCAN_FILES: Sequence[str] = (
+    "volcano_tpu/obs/audit.py",
+    "volcano_tpu/obs/slo.py",
+)
+
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9-]*)`\s*\|")
+
+
+def emitted_reasons(path: str, src: str
+                    ) -> Tuple[Dict[str, int], List[Finding]]:
+    """reason -> first lineno for every ``Anomaly(<literal>, ...)``
+    call in ``src``; VCL603 for non-literal reasons."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as err:
+        return {}, [Finding(
+            "VCL001", path, err.lineno or 1,
+            f"audit surface does not parse: {err.msg}",
+        )]
+    reasons: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "Anomaly"):
+            continue
+        if not node.args:
+            findings.append(Finding(
+                "VCL603", path, node.lineno,
+                "Anomaly() constructed without a reason argument",
+            ))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            reasons.setdefault(arg.value, node.lineno)
+        else:
+            findings.append(Finding(
+                "VCL603", path, node.lineno,
+                "Anomaly() reason is not a string literal (the "
+                "catalog drift check needs static names)",
+            ))
+    return reasons, findings
+
+
+def documented_reasons(doc_src: str) -> Dict[str, int]:
+    """reason -> lineno for every anomaly-catalog table row."""
+    out: Dict[str, int] = {}
+    for lineno, text in enumerate(doc_src.splitlines(), start=1):
+        m = _DOC_ROW_RE.match(text.strip())
+        if m:
+            out.setdefault(m.group(1), lineno)
+    return out
+
+
+def analyze(sources: Sequence[Tuple[str, str]], doc_path: str,
+            doc_src: str) -> List[Finding]:
+    findings: List[Finding] = []
+    emitted: Dict[str, Tuple[str, int]] = {}
+    for path, src in sources:
+        reasons, fs = emitted_reasons(path, src)
+        findings.extend(fs)
+        for reason, lineno in reasons.items():
+            emitted.setdefault(reason, (path, lineno))
+    docs = documented_reasons(doc_src)
+    for reason, (path, lineno) in sorted(emitted.items()):
+        if reason not in docs:
+            findings.append(Finding(
+                "VCL601", path, lineno,
+                f"anomaly reason '{reason}' is not catalogued in "
+                f"{doc_path}",
+            ))
+    for reason, lineno in sorted(docs.items()):
+        if reason not in emitted:
+            findings.append(Finding(
+                "VCL602", doc_path, lineno,
+                f"catalogued anomaly reason '{reason}' is never "
+                "emitted by the audit surface",
+            ))
+    return findings

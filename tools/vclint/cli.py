@@ -13,7 +13,8 @@ import sys
 from pathlib import Path
 from typing import List
 
-from . import aggcheck, hotpath, lockcheck, metricscheck, schemacheck
+from . import (aggcheck, anomalycheck, hotpath, lockcheck, metricscheck,
+               schemacheck)
 from .findings import Finding, finish
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
@@ -34,6 +35,8 @@ LOCK_FILES = [
     "volcano_tpu/whatif.py",
     "volcano_tpu/ops/devsnap.py",
     "volcano_tpu/obs/recorder.py",
+    "volcano_tpu/obs/audit.py",
+    "volcano_tpu/obs/slo.py",
 ]
 
 # Metrics-drift surface: every series in the registry must have a row
@@ -42,6 +45,11 @@ METRICS_FILES = {
     "metrics": "volcano_tpu/metrics/metrics.py",
     "doc": "docs/metrics.md",
 }
+
+# Anomaly-catalog surface (VCL601/602/603): every Anomaly reason the
+# runtime auditor can emit must have a docs/observability.md catalog
+# row and vice versa.
+ANOMALY_DOC = "docs/observability.md"
 
 SCHEMA_FILES = {
     "snapwire": "volcano_tpu/cache/snapwire.py",
@@ -154,6 +162,34 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
             src4 = m_src if key == "metrics" else d_src
             all_findings.extend(finish(rel, src4, by_path4.get(rel, [])))
 
+    # ---- anomaly catalog <-> docs drift ----------------------------
+    anom_sources = []
+    for rel in anomalycheck.SCAN_FILES:
+        path = root / rel
+        if path.is_file():
+            anom_sources.append((rel, path.read_text()))
+        else:
+            all_findings.append(Finding(
+                "VCL001", rel, 1,
+                "anomaly-catalog scan set names a missing file",
+            ))
+    try:
+        anom_doc = _read(ANOMALY_DOC, root)
+    except OSError as err:
+        all_findings.append(Finding(
+            "VCL001", ANOMALY_DOC, 1,
+            f"anomaly-catalog doc unreadable: {err}",
+        ))
+    else:
+        raw6 = anomalycheck.analyze(anom_sources, ANOMALY_DOC, anom_doc)
+        by_path6 = {}
+        for f in raw6:
+            by_path6.setdefault(f.path, []).append(f)
+        for rel, src6 in anom_sources + [(ANOMALY_DOC, anom_doc)]:
+            all_findings.extend(finish(
+                rel, src6, by_path6.get(rel, [])
+            ))
+
     # ---- report -----------------------------------------------------
     open_findings = [f for f in all_findings if not f.suppressed]
     suppressed = [f for f in all_findings if f.suppressed]
@@ -168,7 +204,8 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
         f"({len(sources)} lock files, "
         f"{sum(len(v) for v in hotpath.HOT_REGISTRY.values())} hot "
         f"functions, {len(aggcheck.CACHE_REGISTRY)} keyed caches, "
-        "1 schema/ABI surface, 1 metrics/docs surface)",
+        "1 schema/ABI surface, 1 metrics/docs surface, "
+        "1 anomaly-catalog surface)",
         file=out,
     )
     return 1 if open_findings else 0

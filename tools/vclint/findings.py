@@ -9,6 +9,8 @@ Finding codes (see docs/static_analysis.md for the full catalog):
 - VCL4xx  metrics <-> docs drift (registry vs docs/metrics.md)
 - VCL5xx  persistent cycle-aggregate cache contract (keyed on the
           mirror's mutation_seq/epoch/compact_gen machinery)
+- VCL6xx  anomaly-catalog drift (runtime-auditor reasons vs
+          docs/observability.md)
 
 Suppression convention: a finding is silenced by a trailing comment on
 the SAME line it is reported at, or by a comment-only line DIRECTLY
@@ -54,6 +56,9 @@ CODE_TITLES = {
     "VCL501": "_epoch_cached key missing the mirror epoch",
     "VCL502": "persistent cache missing its declared invalidation",
     "VCL503": "unregistered persistent cycle-aggregate cache",
+    "VCL601": "anomaly reason missing from docs/observability.md",
+    "VCL602": "catalogued anomaly reason never emitted",
+    "VCL603": "anomaly reason is not a string literal",
 }
 
 

@@ -387,6 +387,13 @@ class StoreMirror:
         # object-array cache keys on it, so the 100k-element np.fromiter
         # walk reruns only when a record actually moved.
         self.pod_obj_gen = 0  # guarded-by: _lock
+        # Conservation auditor (obs/audit.py, ISSUE 13), attached by
+        # the owning store: the dynamic-state writers below declare
+        # their pod-count flows through it (double-entry bookkeeping
+        # the cycle-end reconcile balances against the census).  None
+        # for bare mirrors in tests; the auditor is internally
+        # synchronized, so no extra locking here.
+        self.audit = None
 
     # ================================================================ pods
 
@@ -610,6 +617,10 @@ class StoreMirror:
                 # update dynamic state only.  The job link is re-derived —
                 # the podgroup controller back-annotates bare pods with a
                 # group name after the fact (pg_controller_handler.go:72-105).
+                if self.audit is not None:
+                    old = int(self.p_status[row])
+                    if old != status:
+                        self.audit.flow("pod-update", old, status)
                 self.p_status[row] = status
                 self.p_node[row] = node_row
                 self.p_node_name[row] = pod.node_name or None
@@ -643,6 +654,8 @@ class StoreMirror:
         self.p_pref_hi = _grow(self.p_pref_hi, n)
         self.p_node_name = _grow(self.p_node_name, n)
 
+        if self.audit is not None:
+            self.audit.flow_added(status)
         self.p_status[row] = status
         self.p_node[row] = node_row
         self.p_node_name[row] = pod.node_name or None
@@ -709,6 +722,8 @@ class StoreMirror:
         self.mutation_seq += 1
         self.mark_pod_dirty(row)
         self.pod_obj_gen += 1
+        if self.audit is not None and self.p_alive[row]:
+            self.audit.flow_removed(int(self.p_status[row]))
         self.p_alive[row] = False
         self.p_uid[row] = None
         self.p_node_name[row] = None
@@ -723,6 +738,10 @@ class StoreMirror:
         if row is not None:
             self.mutation_seq += 1
             self.mark_pod_dirty(row)
+            if self.audit is not None:
+                old = int(self.p_status[row])
+                if old != status:
+                    self.audit.flow("set-pod-state", old, status)
             self.p_status[row] = status
             self.p_node[row] = node_row
             self.p_node_name[row] = (
@@ -1177,7 +1196,13 @@ class StoreMirror:
         seq, gen = self.mutation_seq, self.compact_gen
         dseq = self.dirty_seq
         dirty, floor = self._node_dirty_rows, self._node_dirty_floor
+        audit = self.audit
         self.__dict__.update(fresh.__dict__)
+        # The auditor rides the STORE, not the table generation: row
+        # renumbering preserves the per-status census exactly (only
+        # tombstones drop), so conservation needs no re-anchor — the
+        # attached auditor itself must just survive the swap.
+        self.audit = audit
         self.mutation_seq = seq + 1
         self.compact_gen = gen + 1
         self._node_dirty_rows = dirty
@@ -1198,6 +1223,10 @@ class StoreMirror:
         # Every live row may change: per-row marking would cost as much
         # as the rebuild it exists to avoid.
         self.mark_pods_overflow()
+        if self.audit is not None:
+            # Bulk re-derive: per-row flow declaration would be a scan
+            # of its own; re-anchor the conservation census instead.
+            self.audit.reanchor("resync-status")
         for uid, row in self.p_row.items():
             pod = pods.get(uid)
             if pod is None:

@@ -217,10 +217,19 @@ class ClusterStore:
         # (the recorder's ring lock nests strictly inside _lock and is
         # never taken around store state); stdlib-only, so wiring them
         # unconditionally costs two small objects per store.
-        from ..obs import FlightRecorder, Tracer
+        from ..obs import Auditor, FlightRecorder, SLOTracker, Tracer
 
         self.tracer = Tracer()
         self.flight = FlightRecorder()
+        # Runtime conservation auditor + SLO layer (obs/audit.py,
+        # obs/slo.py, ISSUE 13): internally synchronized like the
+        # recorder (the auditor's lock nests strictly inside _lock and
+        # is never taken around store state).  The mirror's writers
+        # declare pod-count flows through mirror.audit; the fast cycle
+        # reconciles + samples at cycle end.
+        self.auditor = Auditor()
+        self.auditor.slo = SLOTracker()
+        self.mirror.audit = self.auditor
         # Monotonic pipelined solve-id: the flow link between a
         # dispatch span in cycle N and its commit spans in cycle N+1.
         self._solve_seq = 0  # guarded-by: _lock

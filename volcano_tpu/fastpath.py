@@ -301,6 +301,13 @@ class FastCycle:
         # the bench A/B (BENCH_HOST=1) measures the full surface.
         self._incr = incremental_on()
         self.derive_mode = aggr.refresh(m, Pn, Nn, R, self.n_alive)
+        # Sampled coherence audit of the refreshed planes (ISSUE 13):
+        # HERE, right after refresh, the persistent aggregates equal
+        # mirror truth by construction — by cycle end they lag the
+        # cycle's own commits, so this is the only honest audit point.
+        auditor = getattr(self.store, "auditor", None)
+        if auditor is not None and auditor.enabled:
+            auditor.audit_aggregates_now(m)
         # Device-lane incrementality (ISSUE 9): fold this derive's
         # changed-node capture into the store's DeviceIncremental — the
         # warm-shortlist diff is against the previous SOLVE, which may
@@ -849,17 +856,48 @@ class FastCycle:
             for keys, hosts, pods, entry in self._bind_batches:
                 store.dispatch_binds(keys, hosts, pods, entry=entry)
 
+    # ------------------------------------------------------------- audit
+
+    def _audit_flow(self, old_status: int, new_status: int,
+                    reason: str) -> None:
+        """Scalar conservation-flow declaration (obs/audit.py): the
+        per-row mirror status writers pair each write with one of
+        these, so the cycle-end reconcile can balance declared flows
+        against the census."""
+        a = getattr(self.store, "auditor", None)
+        if a is not None and a.enabled and old_status != new_status:
+            a.flow(reason, old_status, new_status)
+
+    def _audit_flow_rows(self, rows, new_status: int,
+                         reason: str) -> None:
+        """Bulk conservation-flow declaration for the vectorized
+        status writes — MUST be called before the ``p_status`` write
+        (it classifies the rows' old statuses)."""
+        a = getattr(self.store, "auditor", None)
+        if a is not None and a.enabled and len(rows):
+            a.flow_rows(self.m.p_status, rows, int(new_status), reason)
+
     def _record_cycle(self, t_wall: float, duration_s: float,
                       err: Optional[BaseException]) -> None:
-        """Seal this cycle into the store's flight recorder."""
+        """Run the cycle-end audits and seal this cycle into the
+        store's flight recorder."""
         from .obs.recorder import CycleRecord
 
         st = self.stats
+        # Runtime auditor (obs/audit.py, ISSUE 13): conservation
+        # reconcile + sampled coherence audits + SLO feed.  Runs even
+        # when no flight recorder is attached — the anomaly ring and
+        # counters are the production surface; the CycleRecord copy is
+        # the forensic one.
+        anoms = []
+        auditor = getattr(self.store, "auditor", None)
+        if auditor is not None and auditor.enabled:
+            anoms = auditor.end_cycle(self, duration_s, err)
         flight = getattr(self.store, "flight", None)
         if flight is None:
             self.tracer.drain()
             return
-        flight.record(CycleRecord(
+        seq = flight.record(CycleRecord(
             session=self.uid, path="fast", t_wall=t_wall,
             duration_s=duration_s, lanes=dict(self.lanes),
             pods_considered=int(st["considered"]),
@@ -878,7 +916,12 @@ class FastCycle:
             spans=self.tracer.drain(),
             rebalance=st.get("rebalance"),
             whatif=st.get("whatif"),
+            anomalies=[a.to_dict() for a in anoms],
         ))
+        # Stamp the ring copies with the flight seq, so an operator can
+        # walk /debug/anomalies -> /debug/cycles/<seq> for forensics.
+        for a in anoms:
+            a.cycle_seq = seq
 
     def _count_drops(self, reasons: Dict[str, int]) -> None:
         """Fold staleness-guard drop counts into the cycle stats and the
@@ -3262,6 +3305,7 @@ class FastCycle:
         # agree on what "changed" means (commit runs before this cycle's
         # dispatch captures its sequence, so the guard semantics are
         # unchanged).
+        self._audit_flow_rows(rows, ST_BOUND, "commit-bind")
         m.p_status[rows] = ST_BOUND
         m.p_node[rows] = nodes_c
         m.mark_pods_dirty(rows)
@@ -3490,6 +3534,7 @@ class FastCycle:
         self.n_ntasks -= np.bincount(
             nodes_f, minlength=self.Nn
         )[:self.Nn].astype(I)
+        self._audit_flow_rows(rows_f, ST_PENDING, "unbind")
         m.p_status[rows_f] = ST_PENDING
         m.p_node[rows_f] = -1
         m.p_node_name[rows_f] = None
@@ -3557,6 +3602,8 @@ class FastCycle:
                 placed = ni
                 break
             if placed is not None:
+                self._audit_flow(int(m.p_status[row]), ST_BOUND,
+                                 "backfill-bind")
                 m.p_status[row] = ST_BOUND
                 m.p_node[row] = placed
                 m.p_node_name[row] = m.n_name[placed]
@@ -3614,6 +3661,8 @@ class FastCycle:
                         kept.append((pod, hostname))
                         continue
                     jrow = self.jobr[row]
+                    self._audit_flow(int(m.p_status[row]), ST_PENDING,
+                                     "backfill-revert")
                     m.p_status[row] = ST_PENDING
                     self.n_ntasks[m.p_node[row]] -= 1
                     m.p_node[row] = -1

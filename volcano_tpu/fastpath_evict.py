@@ -140,6 +140,7 @@ class EvictState:
         m = c.m
         n = int(m.p_node[row])
         req = self.req[row]
+        c._audit_flow(int(m.p_status[row]), ST_RELEASING, "evict")
         m.p_status[row] = ST_RELEASING
         # Direct mirror status write: the incremental derive's dirty set
         # must see it (the action stamps mutation_seq at its end).
@@ -170,6 +171,7 @@ class EvictState:
         c = self.cyc
         m = c.m
         req = self.req[row]
+        c._audit_flow(int(m.p_status[row]), ST_RUNNING, "evict-revert")
         m.p_status[row] = ST_RUNNING
         m.mark_pod_dirty(row)
         c.n_releasing[n] -= req

@@ -325,6 +325,27 @@ class Metrics:
             "to host any task of the starved gang's profiles (0 = no "
             "stranded idle, 1 = fully idle yet useless)",
         )
+        self.audit_anomalies = _Counter(
+            f"{ns}_audit_anomalies_total",
+            "Runtime-auditor anomalies by catalogued reason "
+            "(obs/audit.py; docs/observability.md anomaly catalog).  "
+            "Nonzero means an invariant the scheduler relies on was "
+            "observed violated at runtime — a page, not a trend",
+        )
+        self.audit_cycles = _Counter(
+            f"{ns}_audit_cycles_total",
+            "Auditor cycle-end passes by mode: reconciled (census "
+            "compared against the declared flows), skipped (no flows, "
+            "unmoved mutation counter), or sampled (coherence audits "
+            "of the registered cache slots also ran)",
+        )
+        self.slo_burn_rate = _Gauge(
+            f"{ns}_slo_budget_burn_rate",
+            "Error-budget burn rate per SLO lane (obs/slo.py): "
+            "(fraction of window cycles over the declared target) / "
+            "allowed fraction.  >= 1.0 means the lane is consuming "
+            "its error budget faster than the SLO allows",
+        )
         # Registry-wide lock sharing: rebind every series to THIS
         # registry's lock (done before any concurrent use) so writers
         # serialize with expose_text's iteration.

@@ -1,7 +1,7 @@
 """Observability layer: trace spans, cycle flight recorder, Perfetto
-export (ISSUE 3).
+export (ISSUE 3), runtime conservation auditor + SLO layer (ISSUE 13).
 
-Three stdlib-only modules, importable without jax/numpy so the store and
+Five stdlib-only modules, importable without jax/numpy so the store and
 the HTTP service can wire them unconditionally:
 
 - ``trace``    — the low-overhead span API (``perf_counter_ns``; one
@@ -17,21 +17,37 @@ the HTTP service can wire them unconditionally:
 - ``export``   — Chrome/Perfetto ``trace_event`` JSON (loadable in
   ``chrome://tracing`` / https://ui.perfetto.dev), with flow arrows
   linking a pipelined solve's dispatch span in cycle N to its
-  fetch/commit spans in cycle N+1 via the solve-id.
+  fetch/commit spans in cycle N+1 via the solve-id, plus one instant
+  event per audit anomaly so correctness failures are visible on the
+  latency timeline.
+- ``audit``    — the always-on runtime conservation auditor (ISSUE
+  13): a double-entry ledger of pod-count flows reconciled against
+  mirror truth every cycle, sampled coherence audits of the registered
+  cache slots, the migration-ledger zero-lost-pods check, and the
+  anomaly ring behind ``/debug/anomalies``.
+- ``slo``      — per-lane latency windows with declared budgets and
+  error-budget burn tracking; breaches surface as auditor anomalies
+  and in ``/debug/health``.
 
 Consumers: ``service.py`` exposes ``/debug/cycles``,
-``/debug/cycles/<seq>`` and ``/debug/trace?cycles=K``; ``bench.py``
-writes one trace file per config and folds drop-reason totals plus
-per-lane p50/p95 into its machine-readable JSON tail.  docs/tracing.md
-documents all of it.
+``/debug/cycles/<seq>``, ``/debug/trace?cycles=K``, ``/debug/health``
+and ``/debug/anomalies``; ``bench.py`` writes one trace file per
+config and folds drop-reason totals, per-lane p50/p95, and the audit
+overhead block into its machine-readable JSON tail.  docs/tracing.md
+and docs/observability.md document all of it.
 """
 
+from .audit import Anomaly, Auditor
 from .recorder import CycleRecord, FlightRecorder
+from .slo import SLOTracker
 from .trace import SpanRecord, Tracer, null_tracer
 
 __all__ = [
+    "Anomaly",
+    "Auditor",
     "CycleRecord",
     "FlightRecorder",
+    "SLOTracker",
     "SpanRecord",
     "Tracer",
     "null_tracer",

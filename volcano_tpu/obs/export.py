@@ -81,6 +81,17 @@ def trace_events(records: Iterable) -> List[dict]:
                 "tid": _tid_of("cycle", tid_table),
                 "args": {"cycle_seq": rec.seq, "rows": count},
             })
+        # Audit anomalies (ISSUE 13): one process-scoped instant per
+        # finding, so a correctness failure is visible on the latency
+        # timeline at the cycle where it was detected.
+        for anom in getattr(rec, "anomalies", ()) or ():
+            events.append({
+                "name": f"anomaly:{anom.get('reason', '?')}",
+                "cat": "audit", "ph": "i", "s": "p", "ts": base_ts,
+                "pid": PID, "tid": _tid_of("cycle", tid_table),
+                "args": {"cycle_seq": rec.seq,
+                         "detail": anom.get("detail", {})},
+            })
 
     # Flow arrows: start at the chronologically first span of each flow,
     # finish at the last, step through the middle.

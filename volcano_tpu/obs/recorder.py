@@ -16,8 +16,9 @@ cycle 48231 drop 17 rows" investigation needs:
 - device crash / budget-degradation events,
 - mirror ``mutation_seq`` / node-table ``epoch`` at dispatch vs commit
   (how much the world moved during the overlap),
-- the dispatched and committed solve-ids (the cross-cycle link), and
-- the cycle's trace spans (``obs.trace``).
+- the dispatched and committed solve-ids (the cross-cycle link),
+- the cycle's trace spans (``obs.trace``), and
+- the runtime auditor's anomalies for the cycle (``obs.audit``).
 
 Concurrency: the cycle thread records (holding the store lock — the
 ring lock nests strictly inside it and is never taken around store
@@ -46,6 +47,7 @@ class CycleRecord:
         "committed_solve_id", "mutation_seq_at_dispatch",
         "mutation_seq_at_commit", "epoch_at_dispatch", "epoch_at_commit",
         "device_events", "error", "spans", "rebalance", "whatif",
+        "anomalies",
     )
 
     def __init__(self, session: str = "", path: str = "fast",
@@ -65,7 +67,8 @@ class CycleRecord:
                  error: Optional[str] = None,
                  spans: Optional[list] = None,
                  rebalance: Optional[dict] = None,
-                 whatif: Optional[dict] = None):
+                 whatif: Optional[dict] = None,
+                 anomalies: Optional[List[dict]] = None):
         self.seq = -1  # assigned by FlightRecorder.record
         self.session = session
         self.path = path
@@ -94,6 +97,9 @@ class CycleRecord:
         # volcano_tpu/whatif.py): action, outcome, gang uid, victim
         # counts.  None when neither lane planned anything.
         self.whatif = whatif
+        # Runtime-auditor findings for THIS cycle (ISSUE 13,
+        # obs/audit.py Anomaly.to_dict): empty on a healthy cycle.
+        self.anomalies = anomalies or []
 
     def to_dict(self, include_spans: bool = False) -> dict:
         d = {
@@ -122,6 +128,7 @@ class CycleRecord:
                           if self.rebalance is not None else None),
             "whatif": (dict(self.whatif)
                        if self.whatif is not None else None),
+            "anomalies": [dict(a) for a in self.anomalies],
         }
         if include_spans:
             d["spans"] = [s.to_dict() for s in self.spans]

@@ -351,6 +351,30 @@ class Service:
                             self._json(404, {"error": "no such cycle"})
                         else:
                             self._json(200, rec.to_dict(include_spans=True))
+                    elif parts[:2] == ["debug", "health"]:
+                        # Runtime-auditor verdict + armed verifiers +
+                        # SLO state (ISSUE 13).  Reads only the
+                        # auditor's own lock-guarded snapshots — NEVER
+                        # the store lock — so a scrape cannot block
+                        # the cycle thread (tests/test_audit.py pins
+                        # this under churn).
+                        auditor = getattr(service.store, "auditor",
+                                          None)
+                        if auditor is None:
+                            self._json(200, {"status": "no-auditor"})
+                        else:
+                            self._json(200, auditor.health())
+                    elif parts[:2] == ["debug", "anomalies"]:
+                        # The anomaly ring, oldest first; ?n=K limits.
+                        auditor = getattr(service.store, "auditor",
+                                          None)
+                        n_raw = parse_qs(url.query).get("n", [None])[0]
+                        n = int(n_raw) if n_raw is not None else None
+                        self._json(200, [
+                            a.to_dict()
+                            for a in (auditor.anomalies(n)
+                                      if auditor is not None else [])
+                        ])
                     elif parts[:2] == ["debug", "trace"]:
                         # Perfetto/chrome://tracing trace of the last K
                         # cycles (?cycles=K, default the whole ring).

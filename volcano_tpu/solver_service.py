@@ -1114,6 +1114,16 @@ class RemoteSolver:
             if self._pending is not handle:
                 raise RuntimeError("stale PendingSolve handle")
             self._pending = None
+            if self._sock is None:
+                # The connection died while this solve was parked
+                # (solver-child kill/restart between dispatch and
+                # fetch): the reply is unrecoverable.  Surface the
+                # standard lost-reply error the pipelined staleness
+                # machinery already handles — not an AttributeError
+                # on the dead socket slot.
+                raise ConnectionError(
+                    "solver connection closed while a solve was "
+                    "in flight")
             try:
                 return recv_frame(self._sock)
             except (OSError, ConnectionError, ValueError):
