@@ -183,7 +183,7 @@ def _collect_audit(store):
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
           records=None, fallbacks=None, rebalance=None, devincr=None,
           wire=None, preempt=None, compile_ms=None, warmup_cycles=None,
-          composed=None, endurance=None):
+          composed=None, endurance=None, pool=None):
     global _AUDIT_TAIL
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
@@ -237,6 +237,11 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # verdict, fault-wave counts, p99s vs budgets, audit overhead
         # (docs/observability.md).
         payload["endurance"] = dict(endurance)
+    if pool:
+        # BENCH_POOL tail (ISSUE 15): hedge dispatches/wins, failovers,
+        # per-replica frame counts, device-lane percentiles, lost-pod
+        # and anomaly verdicts per pool size (docs/tuning.md).
+        payload["pool"] = dict(pool)
     if _AUDIT_TAIL is not None:
         # Runtime-auditor block (ISSUE 13): sampled cycles + measured
         # overhead ride every tail, so any bench row doubles as an
@@ -1165,6 +1170,40 @@ tiers:
 """
 
 
+def _restart_pool_member(servers, idx, victim, reason):
+    """Kill + restart pool member ``idx`` (the ISSUE 15 fault legs):
+    sever the replica's live connection FIRST (the server's conn
+    thread exits on the dead socket and releases the established
+    tuple), drop the listener, rebind the same port with a bounded
+    retry, carry the straggler hook over, and respawn the serve
+    thread.  When the kernel keeps the old tuple a fresh ephemeral
+    port is still a faithful child restart — the replica is RETARGETED
+    so its next reconnect dials the new port instead of the dead one
+    (the heal assertions depend on the reconnect actually landing)."""
+    import threading as _threading
+
+    from volcano_tpu.solver_service import SolverServer
+
+    vport = servers[idx].port
+    with victim._lock:
+        victim._close_locked(reason)
+    servers[idx].shutdown()
+    ns = None
+    for _attempt in range(50):
+        try:
+            ns = SolverServer(port=vport)
+            break
+        except OSError:
+            time.sleep(0.1)
+    if ns is None:
+        ns = SolverServer(port=0)
+        victim.port = ns.port
+    ns.solve_delay_fn = servers[idx].solve_delay_fn
+    servers[idx] = ns
+    _threading.Thread(target=ns.serve_forever, daemon=True).start()
+    return ns
+
+
 def config_endurance():
     """BENCH_ENDURANCE=1 (ISSUE 13): the compressed-hours survival gate.
 
@@ -1238,12 +1277,45 @@ def config_endurance():
     st_bound = int(TaskStatus.Bound)
     st_running = int(TaskStatus.Running)
 
-    # Solver child over real loopback TCP, so the kill wave severs a
-    # real connection (BENCH_ENDURANCE_WIRE=0 keeps the in-process
-    # solver; the kill wave then no-ops).
+    # Solver child(ren) over real loopback TCP, so the kill wave severs
+    # real connections (BENCH_ENDURANCE_WIRE=0 keeps the in-process
+    # solver; the kill wave then no-ops).  BENCH_ENDURANCE_POOL=<n>
+    # (>= 2) is the pool leg (ISSUE 15): n servers behind a SolverPool,
+    # a mild straggler on replica 0 with tight hedge knobs so hedges
+    # fire regularly, and kill waves that hit RANDOM pool members — so
+    # some kills land mid-hedge.  Default 1 keeps the historic
+    # single-connection harness byte-for-byte.
     server = client = None
+    servers = []
+    pool_n = 1
+    try:
+        pool_n = max(1, int(os.environ.get("BENCH_ENDURANCE_POOL",
+                                           "1")))
+    except ValueError:
+        pool_n = 1
     wire_on = os.environ.get("BENCH_ENDURANCE_WIRE", "1") != "0"
-    if wire_on:
+    if wire_on and pool_n > 1:
+        import random as _random
+
+        from volcano_tpu.solver_pool import SolverPool
+        from volcano_tpu.solver_service import SolverServer
+
+        os.environ.setdefault("VOLCANO_TPU_POOL_HEDGE_P99_MULT", "2.0")
+        os.environ.setdefault("VOLCANO_TPU_POOL_HEDGE_MIN_MS", "20")
+        for k in range(pool_n):
+            srv = SolverServer(port=0)
+            if k == 0:
+                # Mild periodic straggle: enough to trigger hedges,
+                # small enough to keep the calibrated budgets honest.
+                srv.solve_delay_fn = (
+                    lambda i: 0.06 if i % 7 == 0 else 0.0)
+            _threading.Thread(target=srv.serve_forever,
+                              daemon=True).start()
+            servers.append(srv)
+        client = SolverPool([f"127.0.0.1:{s.port}" for s in servers])
+        store.remote_solver = client
+        _kill_rng = _random.Random(5)
+    elif wire_on:
         from volcano_tpu.solver_service import RemoteSolver, SolverServer
 
         server = SolverServer(port=0)
@@ -1422,7 +1494,19 @@ def config_endurance():
             if i >= teardown:
                 _teardown_wave(gname)
                 wave_groups.remove((gname, teardown))
-        if i in kill_at and server is not None:
+        if i in kill_at and servers:
+            # Pool leg (ISSUE 15): kill/restart a RANDOM member — the
+            # straggler + tight hedge knobs keep hedges in flight, so
+            # some kills land mid-hedge.  The severed replica's reply
+            # rides the lost-reply machinery (or the hedge winner
+            # commits in its place); its reconnect ships a full frame
+            # and deltas re-engage per replica.
+            kills += 1
+            idx = _kill_rng.randrange(len(servers))
+            _restart_pool_member(servers, idx,
+                                 client.replicas[idx].client,
+                                 "endurance-kill")
+        elif i in kill_at and server is not None:
             # Solver-child kill: restart the server AND sever the live
             # connection, so the per-connection wire mirror + devincr
             # caches die with it; the client reconnect must heal to a
@@ -1500,6 +1584,12 @@ def config_endurance():
         "wire": ({"frames": dict(client.frame_counts),
                   "fallbacks": dict(client.wire_fallbacks)}
                  if client is not None else None),
+        # Pool leg (ISSUE 15): per-replica health + hedge/failover
+        # totals, so the gate's tail proves random-member kills healed
+        # with the pool still hedging.  (client is None under
+        # BENCH_ENDURANCE_WIRE=0 regardless of the pool knob.)
+        "pool": (client.health_snapshot()
+                 if pool_n > 1 and client is not None else None),
     }
     _collect_audit(store)
     _emit(
@@ -1520,10 +1610,187 @@ def config_endurance():
     if server is not None:
         server.shutdown()
         time.sleep(0.2)
+    for srv in servers:
+        srv.shutdown()
+    if servers:
+        time.sleep(0.2)
     if anoms:
         print(f"# ENDURANCE FAILED: {anoms} anomalies "
               f"({by_reason})", file=sys.stderr)
         raise SystemExit(1)
+
+
+def config_pool():
+    """BENCH_POOL=1 (ISSUE 15): solver replica pool A/B — pool sizes
+    {1,2,3} over in-process ``SolverServer``s with an injected
+    straggler + kill fault schedule.
+
+    Every server straggles (``BENCH_POOL_STRAGGLE_MS``, default 250 ms,
+    on every ``BENCH_POOL_STRAGGLE_EVERY``-th solve, default 5) so
+    health-scored routing alone cannot dodge the tail — the pool=2/3
+    rows isolate what HEDGING buys.  Mid-run, a random replica is
+    killed and restarted (connection severed + listener rebound), so
+    every row also pays one lost-reply re-place; the tail proves the
+    kill cost exactly that (zero lost pods, failover counted, the
+    killed replica's deltas re-engaged after its full-frame reconnect).
+
+    Per size, one JSON row: steady pipelined cycle p50 plus a "pool"
+    tail — hedge dispatches/wins, failovers, per-replica frame counts,
+    the killed replica's post-restart frames, device-lane p50/p99 (the
+    acceptance number: pool=2 hedging must cut device p99 >= 20% vs
+    pool=1 under this schedule), lost pods, and the anomaly verdict.
+    """
+    import threading as _threading
+
+    import numpy as _np
+
+    from volcano_tpu.api import TaskStatus
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.solver_pool import SolverPool
+    from volcano_tpu.solver_service import SolverServer
+    from volcano_tpu.synth import synthetic_cluster
+
+    n_nodes = int(os.environ.get("BENCH_NODES", 256))
+    n_pods = int(os.environ.get("BENCH_PODS", 2048))
+    cycles = max(int(os.environ.get("BENCH_POOL_CYCLES", "40")), 20)
+    straggle_s = float(os.environ.get("BENCH_POOL_STRAGGLE_MS",
+                                      "250")) / 1e3
+    straggle_every = max(
+        int(os.environ.get("BENCH_POOL_STRAGGLE_EVERY", "5")), 2)
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_POOL_SIZES", "1,2,3").split(",") if s.strip()]
+    # The straggler delays are real wall time; hedge past a tight
+    # deadline so the A/B exercises the lane (operators tune these in
+    # docs/tuning.md "Solver replica pool").
+    os.environ.setdefault("VOLCANO_TPU_POOL_HEDGE_P99_MULT", "3.0")
+    os.environ.setdefault("VOLCANO_TPU_POOL_HEDGE_MIN_MS", "25")
+    st_bound = int(TaskStatus.Bound)
+
+    def _spawn(k):
+        servers = []
+        for _ in range(k):
+            server = SolverServer(port=0)
+            server.solve_delay_fn = (
+                lambda i: straggle_s if i % straggle_every == 0
+                else 0.0)
+            _threading.Thread(target=server.serve_forever,
+                              daemon=True).start()
+            servers.append(server)
+        return servers
+
+    for size in sizes:
+        servers = _spawn(size)
+        pool = SolverPool(
+            [f"127.0.0.1:{s.port}" for s in servers], size=size)
+        store = synthetic_cluster(n_nodes=n_nodes, n_pods=n_pods,
+                                  gang_size=4, seed=3)
+        store.pipeline = True
+        store.async_bind = os.environ.get("BENCH_SYNC_BIND") != "1"
+        store.remote_solver = pool
+
+        def feed(fc):
+            m = fc.m
+            rows = _np.flatnonzero(
+                (m.p_status[:fc.Pn] == st_bound) & m.p_alive[:fc.Pn]
+            )
+            if len(rows):
+                fc._unbind_rows(rows[:max(1, len(rows) // 20)])
+
+        store.cycle_feed = feed
+        sched = Scheduler(store, conf_str=CONF_BASE)
+        warm = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            sched.run_once()
+            warm.append(time.perf_counter() - t0)
+        kill_at = cycles // 2
+        killed = 0
+        post_kill0 = None
+        times = []
+        for i in range(cycles):
+            if i == kill_at:
+                # Kill + restart the CURRENT PRIMARY mid-stream — the
+                # member carrying the in-flight allocate stream, the
+                # case the acceptance bar pins (the severed reply costs
+                # at most one cycle's lost-reply re-place, or a
+                # mid-hedge rescue + failover).  A random member can be
+                # sitting idle under health-scored routing, making the
+                # kill free and the failover assertion vacuous.  The
+                # tail snapshots its frame counters so deltas provably
+                # re-engage afterwards.
+                with pool._lock:
+                    killed = pool._primary
+                victim = pool.replicas[killed].client
+                _restart_pool_member(servers, killed, victim,
+                                     "pool-kill")
+                post_kill0 = dict(victim.frame_counts)
+            t0 = time.perf_counter()
+            sched.run_once()
+            times.append(time.perf_counter() - t0)
+        store.cycle_feed = None
+        for _ in range(3):
+            sched.run_once()
+        store.flush_binds()
+        m = store.mirror
+        lost = sum(
+            1 for r in range(m.n_pods)
+            if m.p_uid[r] is not None and m.p_alive[r]
+            and int(m.p_status[r]) != st_bound
+        )
+        recs = store.flight.recent()[-len(times):]
+        dev = sorted(
+            rec.lanes.get("device", 0.0) * 1e3 for rec in recs)
+
+        def pct(q):
+            return round(dev[min(int(q * (len(dev) - 1) + 0.5),
+                                 len(dev) - 1)], 2)
+
+        h = pool.health_snapshot()
+        kc = pool.replicas[killed].client.frame_counts
+        drops = {}
+        for rec in recs:
+            for reason, n in rec.drop_reasons.items():
+                drops[reason] = drops.get(reason, 0) + n
+        tail = {
+            "size": size,
+            "straggle_ms": round(straggle_s * 1e3, 1),
+            "straggle_every": straggle_every,
+            "hedge_dispatches": h["hedge_dispatches"],
+            "hedge_wins": h["hedge_wins"],
+            "failovers": h["failovers"],
+            "per_replica_frames": pool.per_replica_frames(),
+            "killed_replica": killed,
+            "post_kill_frames": {
+                k: kc[k] - (post_kill0 or {}).get(k, 0)
+                for k in kc
+            },
+            "device_p50_ms": pct(0.50),
+            "device_p99_ms": pct(0.99),
+            "lost_reply_rows": drops.get("lost-reply", 0),
+            "lost_pods": lost,
+            "anomalies": store.auditor.total_anomalies(),
+        }
+        _collect_audit(store)
+        times_ms = sorted(t * 1e3 for t in times)
+        _emit(
+            f"Solver pool A/B @ {n_nodes} nodes x {n_pods} pods "
+            f"(pool={size}, straggler "
+            f"{straggle_s * 1e3:.0f}ms/{straggle_every})",
+            times_ms[len(times_ms) // 2], n_pods,
+            f"device_p99={tail['device_p99_ms']}ms "
+            f"hedges={tail['hedge_dispatches']} "
+            f"wins={tail['hedge_wins']} "
+            f"failovers={tail['failovers']} lost_pods={lost}",
+            lanes=store.last_cycle_lanes,
+            records=recs,
+            pool=tail,
+            compile_ms=sum(warm) * 1e3,
+        )
+        store.close()
+        pool.close()
+        for s in servers:
+            s.shutdown()
+        time.sleep(0.2)
 
 
 def _round_frac(f):
@@ -1633,6 +1900,12 @@ def main():
         # waves with the runtime auditor on; exits nonzero on any
         # anomaly (hack/run-endurance.sh, docs/observability.md).
         config_endurance()
+        return
+    if os.environ.get("BENCH_POOL"):
+        # Solver replica pool A/B (ISSUE 15): pool sizes {1,2,3} under
+        # an injected straggler + kill schedule; the pool tails carry
+        # hedge/failover counts and device-lane p50/p99 per size.
+        config_pool()
         return
     mesh_raw = os.environ.get("BENCH_MESH")
     if mesh_raw:

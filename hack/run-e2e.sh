@@ -80,6 +80,39 @@ assert ratio > 2, f"delta frames did not shrink the wire: {ratio:.1f}x"
 print(f"BENCH_WIRE smoke OK ({len(rows)} rows, {ratio:.1f}x fewer "
       "bytes/cycle on deltas)")
 '
+# BENCH_POOL smoke (ISSUE 15): the solver replica pool A/B at a small
+# shape under the injected straggler + kill schedule — asserts pool=2
+# hedging cuts the device-lane p99 >= 20% vs pool=1, the mid-stream
+# replica kill heals with deltas re-engaged (post-restart full frame
+# then deltas on the killed replica) at the cost of at most one
+# cycle's lost-reply re-place, and zero pods are lost (0 anomalies).
+BENCH_POOL=1 BENCH_NODES=128 BENCH_PODS=1024 BENCH_POOL_CYCLES=24 \
+  BENCH_POOL_SIZES=1,2 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+tails = {r["pool"]["size"]: r["pool"] for r in rows if "pool" in r}
+assert set(tails) == {1, 2}, f"missing pool sizes: {sorted(tails)}"
+p1, p2 = tails[1], tails[2]
+assert p2["hedge_dispatches"] >= 1, p2
+assert p2["hedge_wins"] >= 1, p2
+assert p2["device_p99_ms"] <= 0.8 * p1["device_p99_ms"], (
+    "hedging did not cut device p99 >= 20%%: pool1=%s pool2=%s"
+    % (p1["device_p99_ms"], p2["device_p99_ms"]))
+for size, t in tails.items():
+    assert t["lost_pods"] == 0, f"pool={size} lost pods: {t}"
+    assert t["anomalies"] == 0, f"pool={size} anomalies: {t}"
+    # The killed replica healed: its post-restart stream is a full
+    # frame followed by re-engaged deltas.
+    pk = t["post_kill_frames"]
+    assert pk.get("full", 0) >= 1 and pk.get("delta", 0) >= 1, t
+assert p2["failovers"] + p2["lost_reply_rows"] >= 1, p2
+cut = 100 * (1 - p2["device_p99_ms"] / p1["device_p99_ms"])
+print("BENCH_POOL smoke OK (device p99 %.0fms -> %.0fms, %.0f%% cut, "
+      "%s hedges / %s wins)" % (p1["device_p99_ms"], p2["device_p99_ms"],
+                                cut, p2["hedge_dispatches"],
+                                p2["hedge_wins"]))
+'
 # BENCH_PREEMPT smoke (ISSUE 11): the device-native preempt lane on a
 # small fragmented-priority cluster — asserts the DEVICE lane actually
 # engaged (a committed what-if plan + evictions through the shared
@@ -167,13 +200,16 @@ off = run(False)
 assert on == off, "composed binds differ from the everything-off run"
 print(f"composed bind parity OK ({len(on)} pods bit-for-bit)")
 '
-# Endurance smoke (ISSUE 13): >= 200 churn cycles at a small shape
-# with the full fault schedule — a mid-run solver-child kill/restart,
+# Endurance smoke (ISSUE 13 + the ISSUE 15 pool leg): >= 200 churn
+# cycles at a small shape with the full fault schedule — mid-run
+# kill/restarts of RANDOM solver-pool members (a straggler + tight
+# hedge knobs keep hedges in flight, so kills can land mid-hedge),
 # node flaps, preempt waves, and enough lifecycle churn to force at
 # least one real pod-table compaction — auditors on every cycle.  The
 # gate exits nonzero on any anomaly; the tail assertion additionally
-# proves the faults actually fired and the audit verdict is clean.
-BENCH_ENDURANCE=1 BENCH_NODES=64 BENCH_PODS=1024 \
+# proves the faults actually fired and the audit verdict is clean
+# (0 anomalies = conservation held = zero lost pods).
+BENCH_ENDURANCE=1 BENCH_ENDURANCE_POOL=2 BENCH_NODES=64 BENCH_PODS=1024 \
   BENCH_ENDURANCE_CYCLES=200 BENCH_ENDURANCE_DELETE_FRAC=0.03 \
   VOLCANO_TPU_AUDIT_SAMPLE=8 JAX_PLATFORMS=cpu \
   python bench.py | python -c '
@@ -187,11 +223,15 @@ assert e["cycles"] >= 200, e
 assert e["solver_kills"] >= 1, f"no solver kill exercised: {e}"
 assert e["compactions"] >= 1, f"no compaction exercised: {e}"
 assert e["node_flaps"] >= 1 and e["preempt_waves"] >= 1, e
+p = e.get("pool")
+assert p and p["size"] == 2, f"pool leg did not engage: {e}"
+assert p["hedge_dispatches"] >= 1, f"no hedge exercised: {p}"
 audits = [r["audit"] for r in rows if "audit" in r]
 assert audits and audits[0]["sampled_cycles"] >= 1, audits
 c, k, n = e["cycles"], e["solver_kills"], e["compactions"]
-print(f"endurance smoke OK ({c} cycles, {k} kills, "
-      f"{n} compactions, 0 anomalies)")
+h = p["hedge_dispatches"]
+print(f"endurance smoke OK ({c} cycles, {k} pool-member kills, "
+      f"{h} hedges, {n} compactions, 0 anomalies)")
 '
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \

@@ -18,5 +18,23 @@ cd "$(dirname "$0")/.."
 : "${VOLCANO_TPU_AUDIT_SAMPLE:=16}"
 export BENCH_ENDURANCE_CYCLES VOLCANO_TPU_AUDIT_SAMPLE
 
-BENCH_ENDURANCE=1 python bench.py "$@"
+# The first leg pins the HISTORIC single-connection path regardless of
+# how the pool leg below is sized — without the explicit pool=1 an
+# exported BENCH_ENDURANCE_POOL>=2 would silently turn this into a
+# second pool run and leave the single-connection path ungated.
+BENCH_ENDURANCE=1 BENCH_ENDURANCE_POOL=1 python bench.py "$@"
 echo "endurance gate OK (0 anomalies)"
+
+# Pool leg (ISSUE 15): the same churn + fault schedule over a 2-replica
+# solver pool — kill waves hit RANDOM members while a straggler keeps
+# hedges in flight (so kills can land mid-hedge); exits nonzero on any
+# anomaly (0 anomalies = conservation held = zero lost pods).  Skip
+# with BENCH_ENDURANCE_POOL=1; size with BENCH_ENDURANCE_POOL=<n>.
+: "${BENCH_ENDURANCE_POOL:=2}"
+export BENCH_ENDURANCE_POOL
+if [ "${BENCH_ENDURANCE_POOL}" -gt 1 ]; then
+  BENCH_ENDURANCE=1 \
+    BENCH_ENDURANCE_CYCLES=$(( BENCH_ENDURANCE_CYCLES / 2 > 150 \
+      ? BENCH_ENDURANCE_CYCLES / 2 : 150 )) python bench.py "$@"
+  echo "endurance pool leg OK (0 anomalies, pool=${BENCH_ENDURANCE_POOL})"
+fi

@@ -478,6 +478,46 @@ def test_queue_close_open_lifecycle_via_commands():
     assert store.batch_jobs["default/j2"].status.state.phase == "Running"
 
 
+def test_sync_queue_compacts_stale_podgroups():
+    """syncQueue's stale-member handling (the reference's NotFound
+    branch, queue_controller_action.go:44-56; PARITY.md "Queue
+    controller"): a PodGroup uid in the controller's per-queue index
+    whose record is GONE from the store (the delete event raced or was
+    lost — exactly the window the reference's "check NotFound error
+    and sync local cache" comment covers) is deleted from the index
+    during sync, the status counts exclude it, and the compaction
+    sticks — a later sync sees the compacted membership."""
+    from volcano_tpu.api import PodGroup, Queue
+    from volcano_tpu.controllers import Command
+
+    store, cm, sched, sim = make_env()
+    store.add_queue(Queue(name="batch", weight=1))
+    for i in range(3):
+        store.add_pod_group(PodGroup(name=f"pg{i}", queue="batch"))
+    qc = cm.queue_controller
+    qc.process_all()
+    assert qc.status["batch"].pending == 3
+    assert qc._pg_list("batch") == {"default/pg0", "default/pg1",
+                                    "default/pg2"}
+    # Remove a PodGroup from the system of record WITHOUT the delete
+    # event (pop the raw map, no _notify): the index now holds a
+    # stale uid — the reference's informer-cache NotFound window.
+    store.pod_groups.pop("default/pg1")
+    store.add_command(Command(action="SyncQueue", target_kind="Queue",
+                              target_name="batch"))
+    cm.process()
+    # The sync Get() missed -> local cache compacted, counts exclude
+    # the stale member, queue state untouched (still Open).
+    assert qc._pg_list("batch") == {"default/pg0", "default/pg2"}
+    assert qc.status["batch"].pending == 2
+    assert store.raw_queues["batch"].state == "Open"
+    # The compaction is durable: a second sync re-counts the same.
+    store.add_command(Command(action="SyncQueue", target_kind="Queue",
+                              target_name="batch"))
+    cm.process()
+    assert qc.status["batch"].pending == 2
+
+
 @pytest.mark.parametrize("event,action,expected_phase", [
     ("PodFailed", "RestartJob", "Running"),    # restarts back to Running
     ("PodFailed", "AbortJob", "Aborted"),

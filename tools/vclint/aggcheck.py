@@ -83,6 +83,12 @@ SCAN_FILES: Sequence[str] = (
     "volcano_tpu/fastpath.py",
     "volcano_tpu/fastpath_incr.py",
     "volcano_tpu/cache/store.py",
+    # Solver-pool surface (ISSUE 15): the pool deliberately holds NO
+    # cache-shaped slots — per-replica wire caches live inside each
+    # RemoteSolver and the hedge's frozen frame dies with its handle —
+    # but scanning the file keeps that true (a future pool-held
+    # ``_*_cache`` must register its invalidation story here).
+    "volcano_tpu/solver_pool.py",
 )
 
 # Cache-shaped attributes that are deliberately NOT persistent (cycle-

@@ -30,6 +30,7 @@ LOCK_FILES = [
     "volcano_tpu/pipeline.py",
     "volcano_tpu/scheduler.py",
     "volcano_tpu/solver_service.py",
+    "volcano_tpu/solver_pool.py",
     "volcano_tpu/fastpath.py",
     "volcano_tpu/fastpath_evict.py",
     "volcano_tpu/whatif.py",

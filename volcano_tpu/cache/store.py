@@ -212,6 +212,16 @@ class ClusterStore:
         # delete_pod hook below restores terminating victims through it.
         self.migrations = None
 
+        # Remote-solver client: a solver_service.RemoteSolver (single
+        # connection) or a solver_pool.SolverPool (N replicas with
+        # hedged dispatch / failover / what-if offload, ISSUE 15) —
+        # attached by Service/bench/tests, None for local-solve stores.
+        # Dispatch and fetch run only on the cycle thread; both client
+        # types synchronize their own internals (each holds its own
+        # lock, never the store's), so the slot needs no store-lock
+        # guard beyond the cycle thread's ownership.
+        self.remote_solver = None
+
         # Observability (obs/, ISSUE 3): the per-store span tracer and
         # the cycle flight recorder.  Both are internally synchronized
         # (the recorder's ring lock nests strictly inside _lock and is

@@ -257,9 +257,19 @@ class QueueController:
         for uid in self._pg_list(queue.name):
             pg = self.store.pod_groups.get(uid)
             if pg is None:
-                # TODO-parity: the reference leaves a comment ("check
-                # NotFound error and sync local cache"); the rebuild
-                # compacts the index here.
+                # Parity: the reference's syncQueue Get()s each member
+                # and, on a NotFound error, deletes it from its local
+                # podGroups cache before counting on
+                # (queue_controller_action.go:44-56 — the code behind
+                # its "check NotFound error and sync local cache"
+                # comment).  A store miss IS our NotFound, and the
+                # compaction below is that cache delete: the stale uid
+                # leaves the index, the counts exclude it, and the
+                # post-compaction member count feeds the state closure
+                # exactly as n_pgs does there.  Pinned by
+                # tests/test_controllers.py
+                # test_sync_queue_compacts_stale_podgroups; PARITY.md
+                # "Queue controller" row.
                 stale.append(uid)
                 continue
             phase = pg.status.phase

@@ -916,6 +916,7 @@ class FastCycle:
             spans=self.tracer.drain(),
             rebalance=st.get("rebalance"),
             whatif=st.get("whatif"),
+            pool=st.get("pool"),
             anomalies=[a.to_dict() for a in anoms],
         ))
         # Stamp the ring copies with the flight seq, so an operator can
@@ -957,6 +958,19 @@ class FastCycle:
         self.stats["shortlist_fallbacks"] = (
             int(self.stats.get("shortlist_fallbacks", 0))
             + exhausted + affinity)
+
+    def _record_pool_fetch(self) -> None:
+        """Fold the solver pool's last-fetch info (winning replica,
+        hedge/failover flags, wait — solver_pool.SolverPool) into the
+        cycle's flight record.  Plain RemoteSolver stores carry no
+        pool info and record nothing."""
+        take = getattr(getattr(self.store, "remote_solver", None),
+                       "take_last_fetch_info", None)
+        if take is None:
+            return
+        info = take()
+        if info:
+            self.stats["pool"] = info
 
     def _devincr_drop_skip(self) -> None:
         """Void the null-delta skip proof: the previously dispatched
@@ -1869,6 +1883,7 @@ class FastCycle:
                     f"{fails}/{self.REMOTE_FETCH_FAIL_CAP}"
                 )
                 self._devincr_drop_skip()
+                self._record_pool_fetch()
                 return
             if self._is_device_crash(e):
                 # Execution-time crashes surface at the async fetch,
@@ -1894,6 +1909,7 @@ class FastCycle:
         self.store._remote_fetch_fails = 0
         self.stats["committed_solve_id"] = inflight.solve_id or None
         self._count_shortlist_fb(*inflight.fallbacks)
+        self._record_pool_fetch()
         if inflight.kind == "remote":
             # The child reported its device-incremental decision in the
             # reply manifest (decoded by the fetch above).
@@ -3776,13 +3792,20 @@ class FastCycle:
         store = self.store
         if not rebalance_enabled():
             return
-        if getattr(store, "remote_solver", None) is not None:
-            # The what-if solve runs on the scheduler's own backend;
-            # remote-solver deployments keep the lane off.  A mesh is
-            # fine since ISSUE 11: the engine's hypothetical patches
-            # touch only per-cycle host planes, so the sharded devsnap
-            # dispatch carries them unchanged.
-            return
+        remote = getattr(store, "remote_solver", None)
+        if remote is not None:
+            from . import whatif
+
+            if not whatif.whatif_offload_on(remote):
+                # Single-connection remote deployments keep the lane
+                # off (the plan solve would contend for the one strict
+                # request/reply connection); a solver POOL with an
+                # idle non-primary replica offloads the plan solve
+                # there instead (ISSUE 15).  A mesh is fine since
+                # ISSUE 11: the engine's hypothetical patches touch
+                # only per-cycle host planes, so the sharded devsnap
+                # dispatch carries them unchanged.
+                return
         ledger = store.migrations
         if ledger is not None and ledger.active(store, "rebalance"):
             # One REBALANCE wave at a time: budgets stay trivially

@@ -301,8 +301,10 @@ class Metrics:
             "rejected-budget (per-PodGroup disruption budgets blocked "
             "an otherwise sufficient wave), stale-voided (store "
             "mutated between the pipelined plan dispatch and its "
-            "commit).  Rebalance outcomes also count in the historical "
-            "volcano_rebalance_plans_total series",
+            "commit), lost-reply (an offloaded plan solve's reply "
+            "died with its pool replica; the plan mutated nothing "
+            "and re-forms).  Rebalance outcomes also count in the "
+            "historical volcano_rebalance_plans_total series",
         )
         self.preempt_evictions = _Counter(
             f"{ns}_preempt_evictions_total",
@@ -324,6 +326,38 @@ class Metrics:
             "planning pass: fraction of idle stranded on nodes unable "
             "to host any task of the starved gang's profiles (0 = no "
             "stranded idle, 1 = fully idle yet useless)",
+        )
+        self.solver_pool_dispatch = _Counter(
+            f"{ns}_solver_pool_dispatch_total",
+            "Solver-pool frame dispatches by replica and kind: "
+            "primary (the health-scored allocate-lane target), hedge "
+            "(the identical frame re-dispatched to a second replica "
+            "after the primary's reply exceeded its rolling-p99 "
+            "deadline), or whatif (a plan-proving solve offloaded to "
+            "an idle non-primary replica)",
+        )
+        self.solver_pool_failover = _Counter(
+            f"{ns}_solver_pool_failover_total",
+            "Solver-pool primary changes away from a failed replica: "
+            "the previous primary's dispatch or fetch failed and the "
+            "next dispatch routed to a healthy replica (whose first "
+            "frame ships full by construction — deltas re-engage "
+            "after it)",
+        )
+        self.solver_pool_hedge_wins = _Counter(
+            f"{ns}_solver_pool_hedge_wins_total",
+            "Hedged solver-pool dispatches whose hedge reply landed "
+            "(and committed) before the straggling primary's; the "
+            "loser's reply is drained later, keeping its mirror "
+            "coherent via ack_gen",
+        )
+        self.solver_pool_replica_health = _Gauge(
+            f"{ns}_solver_pool_replica_health",
+            "Per-replica solver-pool health score: 1 / (1 + "
+            "consecutive failures) — 1.0 is healthy, decaying toward "
+            "0 as dispatch/fetch failures accumulate; failed replicas "
+            "are re-probed on a doubling cooldown and snap back to "
+            "1.0 when the probe succeeds",
         )
         self.audit_anomalies = _Counter(
             f"{ns}_audit_anomalies_total",

@@ -74,9 +74,16 @@ ADDED = -1
 GONE = -2
 
 # Census width: raw status values are clipped into [0, CENSUS_W).
-# TaskStatus values are single digits; 64 leaves headroom plus an
-# aliasing bucket that would itself show up as a mismatch.
-CENSUS_W = 64
+# TaskStatus values are BIT FLAGS up to 1 << 9 = 512 (api/types.py), so
+# the width must clear 512; 1024 leaves headroom plus an aliasing
+# bucket that would itself show up as a mismatch.  (64 — the original
+# "single digits" assumption — silently aliased Releasing (1 << 6)
+# into the clip bucket while the declared flow kept the raw class, so
+# any cycle ending with an evicted-but-not-yet-terminated pod reported
+# a phantom conservation-mismatch.  Unreachable before ISSUE 15: the
+# device-native evict lanes were off for remote stores, and the local
+# suites never asserted anomaly counts across a grace window.)
+CENSUS_W = 1024
 
 DEFAULT_SAMPLE = 64
 DEFAULT_RING = 256
@@ -214,10 +221,12 @@ class Auditor:
         # Anomalies found mid-cycle (the derive-time aggregate audit),
         # drained into the cycle's end_cycle batch.  # guarded-by: _lock
         self._pending: List[Anomaly] = []
-        # id() of the remote-solver client the wire sentinel last
-        # audited: a replaced client restarts its generation, which
-        # must re-anchor, not read as a regression.  # guarded-by: _lock
-        self._wire_client = None
+        # id() of the remote-solver client each wire sentinel slot
+        # ("wire-mirror" single client, "wire-mirror-<i>" pool
+        # replicas) last audited: a replaced client restarts its
+        # generation, which must re-anchor, not read as a
+        # regression.  # guarded-by: _lock
+        self._wire_client: Dict[str, int] = {}
         # Accounting for the bench audit tails / /debug/health.
         self.cycles = 0  # guarded-by: _lock
         self.sampled_cycles = 0  # guarded-by: _lock
@@ -533,27 +542,50 @@ class Auditor:
         v2): the frame generation only ever grows, and the private
         mirror copies may only change when the generation does — an
         in-place mutation under a held generation means future delta
-        frames silently diverge the child's solve inputs."""
+        frames silently diverge the child's solve inputs.  A solver
+        POOL (ISSUE 15) is audited per replica — every member keeps
+        its own generation'd mirror, each under its own sentinel slot
+        (``wire-mirror-<i>``), so a divergence names the replica."""
         client = getattr(store, "remote_solver", None)
-        if client is None or getattr(client, "_wire", None) is None:
+        if client is None:
             with self._lock:
-                self._sentinels.pop("wire-mirror", None)
-                self._wire_client = None
+                for slot in [s for s in self._sentinels
+                             if s.startswith("wire-mirror")]:
+                    self._sentinels.pop(slot, None)
+                self._wire_client.clear()
+            return
+        replicas = getattr(client, "replicas", None)
+        if replicas is not None:
+            for r in replicas:
+                self._audit_wire_client(
+                    r.client, f"wire-mirror-{r.index}", anomalies,
+                    replica=r.index)
+            return
+        self._audit_wire_client(client, "wire-mirror", anomalies)
+
+    def _audit_wire_client(self, client, slot: str,
+                           anomalies: List[Anomaly],
+                           replica: Optional[int] = None) -> None:
+        if getattr(client, "_wire", None) is None:
+            with self._lock:
+                self._sentinels.pop(slot, None)
+                self._wire_client.pop(slot, None)
             return
         with self._lock:
-            if self._wire_client != id(client):
+            if self._wire_client.get(slot) != id(client):
                 # A replaced client (solver failover, endpoint
                 # reconfiguration) legitimately restarts its
                 # generation at 0 — re-anchor, don't report a
                 # regression that never happened.
-                self._sentinels.pop("wire-mirror", None)
-                self._wire_client = id(client)
+                self._sentinels.pop(slot, None)
+                self._wire_client[slot] = id(client)
         w = client._wire
         arrays = w.arrays if w.arrays is not None else None
         detail = self._sentinel_check(
-            "wire-mirror", int(client._gen), arrays,
-            monotonic_key=True)
+            slot, int(client._gen), arrays, monotonic_key=True)
         if detail is not None:
+            if replica is not None:
+                detail["replica"] = replica
             anomalies.append(Anomaly("wire-mirror-divergence", detail))
 
     # ------------------------------------------------------------- reads

@@ -47,7 +47,7 @@ class CycleRecord:
         "committed_solve_id", "mutation_seq_at_dispatch",
         "mutation_seq_at_commit", "epoch_at_dispatch", "epoch_at_commit",
         "device_events", "error", "spans", "rebalance", "whatif",
-        "anomalies",
+        "pool", "anomalies",
     )
 
     def __init__(self, session: str = "", path: str = "fast",
@@ -68,6 +68,7 @@ class CycleRecord:
                  spans: Optional[list] = None,
                  rebalance: Optional[dict] = None,
                  whatif: Optional[dict] = None,
+                 pool: Optional[dict] = None,
                  anomalies: Optional[List[dict]] = None):
         self.seq = -1  # assigned by FlightRecorder.record
         self.session = session
@@ -97,6 +98,11 @@ class CycleRecord:
         # volcano_tpu/whatif.py): action, outcome, gang uid, victim
         # counts.  None when neither lane planned anything.
         self.whatif = whatif
+        # Solver-pool fetch accounting for the cycle (ISSUE 15,
+        # volcano_tpu/solver_pool.py): winning replica, hedge /
+        # failover flags, residual wait.  None for single-connection
+        # (or local-solver) stores.
+        self.pool = pool
         # Runtime-auditor findings for THIS cycle (ISSUE 13,
         # obs/audit.py Anomaly.to_dict): empty on a healthy cycle.
         self.anomalies = anomalies or []
@@ -128,6 +134,8 @@ class CycleRecord:
                           if self.rebalance is not None else None),
             "whatif": (dict(self.whatif)
                        if self.whatif is not None else None),
+            "pool": (dict(self.pool)
+                     if self.pool is not None else None),
             "anomalies": [dict(a) for a in self.anomalies],
         }
         if include_spans:

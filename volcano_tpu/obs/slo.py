@@ -11,12 +11,19 @@ is cheap" contract of the incremental lanes has silently rotted).
 Tracking is a fixed sliding window (deque of the last ``window``
 observations per lane) — bounded memory, exact percentiles over the
 window, no decay math.  The *burn rate* is the classic error-budget
-ratio: (fraction of window observations over target) / allowed_frac; a
+ratio: (violations / the CONFIGURED window size) / allowed_frac; a
 burn rate >= 1.0 means the lane is consuming its error budget faster
-than the SLO allows.  ``observe`` reports breach EDGES (enter-breach
-transitions, re-armed when the window drops back under), so a
-sustained breach costs one anomaly, not one per cycle; the auditor
-(obs/audit.py) turns those into ``slo-budget-exceeded`` anomalies.
+than the SLO allows.  The denominator is deliberately the configured
+window, not the filled portion: while the window is still filling,
+each violation must be worth 1/window of budget, not 1/len — judging
+a 10%-allowed budget over 16 early samples makes TWO expected fault
+spikes an anomaly, which is exactly the startup flake the ISSUE 15
+endurance pool leg exposed (clustered one-time jit compiles early in
+the window fired edges a full window would absorb).  ``observe``
+reports breach EDGES (enter-breach transitions, re-armed when the
+window drops back under), so a sustained breach costs one anomaly,
+not one per cycle; the auditor (obs/audit.py) turns those into
+``slo-budget-exceeded`` anomalies.
 
 Budgets come from env (``VOLCANO_TPU_SLO_CYCLE_P99_MS`` /
 ``VOLCANO_TPU_SLO_DEVICE_P99_MS`` / ``VOLCANO_TPU_SLO_IDLE_P99_MS``,
@@ -123,7 +130,9 @@ class SLOTracker:
                 if len(win) < MIN_SAMPLES:
                     continue
                 over = sum(1 for v in win if v > b.target_ms)
-                burn = (over / len(win)) / b.allowed_frac
+                # Burn over the CONFIGURED window (unfilled slots count
+                # healthy) — see the module docstring.
+                burn = (over / self.window) / b.allowed_frac
                 was = self._breached.get(lane, False)
                 now = burn >= 1.0
                 self._breached[lane] = now
@@ -162,7 +171,7 @@ class SLOTracker:
             }
             if b is not None:
                 over = sum(1 for v in vals if v > b.target_ms)
-                burn = ((over / len(vals)) / b.allowed_frac
+                burn = ((over / self.window) / b.allowed_frac
                         if vals else 0.0)
                 entry.update({
                     "target_p99_ms": b.target_ms,

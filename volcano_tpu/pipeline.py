@@ -185,13 +185,18 @@ class InflightPlan:
     """
 
     __slots__ = (
-        "payload", "plan", "mutation_seq", "epoch", "compact_gen",
-        "n_nodes", "plan_id",
+        "kind", "payload", "plan", "mutation_seq", "epoch",
+        "compact_gen", "n_nodes", "plan_id",
     )
 
     def __init__(self, payload, plan, mutation_seq: int, epoch: int,
-                 compact_gen: int, n_nodes: int, plan_id: int = 0):
-        # A local jax AllocResult (copy_to_host_async already issued).
+                 compact_gen: int, n_nodes: int, plan_id: int = 0,
+                 kind: str = "local"):
+        # "local": a jax AllocResult (copy_to_host_async already
+        # issued).  "remote": a solver_pool.PoolPendingSolve — the
+        # plan solve was offloaded to an idle pool replica (ISSUE 15)
+        # and its reply is still unread.
+        self.kind = kind
         self.payload = payload
         # whatif.WhatIfPlan (host-side wave bookkeeping).
         self.plan = plan
@@ -204,6 +209,10 @@ class InflightPlan:
     def fetch(self):
         """Block on the remaining round trip; returns (assigned [P],
         never_ready [J]) as numpy."""
+        if self.kind == "remote":
+            res = self.payload.fetch()
+            return (np.asarray(res.assigned),
+                    np.asarray(res.never_ready))
         import jax
 
         assigned, never_ready = jax.device_get(
@@ -213,7 +222,15 @@ class InflightPlan:
 
     def abandon(self) -> None:
         """Drop the pending plan without committing it (device futures
-        lose their last reference; nothing was mutated store-side)."""
+        lose their last reference — or, offloaded, the replica's
+        connection resets its framing; nothing was mutated
+        store-side)."""
+        if self.kind == "remote" and self.payload is not None:
+            try:
+                self.payload.abandon()
+            except Exception:  # pragma: no cover - best-effort teardown
+                log.debug("in-flight plan abandon failed",
+                          exc_info=True)
         self.payload = None
 
 

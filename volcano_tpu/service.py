@@ -171,11 +171,18 @@ class Service:
         if remote_solver:
             # Remote-solver split (the north-star bridge): this process
             # keeps the store/controllers/encode/commit; the wave solver
-            # runs in the device-owning process at this address, fed one
-            # C++-packed snapshot frame per solve (solver_service.py).
-            from .solver_service import RemoteSolver
+            # runs in the device-owning process(es) at this address
+            # spec, fed one C++-packed snapshot frame per solve
+            # (solver_service.py).  A comma-separated address list, or
+            # VOLCANO_TPU_SOLVER_POOL=<n> over one address, builds a
+            # replica POOL (solver_pool.py, ISSUE 15): health-scored
+            # routing, hedged dispatch, one-cycle failover, what-if
+            # offload.  The default (one address, pool knob 1) is the
+            # plain single-connection RemoteSolver, byte-identical to
+            # the pre-pool wire.
+            from .solver_pool import make_solver_client
 
-            client = RemoteSolver(remote_solver)
+            client = make_solver_client(remote_solver)
             client.ping()  # fail fast on a permanently wrong address
             client.tracer = self.store.tracer
             self.store.remote_solver = client
@@ -361,9 +368,20 @@ class Service:
                         auditor = getattr(service.store, "auditor",
                                           None)
                         if auditor is None:
-                            self._json(200, {"status": "no-auditor"})
+                            body = {"status": "no-auditor"}
                         else:
-                            self._json(200, auditor.health())
+                            body = auditor.health()
+                        # Solver-pool replica health (ISSUE 15): the
+                        # pool snapshot reads only the pool's own
+                        # lock, so — like the auditor — this can
+                        # never block the cycle thread on store work.
+                        snap = getattr(
+                            getattr(service.store, "remote_solver",
+                                    None),
+                            "health_snapshot", None)
+                        if snap is not None:
+                            body["solver_pool"] = snap()
+                        self._json(200, body)
                     elif parts[:2] == ["debug", "anomalies"]:
                         # The anomaly ring, oldest first; ?n=K limits.
                         auditor = getattr(service.store, "auditor",
@@ -590,11 +608,16 @@ def main(argv=None) -> int:
                         "like the reference's API writes (cache.go:556-599)")
     p.add_argument("--remote-solver", default=None,
                    help="host:port of a vtpu-solver process "
-                        "(solver_service.py).  The scheduler then never "
-                        "touches an accelerator: each cycle's solver "
-                        "inputs ship as one C++-packed snapshot frame and "
-                        "the assignment vectors return — the north-star "
-                        "store<->solver bridge (cache.go:492-554 analog)")
+                        "(solver_service.py), or a comma-separated list "
+                        "for a replica pool (solver_pool.py: hedged "
+                        "dispatch, one-cycle failover, what-if offload; "
+                        "VOLCANO_TPU_SOLVER_POOL=<n> pools n "
+                        "connections to a single address).  The "
+                        "scheduler then never touches an accelerator: "
+                        "each cycle's solver inputs ship as one "
+                        "C++-packed snapshot frame and the assignment "
+                        "vectors return — the north-star store<->solver "
+                        "bridge (cache.go:492-554 analog)")
     p.add_argument("--pipeline", action="store_true",
                    help="pipelined scheduler cycles: dispatch the device "
                         "solve asynchronously and commit it at the top of "

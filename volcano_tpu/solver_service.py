@@ -444,6 +444,12 @@ class SolverServer:
         self.host = host
         self._stop = threading.Event()
         self.solves = 0
+        # Fault-injection hook (bench.py BENCH_POOL straggler schedule,
+        # tests/test_solver_pool.py): called with the running solve
+        # count; a positive return sleeps that many seconds before the
+        # reply ships — a reply-side straggler, exactly the tail the
+        # pool's hedged dispatch exists to cut.  None in production.
+        self.solve_delay_fn = None
 
     def serve_forever(self) -> None:
         self._sock.settimeout(0.5)
@@ -622,6 +628,10 @@ class SolverServer:
         )
         solve_ms = (_time.perf_counter() - t0) * 1e3
         self.solves += 1
+        if self.solve_delay_fn is not None:
+            delay = float(self.solve_delay_fn(self.solves))
+            if delay > 0:
+                _time.sleep(delay)
         arrays_out = []
         tree = sw.flatten_tree(tuple(np.asarray(x) for x in out), arrays_out)
         reply = {"op": "result", "tree": tree,
@@ -1108,6 +1118,27 @@ class RemoteSolver:
         self.requests += 1
         self.bytes_out += total + 8
         return handle
+
+    def wire_socket(self) -> Optional[socket.socket]:
+        """The live connection's socket (None when disconnected) — the
+        solver pool selects over these to race a hedged reply against
+        the primary's (solver_pool.SolverPool._wait_first)."""
+        with self._lock:
+            return self._sock
+
+    def reply_ready(self, timeout: float = 0.0) -> bool:
+        """True when reply bytes are waiting on the connection (or the
+        connection is gone — the fetch then fails promptly, which is
+        as 'ready' as a dead socket gets).  Waits up to ``timeout``
+        seconds.  Read-side probe only; never consumes bytes."""
+        import select as _select
+
+        with self._lock:
+            sock = self._sock
+        if sock is None:
+            return True
+        ready, _, _ = _select.select([sock], [], [], max(timeout, 0.0))
+        return bool(ready)
 
     def _finish_async(self, handle: "PendingSolve") -> bytes:
         with self._lock:

@@ -30,9 +30,14 @@ plan wholesale.  The engine is mesh-aware — the hypothetical patches
 touch only the per-cycle host planes (idle / ntasks / resident /
 queue / readiness vectors), never the device-resident devsnap planes,
 so the sharded dispatch path (``FastCycle._solve_mesh_dispatch``)
-carries it unchanged.  Remote-solver deployments keep the engine off
-(the what-if must run on the scheduler's own backend); preempt/reclaim
-then fall back to the host walk.
+carries it unchanged.  Single-connection remote-solver deployments keep
+the engine off (the plan solve would contend with the allocate lane on
+the one strict request/reply connection); preempt/reclaim then fall
+back to the host walk.  A solver *pool* (ISSUE 15,
+``solver_pool.SolverPool``) lifts that: plan solves offload to an idle
+non-primary replica and overlap the allocate lane — the staleness
+guard and ``InflightPlan`` commit path are unchanged, and a lost plan
+reply voids the plan (it mutated nothing; outcome ``lost-reply``).
 
 Every function here runs on the cycle thread inside ``FastCycle.run``
 (under ``run_cycle_fast``'s store lock).
@@ -75,13 +80,25 @@ def evict_cap() -> int:
     return max(1, _env_int("VOLCANO_TPU_EVICT_CAP", 64))
 
 
+def whatif_offload_on(remote) -> bool:
+    """True when ``remote`` is a solver pool with an idle non-primary
+    replica that can take a plan-proving solve right now (ISSUE 15).
+    A plain ``RemoteSolver`` has no offload capacity by construction."""
+    avail = getattr(remote, "whatif_replica_available", None)
+    return avail is not None and bool(avail())
+
+
 def evict_device_on(store) -> bool:
     """True when this store's preempt/reclaim run the plan-prove-commit
-    device lane.  The what-if solve runs on the scheduler's own
-    backend, so remote-solver deployments keep the host walk; a mesh
-    is fine (the engine dispatches through the sharded path)."""
-    return (evict_device_enabled()
-            and getattr(store, "remote_solver", None) is None)
+    device lane.  Single-connection remote-solver deployments keep the
+    host walk (the plan solve would contend for the one connection); a
+    solver pool with an idle non-primary replica offloads the plan
+    solve there instead; a mesh is fine (the engine dispatches through
+    the sharded path)."""
+    if not evict_device_enabled():
+        return False
+    remote = getattr(store, "remote_solver", None)
+    return remote is None or whatif_offload_on(remote)
 
 
 class WhatIfPlan(NamedTuple):
@@ -227,33 +244,81 @@ def dispatch_plan(cyc, plan: WhatIfPlan) -> None:
                   "victims": len(plan.victim_rows),
                   "need": plan.need}):
         inputs, pid, profiles, ncls = whatif_inputs(cyc, plan)
-        mesh = mesh_from_env(store)
-        if mesh is not None:
-            payload = cyc._solve_mesh_dispatch(
-                mesh, inputs, pid, profiles, ncls)
+        remote = getattr(store, "remote_solver", None)
+        if remote is not None:
+            # What-if offload (ISSUE 15): the plan solve ships to an
+            # idle non-primary pool replica, overlapping the allocate
+            # lane's in-flight solve instead of contending for the
+            # single connection.  The child rebuilds node classes from
+            # the frame; plan frames carry no devincr section.
+            try:
+                payload = remote.solve_whatif_async(inputs, pid,
+                                                    profiles)
+            except (OSError, ConnectionError, ValueError,
+                    RuntimeError):
+                # Every offload candidate died between the lane's
+                # availability gate and this dispatch: the plan
+                # mutated nothing — void it, let the pool's health
+                # probes heal, and re-plan next cycle.
+                log.warning(
+                    "what-if offload dispatch failed; plan voided "
+                    "(action=%s gang=%s)", plan.action, plan.gang_uid,
+                    exc_info=True,
+                )
+                count_plan(cyc, plan.action, "lost-reply",
+                           gang=plan.gang_uid,
+                           victims=len(plan.victim_rows))
+                return
+            if cyc._pipeline_on:
+                from .pipeline import InflightPlan
+
+                store._solve_seq += 1
+                store._inflight_plan = InflightPlan(
+                    payload, plan, m.mutation_seq, m.epoch,
+                    m.compact_gen, cyc.Nn, plan_id=store._solve_seq,
+                    kind="remote",
+                )
+                return
+            try:
+                res = payload.fetch()
+            except (OSError, ConnectionError, ValueError):
+                # Lost plan reply (replica died mid-solve): the plan
+                # mutated nothing — drop it and re-plan next cycle.
+                count_plan(cyc, plan.action, "lost-reply",
+                           gang=plan.gang_uid,
+                           victims=len(plan.victim_rows))
+                return
+            assigned = np.asarray(res.assigned)
+            never_ready = np.asarray(res.never_ready)
         else:
-            payload = solve_wave(*inputs, pid=pid, profiles=profiles,
-                                 taint_any=cyc._taint_any,
-                                 node_classes=ncls)
-        if cyc._pipeline_on:
-            from .pipeline import InflightPlan
+            mesh = mesh_from_env(store)
+            if mesh is not None:
+                payload = cyc._solve_mesh_dispatch(
+                    mesh, inputs, pid, profiles, ncls)
+            else:
+                payload = solve_wave(*inputs, pid=pid,
+                                     profiles=profiles,
+                                     taint_any=cyc._taint_any,
+                                     node_classes=ncls)
+            if cyc._pipeline_on:
+                from .pipeline import InflightPlan
 
-            for arr in (payload.assigned, payload.never_ready):
-                try:
-                    arr.copy_to_host_async()
-                except AttributeError:
-                    pass
-            store._solve_seq += 1
-            store._inflight_plan = InflightPlan(
-                payload, plan, m.mutation_seq, m.epoch,
-                m.compact_gen, cyc.Nn, plan_id=store._solve_seq,
+                for arr in (payload.assigned, payload.never_ready):
+                    try:
+                        arr.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                store._solve_seq += 1
+                store._inflight_plan = InflightPlan(
+                    payload, plan, m.mutation_seq, m.epoch,
+                    m.compact_gen, cyc.Nn, plan_id=store._solve_seq,
+                )
+                return
+            import jax
+
+            assigned, never_ready = jax.device_get(
+                (payload.assigned, payload.never_ready)
             )
-            return
-        import jax
-
-        assigned, never_ready = jax.device_get(
-            (payload.assigned, payload.never_ready)
-        )
     apply_plan(cyc, plan, np.asarray(assigned),
                np.asarray(never_ready))
 
@@ -287,7 +352,25 @@ def commit_inflight_plan(cyc) -> None:
                        gang=plan.gang_uid,
                        victims=len(plan.victim_rows))
             return
-        assigned, never_ready = inflight.fetch()
+        try:
+            assigned, never_ready = inflight.fetch()
+        except (OSError, ConnectionError, ValueError):
+            if inflight.kind != "remote":
+                raise
+            # The offloaded plan solve's reply died with its replica
+            # (ISSUE 15).  A plan mutates nothing until commit, so
+            # this is free: drop it and let the planner re-form
+            # against fresh state — the pool's health scoring routes
+            # the next offload to a live replica.
+            log.warning(
+                "offloaded what-if plan reply lost; plan voided "
+                "(action=%s gang=%s)", plan.action, plan.gang_uid,
+                exc_info=True,
+            )
+            count_plan(cyc, plan.action, "lost-reply",
+                       gang=plan.gang_uid,
+                       victims=len(plan.victim_rows))
+            return
         apply_plan(cyc, plan, assigned, never_ready)
 
 
