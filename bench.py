@@ -183,7 +183,7 @@ def _collect_audit(store):
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
           records=None, fallbacks=None, rebalance=None, devincr=None,
           wire=None, preempt=None, compile_ms=None, warmup_cycles=None,
-          composed=None, endurance=None, pool=None):
+          composed=None, endurance=None, pool=None, shards=None):
     global _AUDIT_TAIL
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
@@ -242,6 +242,11 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # per-replica frame counts, device-lane percentiles, lost-pod
         # and anomaly verdicts per pool size (docs/tuning.md).
         payload["pool"] = dict(pool)
+    if shards:
+        # BENCH_SHARDS tail (ISSUE 16): binds/sec + conflict rate +
+        # per-shard lane splits per shard count, plus the contention
+        # phase's zero-lost-pods verdict (docs/sharding.md).
+        payload["shards"] = dict(shards)
     if _AUDIT_TAIL is not None:
         # Runtime-auditor block (ISSUE 13): sampled cycles + measured
         # overhead ride every tail, so any bench row doubles as an
@@ -1293,6 +1298,23 @@ def config_endurance():
                                            "1")))
     except ValueError:
         pool_n = 1
+    # Sharded-control-plane leg (ISSUE 16): BENCH_ENDURANCE_SHARDS=<n>
+    # (>= 2) runs the whole gate — churn + flaps + preempt waves +
+    # compactions + solver kills — with n cycle shards over the one
+    # store, each with its own solver lane.  The shared node pool plus
+    # the churn feed makes same-node races between shards routine; the
+    # zero-anomaly verdict is then the optimistic commit protocol's
+    # endurance proof.  Mutually exclusive with the pool leg (each
+    # shard owns exactly one connection).
+    try:
+        shards_n = max(1, int(os.environ.get("BENCH_ENDURANCE_SHARDS",
+                                             "1")))
+    except ValueError:
+        shards_n = 1
+    if shards_n > 1:
+        pool_n = 1
+    shard_clients = []
+    shard_servers = []
     wire_on = os.environ.get("BENCH_ENDURANCE_WIRE", "1") != "0"
     if wire_on and pool_n > 1:
         import random as _random
@@ -1323,6 +1345,15 @@ def config_endurance():
                           daemon=True).start()
         client = RemoteSolver(f"127.0.0.1:{server.port}")
         store.remote_solver = client
+        # Extra solver lanes for shards 1..n-1 (the wire protocol is
+        # strict request/reply per connection; shard 0 keeps `client`
+        # and stays the kill wave's victim).
+        for _ in range(shards_n - 1):
+            srv = SolverServer(port=0)
+            _threading.Thread(target=srv.serve_forever,
+                              daemon=True).start()
+            shard_servers.append(srv)
+            shard_clients.append(RemoteSolver(f"127.0.0.1:{srv.port}"))
 
     # Steady churn feed: re-pend a fraction of the freshly-bound rows.
     def feed(fc):
@@ -1334,7 +1365,29 @@ def config_endurance():
             fc._unbind_rows(rows[:max(1, int(len(rows) * frac))])
 
     store.cycle_feed = feed
-    sched = Scheduler(store, conf_str=ENDURANCE_CONF)
+    wave_queue = "default"
+    if shards_n > 1:
+        from volcano_tpu.api import Queue
+        from volcano_tpu.shard import ShardedScheduler, stable_shard
+
+        sched = ShardedScheduler(store, conf_str=ENDURANCE_CONF,
+                                 shards=shards_n)
+        if client is not None:
+            sched.shards[0].remote_solver = client
+            for ctx, cl in zip(sched.shards[1:], shard_clients):
+                ctx.remote_solver = cl
+        # The preempt waves must land in a queue OWNED BY the evictor
+        # shard (shard 0): evict actions run only there under the
+        # sharded plane (docs/sharding.md), so a wave gang homed
+        # elsewhere would pend forever and the gate would measure a
+        # stall, not the protocol.
+        qi = 0
+        while stable_shard(f"endur-q{qi}", shards_n) != 0:
+            qi += 1
+        wave_queue = f"endur-q{qi}"
+        store.add_queue(Queue(name=wave_queue, weight=4))
+    else:
+        sched = Scheduler(store, conf_str=ENDURANCE_CONF)
     sim = ClusterSimulator(store, grace_steps=1)
 
     def one_cycle():
@@ -1381,7 +1434,8 @@ def config_endurance():
         wave_seq += 1
         gname = f"endur-hi{wave_seq}"
         store.add_pod_group(PodGroup(
-            name=gname, min_member=4, priority_class="endur-hi"))
+            name=gname, min_member=4, priority_class="endur-hi",
+            queue=wave_queue))
         for t in range(4):
             store.add_pod(Pod(
                 name=f"{gname}-{t}",
@@ -1538,6 +1592,10 @@ def config_endurance():
 
                 client = RemoteSolver(f"127.0.0.1:{server.port}")
                 store.remote_solver = client
+                if shards_n > 1:
+                    # Shard 0 resolves its lane from its own context,
+                    # not the store slot (docs/sharding.md).
+                    sched.shards[0].remote_solver = client
             _threading.Thread(target=server.serve_forever,
                               daemon=True).start()
         _lifecycle_churn(d_per_cycle)
@@ -1590,6 +1648,21 @@ def config_endurance():
         # BENCH_ENDURANCE_WIRE=0 regardless of the pool knob.)
         "pool": (client.health_snapshot()
                  if pool_n > 1 and client is not None else None),
+        # Sharded leg (ISSUE 16): conflict/steal totals + per-shard
+        # cycle counts, so the gate's tail proves the optimistic
+        # protocol actually raced (conflicts > 0 under this schedule)
+        # and still conserved every pod.
+        "shards": (
+            {
+                "n": shards_n,
+                "conflicts": int(sum(
+                    _metrics.shard_conflicts.data.values())),
+                "steals": int(sum(
+                    _metrics.shard_steals.data.values())),
+                "per_shard": [ctx.debug_snapshot()
+                              for ctx in sched.shards],
+                "table": store.shard_table.snapshot(),
+            } if shards_n > 1 else None),
     }
     _collect_audit(store)
     _emit(
@@ -1607,12 +1680,14 @@ def config_endurance():
     store.close()
     if client is not None:
         client.close()
+    for cl in shard_clients:
+        cl.close()
     if server is not None:
         server.shutdown()
         time.sleep(0.2)
-    for srv in servers:
+    for srv in servers + shard_servers:
         srv.shutdown()
-    if servers:
+    if servers or shard_servers:
         time.sleep(0.2)
     if anoms:
         print(f"# ENDURANCE FAILED: {anoms} anomalies "
@@ -1793,6 +1868,304 @@ def config_pool():
         time.sleep(0.2)
 
 
+def config_shards():
+    """BENCH_SHARDS=1,2,4 (ISSUE 16): sharded control plane A/B — N
+    cycle threads over one logical cluster, each shard fronted by its
+    own in-process ``SolverServer`` with an injected solve delay
+    (``BENCH_SHARDS_SOLVE_MS``, default 30 ms) so the device round trip
+    dominates and the pipelined overlap is what the A/B measures: N
+    shards keep N solves in flight, so binds/sec scales with N until
+    the lock-serialized host cycle saturates.
+
+    Per shard count, three phases over fresh stores:
+
+    - **drain** (conflict-free partition): queues confined to disjoint
+      node zones by selector, no churn — every shard count must bind
+      the SAME total with zero cross-shard conflicts (hack/run-e2e.sh
+      asserts both);
+    - **throughput**: steady churn feed over the same partitioned
+      store, cycles driven round-robin for ``BENCH_SHARDS_SECS`` —
+      binds/sec is the headline (the acceptance bar: >= 1.6x at
+      shards=2 vs shards=1).  The overlap being measured is the
+      PIPELINED solve (each shard's device round trip cooks while its
+      siblings' cycles run), so a single driving thread suffices and
+      keeps the number free of lock-barging noise;
+    - **contention**: a deliberately tight shared node pool under
+      aggressive churn, so same-node races between shards are routine
+      — the verdict is conflict-voided rows re-placing at ZERO lost
+      pods with the conservation auditor clean.
+
+    One JSON row per shard count: binds/sec, conflict rate, and
+    per-shard lane tails (cycles / binds / device p50, split by the
+    ``@sN`` session-uid suffix).
+    """
+    import threading as _threading
+
+    import numpy as _np
+
+    from volcano_tpu.api import TaskStatus
+    from volcano_tpu.metrics import metrics as _metrics
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.shard import ShardedScheduler
+    from volcano_tpu.solver_service import RemoteSolver, SolverServer
+    from volcano_tpu.synth import synthetic_cluster
+
+    sizes = [int(s) for s in os.environ.get(
+        "BENCH_SHARDS", "1,2,4").split(",") if s.strip()]
+    n_nodes = int(os.environ.get("BENCH_NODES", 64))
+    n_pods = int(os.environ.get("BENCH_PODS", 512))
+    n_queues = max(int(os.environ.get("BENCH_SHARDS_QUEUES", "8")),
+                   max(sizes))
+    solve_s = float(os.environ.get("BENCH_SHARDS_SOLVE_MS", "30")) / 1e3
+    secs = max(float(os.environ.get("BENCH_SHARDS_SECS", "6")), 1.0)
+    # The throughput window produces hundreds of cycles across shards;
+    # the ring must retain the whole window for the binds/sec count
+    # and the per-shard splits.
+    os.environ.setdefault("VOLCANO_TPU_FLIGHT_CYCLES", "8192")
+    os.environ.setdefault("VOLCANO_TPU_AUDIT_SAMPLE", "8")
+    st_bound = int(TaskStatus.Bound)
+
+    def _conflicts():
+        return int(sum(_metrics.shard_conflicts.data.values()))
+
+    def _partitioned_store():
+        """Queues confined to disjoint node zones by selector: the
+        feasible sets never overlap across queues, so NO shard split
+        of this workload can race — the drain/throughput phases
+        measure pure scaling, with the commit gate provably quiet."""
+        from volcano_tpu.api import (GROUP_NAME_ANNOTATION, Node, Pod,
+                                     PodGroup, Queue)
+        from volcano_tpu.cache import ClusterStore
+
+        store = ClusterStore()
+        for i in range(n_nodes):
+            z = i % n_queues
+            store.add_node(Node(
+                name=f"node-{i:04d}",
+                allocatable={"cpu": "64", "memory": "256Gi",
+                             "pods": 256},
+                labels={"zone": f"z{z}"},
+            ))
+        for q in range(n_queues):
+            store.add_queue(Queue(name=f"shq-{q}", weight=1))
+        g = made = 0
+        while made < n_pods:
+            q = g % n_queues
+            size = min(4, n_pods - made) or 1
+            pg = PodGroup(name=f"pg-{g:05d}", min_member=size,
+                          queue=f"shq-{q}")
+            store.add_pod_group(pg)
+            for k in range(size):
+                store.add_pod(Pod(
+                    name=f"pg-{g:05d}-{k}",
+                    annotations={GROUP_NAME_ANNOTATION: pg.name},
+                    containers=[{"cpu": "2", "memory": "4Gi"}],
+                    node_selector={"zone": f"z{q}"},
+                ))
+                made += 1
+            g += 1
+        return store
+
+    def _mk(size, store):
+        """Scheduler + one solver lane per shard over ``store`` (the
+        wire protocol is strict request/reply per connection, so
+        concurrent in-flight shards each need their own client)."""
+        store.pipeline = True
+        store.async_bind = os.environ.get("BENCH_SYNC_BIND") != "1"
+        servers, clients = [], []
+        for _ in range(max(size, 1)):
+            srv = SolverServer(port=0)
+            srv.solve_delay_fn = lambda i: solve_s
+            _threading.Thread(target=srv.serve_forever,
+                              daemon=True).start()
+            servers.append(srv)
+            clients.append(RemoteSolver(f"127.0.0.1:{srv.port}"))
+        if size <= 1:
+            store.remote_solver = clients[0]
+            sched = Scheduler(store, conf_str=CONF_BASE,
+                              schedule_period=0.0)
+        else:
+            sched = ShardedScheduler(store, conf_str=CONF_BASE,
+                                     schedule_period=0.0, shards=size)
+            for ctx, cl in zip(sched.shards, clients):
+                ctx.remote_solver = cl
+        return store, sched, servers, clients
+
+    def _teardown(store, servers, clients):
+        store.close()
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.shutdown()
+        time.sleep(0.2)
+
+    def _bound(store):
+        m = store.mirror
+        return int(_np.count_nonzero(
+            m.p_alive[:m.n_pods]
+            & (m.p_status[:m.n_pods] == st_bound)))
+
+    st_pending = int(TaskStatus.Pending)
+
+    def _lost(store):
+        m = store.mirror
+        return sum(
+            1 for r in range(m.n_pods)
+            if m.p_uid[r] is not None and m.p_alive[r]
+            and int(m.p_status[r]) != st_bound
+        )
+
+    def _lost_strict(store):
+        """Pods that vanished from BOTH states — the conservation
+        failure a voided commit could cause.  On the deliberately
+        oversubscribed contention pool, Pending leftovers are the
+        expected backlog, not a loss."""
+        m = store.mirror
+        return sum(
+            1 for r in range(m.n_pods)
+            if m.p_uid[r] is not None and m.p_alive[r]
+            and int(m.p_status[r]) not in (st_bound, st_pending)
+        )
+
+    def _pending(store):
+        m = store.mirror
+        return int(_np.count_nonzero(
+            m.p_alive[:m.n_pods]
+            & (m.p_status[:m.n_pods] == st_pending)))
+
+    def _last_seq(store):
+        recs = store.flight.recent()
+        return recs[-1].seq if recs else 0
+
+    baseline_rate = None
+    for size in sizes:
+        c0 = _conflicts()
+        # ---- phase 1: drain (conflict-free partition) ---------------
+        store, sched, servers, clients = _mk(size, _partitioned_store())
+        rounds = 0
+        while rounds < 40 and _bound(store) < n_pods:
+            sched.run_once()
+            rounds += 1
+        store.flush_binds()
+        drain = {
+            "rounds": rounds,
+            "bound": _bound(store),
+            "conflicts": _conflicts() - c0,
+        }
+
+        # ---- phase 2: throughput (steady churn) ---------------------
+        def feed(fc):
+            m = fc.m
+            rows = _np.flatnonzero(
+                (m.p_status[:fc.Pn] == st_bound) & m.p_alive[:fc.Pn]
+            )
+            if len(rows):
+                fc._unbind_rows(rows[:max(1, len(rows) // 8)])
+
+        store.cycle_feed = feed
+        for _ in range(6):
+            sched.run_once()  # warm the churn shapes before timing
+        c1 = _conflicts()
+        seq0 = _last_seq(store)
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            sched.run_once()
+        elapsed = time.perf_counter() - t0
+        recs = [r for r in store.flight.recent() if r.seq > seq0]
+        binds = sum(r.pods_bound for r in recs)
+        rate = binds / max(elapsed, 1e-9)
+        if baseline_rate is None:
+            baseline_rate = rate
+        per_shard = {}
+        for r in recs:
+            k = (r.session.rsplit("@", 1)[1]
+                 if "@" in r.session else "s0")
+            d = per_shard.setdefault(
+                k, {"cycles": 0, "binds": 0, "_dev": []})
+            d["cycles"] += 1
+            d["binds"] += r.pods_bound
+            d["_dev"].append(r.lanes.get("device", 0.0) * 1e3)
+        for d in per_shard.values():
+            dev = sorted(d.pop("_dev"))
+            d["device_p50_ms"] = (
+                round(dev[len(dev) // 2], 2) if dev else 0.0)
+        thr_conflicts = _conflicts() - c1
+        store.cycle_feed = None
+        for _ in range(3):
+            sched.run_once()
+        store.flush_binds()
+        lost_ab = _lost(store)
+        anoms_ab = store.auditor.total_anomalies()
+        cycle_ms = sorted(r.duration_s * 1e3 for r in recs)
+        p50 = cycle_ms[len(cycle_ms) // 2] if cycle_ms else 0.0
+        _teardown(store, servers, clients)
+
+        # ---- phase 3: contention (tight shared pool, forced races) --
+        c2 = _conflicts()
+        steals0 = int(sum(_metrics.shard_steals.data.values()))
+        store2, sched2, servers2, clients2 = _mk(
+            size, synthetic_cluster(
+                n_nodes=max(6, n_nodes // 10),
+                n_pods=max(96, n_pods // 4), gang_size=4,
+                n_queues=n_queues, node_cpu="16", seed=7))
+
+        def feed2(fc):
+            m = fc.m
+            rows = _np.flatnonzero(
+                (m.p_status[:fc.Pn] == st_bound) & m.p_alive[:fc.Pn]
+            )
+            if len(rows):
+                fc._unbind_rows(rows[:max(1, len(rows) // 3)])
+
+        for _ in range(4):
+            sched2.run_once()
+        store2.cycle_feed = feed2
+        t1 = time.perf_counter()
+        while time.perf_counter() - t1 < max(secs / 2, 2.0):
+            sched2.run_once()
+        store2.cycle_feed = None
+        for _ in range(4):
+            sched2.run_once()
+        store2.flush_binds()
+        contention = {
+            "conflicts": _conflicts() - c2,
+            "steals": int(sum(
+                _metrics.shard_steals.data.values())) - steals0,
+            "pending_backlog": _pending(store2),
+            "lost_pods": _lost_strict(store2),
+            "anomalies": store2.auditor.total_anomalies(),
+        }
+        _collect_audit(store2)
+
+        tail = {
+            "shards": size,
+            "solve_ms": round(solve_s * 1e3, 1),
+            "drain": drain,
+            "binds_per_sec": round(rate, 1),
+            "speedup_vs_shard1": (
+                round(rate / baseline_rate, 3) if baseline_rate else None),
+            "throughput_conflicts": thr_conflicts,
+            "conflict_rate": round(thr_conflicts / max(binds, 1), 5),
+            "per_shard": per_shard,
+            "lost_pods": lost_ab,
+            "anomalies": anoms_ab,
+            "contention": contention,
+        }
+        _emit(
+            f"Sharded control plane @ {n_nodes} nodes x {n_pods} pods "
+            f"(shards={size}, solve {solve_s * 1e3:.0f}ms)",
+            p50, n_pods,
+            f"binds/sec={tail['binds_per_sec']} "
+            f"speedup={tail['speedup_vs_shard1']} "
+            f"conflicts={thr_conflicts} "
+            f"contention_lost={contention['lost_pods']} "
+            f"contention_anoms={contention['anomalies']}",
+            records=recs,
+            shards=tail,
+        )
+        _teardown(store2, servers2, clients2)
+
+
 def _round_frac(f):
     return round(min(max(f, 0.0), 1.0), 4)
 
@@ -1906,6 +2279,12 @@ def main():
         # an injected straggler + kill schedule; the pool tails carry
         # hedge/failover counts and device-lane p50/p99 per size.
         config_pool()
+        return
+    if os.environ.get("BENCH_SHARDS"):
+        # Sharded control plane A/B (ISSUE 16): shard counts {1,2,4}
+        # over one logical cluster; the shard tails carry binds/sec,
+        # conflict rate, and per-shard lane splits.
+        config_shards()
         return
     mesh_raw = os.environ.get("BENCH_MESH")
     if mesh_raw:

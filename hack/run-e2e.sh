@@ -113,6 +113,44 @@ print("BENCH_POOL smoke OK (device p99 %.0fms -> %.0fms, %.0f%% cut, "
                                 cut, p2["hedge_dispatches"],
                                 p2["hedge_wins"]))
 '
+# BENCH_SHARDS smoke (ISSUE 16): the sharded control plane A/B at a
+# small shape — asserts shards=2 actually engages (both shards run
+# cycles and bind), the drain phase binds the SAME total as shards=1
+# with ZERO cross-shard conflicts on the zone-partitioned workload,
+# and the contention-heavy phase resolves its forced same-node races
+# with zero lost pods and the conservation auditor clean.
+BENCH_SHARDS=1,2 BENCH_NODES=32 BENCH_PODS=192 BENCH_SHARDS_SECS=4 \
+  BENCH_SHARDS_SOLVE_MS=25 JAX_PLATFORMS=cpu \
+  python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+tails = {r["shards"]["shards"]: r["shards"] for r in rows
+         if "shards" in r}
+assert set(tails) == {1, 2}, f"missing shard sizes: {sorted(tails)}"
+s1, s2 = tails[1], tails[2]
+# shards=2 engaged: both shards ran cycles and bound pods.
+per = s2["per_shard"]
+assert set(per) == {"s0", "s1"}, per
+assert all(v["cycles"] >= 1 for v in per.values()), per
+assert sum(v["binds"] for v in per.values()) >= 1, per
+# Conflict-free partition: same bind total as shards=1, gate quiet.
+assert s2["drain"]["bound"] == s1["drain"]["bound"], (s1, s2)
+assert s1["drain"]["conflicts"] == 0, s1
+assert s2["drain"]["conflicts"] == 0, s2
+assert s2["throughput_conflicts"] == 0, s2
+for size, t in tails.items():
+    assert t["lost_pods"] == 0, f"shards={size} lost pods: {t}"
+    assert t["anomalies"] == 0, f"shards={size} anomalies: {t}"
+    c = t["contention"]
+    assert c["lost_pods"] == 0, f"shards={size} contention lost: {c}"
+    assert c["anomalies"] == 0, f"shards={size} contention anoms: {c}"
+# The contention phase actually raced across shards.
+assert s2["contention"]["conflicts"] >= 1, s2
+print("BENCH_SHARDS smoke OK (%s -> %s binds/sec, %.2fx, "
+      "%s contention conflicts, 0 lost)"
+      % (s1["binds_per_sec"], s2["binds_per_sec"],
+         s2["speedup_vs_shard1"], s2["contention"]["conflicts"]))
+'
 # BENCH_PREEMPT smoke (ISSUE 11): the device-native preempt lane on a
 # small fragmented-priority cluster — asserts the DEVICE lane actually
 # engaged (a committed what-if plan + evictions through the shared

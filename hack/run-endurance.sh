@@ -19,10 +19,12 @@ cd "$(dirname "$0")/.."
 export BENCH_ENDURANCE_CYCLES VOLCANO_TPU_AUDIT_SAMPLE
 
 # The first leg pins the HISTORIC single-connection path regardless of
-# how the pool leg below is sized — without the explicit pool=1 an
-# exported BENCH_ENDURANCE_POOL>=2 would silently turn this into a
-# second pool run and leave the single-connection path ungated.
-BENCH_ENDURANCE=1 BENCH_ENDURANCE_POOL=1 python bench.py "$@"
+# how the pool/shard legs below are sized — without the explicit
+# pool=1 shards=1 an exported BENCH_ENDURANCE_POOL>=2 or
+# BENCH_ENDURANCE_SHARDS>=2 would silently turn this into a second
+# pool/shard run and leave the single-connection path ungated.
+BENCH_ENDURANCE=1 BENCH_ENDURANCE_POOL=1 BENCH_ENDURANCE_SHARDS=1 \
+  python bench.py "$@"
 echo "endurance gate OK (0 anomalies)"
 
 # Pool leg (ISSUE 15): the same churn + fault schedule over a 2-replica
@@ -33,8 +35,24 @@ echo "endurance gate OK (0 anomalies)"
 : "${BENCH_ENDURANCE_POOL:=2}"
 export BENCH_ENDURANCE_POOL
 if [ "${BENCH_ENDURANCE_POOL}" -gt 1 ]; then
-  BENCH_ENDURANCE=1 \
+  BENCH_ENDURANCE=1 BENCH_ENDURANCE_SHARDS=1 \
     BENCH_ENDURANCE_CYCLES=$(( BENCH_ENDURANCE_CYCLES / 2 > 150 \
       ? BENCH_ENDURANCE_CYCLES / 2 : 150 )) python bench.py "$@"
   echo "endurance pool leg OK (0 anomalies, pool=${BENCH_ENDURANCE_POOL})"
+fi
+
+# Sharded leg (ISSUE 16): the same churn + fault schedule driven by a
+# TWO-SHARD control plane over one logical cluster — cross-shard bind
+# races resolve through the optimistic commit gate, preempt waves home
+# on the evictor shard, and kill waves respawn the shard-0 solver lane.
+# Conservation must hold across shard boundaries: exits nonzero on any
+# anomaly.  Skip with BENCH_ENDURANCE_SHARDS=1; size with
+# BENCH_ENDURANCE_SHARDS=<n> (forces pool=1 — one wire lane per shard).
+: "${BENCH_ENDURANCE_SHARDS:=2}"
+export BENCH_ENDURANCE_SHARDS
+if [ "${BENCH_ENDURANCE_SHARDS}" -gt 1 ]; then
+  BENCH_ENDURANCE=1 \
+    BENCH_ENDURANCE_CYCLES=$(( BENCH_ENDURANCE_CYCLES / 2 > 150 \
+      ? BENCH_ENDURANCE_CYCLES / 2 : 150 )) python bench.py "$@"
+  echo "endurance shard leg OK (0 anomalies, shards=${BENCH_ENDURANCE_SHARDS})"
 fi

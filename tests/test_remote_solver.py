@@ -298,9 +298,10 @@ def test_wire_child_restart_heals(monkeypatch):
     from test_devincr import _partial_feed, _reset_uid_counters
 
     monkeypatch.setenv("VOLCANO_TPU_WIRE", "1")
-    # The first child picks its own port (--port 0 + announce) so there
-    # is no probe-then-bind race; only the restart below must rebind the
-    # SAME port, the unavoidable window.
+    # Both children pick their own port (--port 0 + announce) so there
+    # is never a probe-then-bind race: the restart derives the new port
+    # from the new child's announce and repoints the client, instead of
+    # racing other test processes for the freed port.
     proc, port = _spawn_solver()
     _reset_uid_counters()
     store = synthetic_cluster(n_nodes=16, n_pods=48, gang_size=4,
@@ -319,7 +320,17 @@ def test_wire_child_restart_heals(monkeypatch):
         # Kill the child MID-STREAM: a pipelined solve is in flight.
         proc.terminate()
         proc.wait(timeout=10)
-        proc, _ = _spawn_solver(port)
+        # Respawn on a fresh OS-assigned port (retry-bounded in case a
+        # cold interpreter start flakes) and repoint the client: its
+        # dead socket forces a reconnect, which dials host:port anew.
+        for attempt in range(3):
+            try:
+                proc, port = _spawn_solver()
+                break
+            except RuntimeError:
+                if attempt == 2:
+                    raise
+        client.host, client.port = "127.0.0.1", port
         pre_restart_delta = client.frame_counts["delta"]
         for _ in range(5):
             sched.run_once()

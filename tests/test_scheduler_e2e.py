@@ -220,7 +220,7 @@ def test_fastpath_failure_fallback_guard(monkeypatch):
     from volcano_tpu.scheduler import Scheduler
     from volcano_tpu.synth import synthetic_cluster
 
-    def boom(store, conf):
+    def boom(store, conf, shard=None):
         raise RuntimeError("device exploded")
 
     monkeypatch.setattr(fp, "run_cycle_fast", boom)
@@ -248,7 +248,7 @@ def test_fastpath_failure_no_fallback_at_hyperscale(monkeypatch):
     from volcano_tpu.scheduler import Scheduler
     from volcano_tpu.synth import synthetic_cluster
 
-    def boom(store, conf):
+    def boom(store, conf, shard=None):
         raise RuntimeError("device exploded")
 
     monkeypatch.setattr(fp, "run_cycle_fast", boom)

@@ -29,6 +29,7 @@ LOCK_FILES = [
     "volcano_tpu/cache/bindqueue.py",
     "volcano_tpu/pipeline.py",
     "volcano_tpu/scheduler.py",
+    "volcano_tpu/shard.py",
     "volcano_tpu/solver_service.py",
     "volcano_tpu/solver_pool.py",
     "volcano_tpu/fastpath.py",

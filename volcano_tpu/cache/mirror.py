@@ -352,6 +352,17 @@ class StoreMirror:
         # result must be dropped (rows are otherwise stable for a pod's
         # lifetime — tombstoned rows are never reused).
         self.compact_gen = 0  # guarded-by: _lock
+        # Cross-shard commit gate (shard.py, ISSUE 16): bumped by every
+        # sharded FastCycle._commit.  A shard captures the value at
+        # solve dispatch; an advance at fetch time proves ANOTHER shard
+        # committed binds during the overlap (a shard never commits
+        # after its own pipelined dispatch within one cycle), so the
+        # staleness guard's competing-bind / capacity-taken voids are
+        # attributed to the optimistic protocol as the
+        # `cross-shard-conflict` drop reason.  Correctness never rests
+        # on this counter — mutation_seq already forces the
+        # re-validation; this one only drives attribution + metrics.
+        self.shard_commit_seq = 0  # guarded-by: _lock
         # Node rows touched since the last reset_node_delta(): lets the
         # device-resident snapshot upload per-row deltas instead of the
         # full [N, *] planes on every node-table epoch bump.

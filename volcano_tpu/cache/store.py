@@ -175,6 +175,19 @@ class ClusterStore:
         # InflightPlan): same ownership/locking contract as the solve
         # slot above.
         self._inflight_plan = None  # guarded-by: _lock (any-receiver)
+        # Per-shard parked solves (shard.py, ISSUE 16): shard index ->
+        # InflightSolve.  The default single-scheduler path never
+        # touches this dict — it keeps using _inflight_solve above, so
+        # VOLCANO_TPU_SHARDS=1 stays bitwise identical.  Same
+        # any-receiver locking contract as the default slot
+        # (cycle threads park/pop their own entry; close()/stop()
+        # drain from other threads).
+        self._shard_inflight: Dict[int, object] = {}  # guarded-by: _lock (any-receiver)
+        # Shard ownership table (shard.ShardOwnershipTable), attached by
+        # ShardedScheduler; None for the single-scheduler path.  The
+        # table's mutable state (steal overrides + handoff epoch) is
+        # itself guarded by THIS store's _lock — see shard.py contracts.
+        self.shard_table = None  # guarded-by: _lock (any-receiver)
         # Mesh-path persistent plane cache (parallel/mesh.py
         # shard_wave_inputs): epoch-keyed per-device placements of the
         # epoch-stable planes the sharded devsnap does not own (e.g.

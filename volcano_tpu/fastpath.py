@@ -180,11 +180,27 @@ class FastCycle:
     # lock held.
     # vclint: class-holds: _lock
 
-    def __init__(self, store, conf):
+    def __init__(self, store, conf, shard=None):
         self.store = store
         self.conf = conf
         self.m = store.mirror
-        self.uid = f"ssn-{next(_session_counter)}"
+        # Sharded control plane (shard.py, ISSUE 16): this cycle's
+        # shard.ShardContext, or None on the default single-scheduler
+        # path (which must stay bitwise identical — every shard branch
+        # below is behind `self.shard is not None`).  The session uid
+        # carries the shard index so /debug/cycles and the flight
+        # recorder attribute cycles per shard for free.
+        self.shard = shard
+        n = next(_session_counter)
+        self.uid = (f"ssn-{n}" if shard is None
+                    else f"ssn-{n}@s{shard.index}")
+        # Per-shard solver client override: each shard may own its own
+        # device lane (bench A/B, service wiring); falls back to the
+        # store-wide client.  Resolved once per cycle — both slots are
+        # cycle-thread-owned, so no lock is needed beyond ownership.
+        self._remote_solver = getattr(store, "remote_solver", None)
+        if shard is not None and shard.remote_solver is not None:
+            self._remote_solver = shard.remote_solver
         self.action_names = [
             a.strip() for a in conf.actions.split(",") if a.strip()
         ]
@@ -386,6 +402,15 @@ class FastCycle:
         # sat on the hot cycle thread (ISSUE 8 satellite); every
         # consumer takes it through np.asarray.
         self.session_jobs = np.flatnonzero(m.j_alive[:Jn])
+        # Sharded control plane (ISSUE 16): restrict the session to this
+        # shard's owned queues.  This is the ONE seam the per-shard
+        # mirror view hangs off — _schedulable_rows/_pending_rows/
+        # enqueue/backfill/close all derive from session_jobs, while the
+        # node planes above stay shared (whole-cluster capacity).
+        if self.shard is not None:
+            self.session_jobs = self.shard.filter_session_jobs(
+                self, self.session_jobs
+            )
         # PodGroup refs + status snapshot come straight from the mirror's
         # incrementally-maintained columns (every store add/update
         # funnels through upsert_pod_group) instead of a 45k-object walk
@@ -771,6 +796,15 @@ class FastCycle:
                     with tracer.span("feed", lanes=self.lanes):
                         feed(self)
                 for name in self.action_names:
+                    if (self.shard is not None
+                            and not self.shard.runs_evictions
+                            and name in ("preempt", "reclaim",
+                                         "rebalance")):
+                        # Evict planners reason over the WHOLE cluster's
+                        # victims; only the designated evictor shard
+                        # (shard 0) runs them, or two shards would plan
+                        # overlapping evictions (shard.py).
+                        continue
                     lane = (name if name in ("preempt", "reclaim",
                                              "enqueue", "backfill",
                                              "rebalance")
@@ -964,8 +998,7 @@ class FastCycle:
         hedge/failover flags, wait — solver_pool.SolverPool) into the
         cycle's flight record.  Plain RemoteSolver stores carry no
         pool info and record nothing."""
-        take = getattr(getattr(self.store, "remote_solver", None),
-                       "take_last_fetch_info", None)
+        take = getattr(self._remote_solver, "take_last_fetch_info", None)
         if take is None:
             return
         info = take()
@@ -1419,7 +1452,7 @@ class FastCycle:
             never_any = False
             try:
                 chunks = list(self._solve_chunks(solve_jobs, task_rows))
-                remote = getattr(store, "remote_solver", None)
+                remote = self._remote_solver
                 from .parallel.mesh import mesh_from_env
 
                 # store.solve_mesh, or the VOLCANO_TPU_MESH deploy knob
@@ -1774,12 +1807,24 @@ class FastCycle:
 
         # Commit prep that needs no assignment overlaps the round trip.
         req_gather = self.m.c_req.gather(crows)
-        self.store._inflight_solve = InflightSolve(
+        shard_idx = None if self.shard is None else self.shard.index
+        shard_seq = None
+        if self.shard is not None:
+            # Cross-shard gate token: sibling commits bump the first
+            # component, queue steals the second (shard.py, ISSUE 16).
+            shard_seq = (int(self.m.shard_commit_seq),
+                         int(self.shard.table.epoch))
+        inflight = InflightSolve(
             kind, payload, list(cjobs), crows, req_gather,
             self.m.mutation_seq, self.m.epoch, self.m.compact_gen,
             self.Nn, solve_id=solve_id, dirty_seq=self.m.dirty_seq,
-            devincr_token=devincr_token,
+            devincr_token=devincr_token, shard=shard_idx,
+            shard_seq=shard_seq,
         )
+        if self.shard is None:
+            self.store._inflight_solve = inflight
+        else:
+            self.store._shard_inflight[self.shard.index] = inflight
 
     def _solve_mesh_dispatch(self, mesh, inputs, pid, profiles, ncls,
                              devincr=None):
@@ -1815,7 +1860,10 @@ class FastCycle:
         synchronous cycle would have."""
         from .pipeline import take_inflight
 
-        inflight = take_inflight(self.store)
+        inflight = take_inflight(
+            self.store,
+            None if self.shard is None else self.shard.index,
+        )
         if inflight is None:
             return
         m = self.m
@@ -1913,7 +1961,7 @@ class FastCycle:
         if inflight.kind == "remote":
             # The child reported its device-incremental decision in the
             # reply manifest (decoded by the fetch above).
-            mode = getattr(getattr(self.store, "remote_solver", None),
+            mode = getattr(self._remote_solver,
                            "last_devincr_mode", None)
             if mode in ("warm", "full"):
                 metrics.device_incremental_solves.inc(mode=mode)
@@ -1945,6 +1993,21 @@ class FastCycle:
             req_gather = inflight.req_gather
             stale = (m.mutation_seq != inflight.mutation_seq
                      or self.Nn != inflight.n_nodes)
+            # Cross-shard commit gate (shard.py, ISSUE 16): the token
+            # captured at dispatch was (mirror.shard_commit_seq,
+            # ownership-table handoff epoch).  An advance of the first
+            # component means ANOTHER shard committed binds during the
+            # overlap (our own shard never commits after its own
+            # pipelined dispatch within one cycle); the second forces
+            # re-validation across a queue steal even when nothing else
+            # moved.  mutation_seq already makes the commit-race case
+            # stale — cross_shard only re-attributes the voids.
+            cross_shard = False
+            if self.shard is not None and inflight.shard_seq is not None:
+                cur_seq = (int(m.shard_commit_seq),
+                           int(self.shard.table.epoch))
+                cross_shard = cur_seq != inflight.shard_seq
+                stale = stale or cross_shard
             if not stale and m.dirty_seq != inflight.dirty_seq:
                 # Agreement contract (ISSUE 8): every writer that marks
                 # the dirty set also bumps the mutation counter, so a
@@ -1961,6 +2024,7 @@ class FastCycle:
                 assigned = self._revalidate_inflight(
                     task_rows, assigned,
                     node_churn=(m.epoch != inflight.epoch),
+                    cross_shard=cross_shard,
                 )
                 # Row set changed: let _commit re-gather the committed
                 # rows.
@@ -1974,7 +2038,8 @@ class FastCycle:
 
     def _revalidate_inflight(self, task_rows: np.ndarray,
                              assigned: np.ndarray,
-                             node_churn: bool = False) -> np.ndarray:
+                             node_churn: bool = False,
+                             cross_shard: bool = False) -> np.ndarray:
         """Drop assignment rows invalidated during the overlap; returns
         ``assigned`` with conflicting rows forced to -1.
 
@@ -2008,6 +2073,15 @@ class FastCycle:
                                    gone / not ready
         - ``capacity-taken``       surviving charge would oversubscribe
                                    the node's allocatable or task slots
+
+        Under the sharded control plane (``cross_shard=True``: another
+        shard committed binds, or a queue steal landed, during the
+        overlap — shard.py, ISSUE 16) the two reasons a sibling's binds
+        produce — ``competing-bind`` and ``capacity-taken`` — are
+        re-attributed as the single ``cross-shard-conflict`` reason and
+        fed to ``volcano_shard_conflicts_total{outcome}`` by losing
+        check.  The counts MOVE (never double-counted), so the
+        per-reason totals still sum exactly to the rows dropped.
         """
         m = self.m
         nn = self.Nn
@@ -2062,13 +2136,26 @@ class FastCycle:
             if bad.any():
                 r_capacity = ok & bad[node]
                 ok &= ~bad[node]
-        self._count_drops({
+        drops = {
             "deleted": int(np.count_nonzero(r_deleted)),
             "competing-bind": int(np.count_nonzero(r_competing)),
             "constraint-sensitive": int(np.count_nonzero(r_constraint)),
             "node-epoch-churn": int(np.count_nonzero(r_churn)),
             "capacity-taken": int(np.count_nonzero(r_capacity)),
-        })
+        }
+        if cross_shard:
+            n_comp = drops.pop("competing-bind")
+            n_cap = drops.pop("capacity-taken")
+            drops["cross-shard-conflict"] = n_comp + n_cap
+            if n_comp:
+                metrics.shard_conflicts.inc(
+                    n_comp, outcome="competing-bind")
+            if n_cap:
+                metrics.shard_conflicts.inc(
+                    n_cap, outcome="capacity-taken")
+            if self.shard is not None:
+                self.shard.conflicts += n_comp + n_cap
+        self._count_drops(drops)
         out = np.where(ok, assigned, -1)
         n_drop = int(np.count_nonzero(live & (out < 0)))
         if n_drop and not ok.any():
@@ -2568,7 +2655,7 @@ class FastCycle:
         with the node-axis NamedSharding and delta scatters stay
         shard-local (ops/devsnap.py), so the mesh path no longer
         re-ships numpy planes every cycle."""
-        if (getattr(self.store, "remote_solver", None) is not None
+        if (self._remote_solver is not None
                 or os.environ.get("VOLCANO_TPU_DEVSNAP", "1") == "0"):
             return None
         from .ops.devsnap import for_store
@@ -3326,6 +3413,11 @@ class FastCycle:
         m.p_node[rows] = nodes_c
         m.mark_pods_dirty(rows)
         m.mutation_seq += 1
+        if self.shard is not None:
+            # Cross-shard commit gate (shard.py, ISSUE 16): siblings
+            # whose overlapped solve raced these binds attribute their
+            # voids as cross-shard-conflict.
+            m.shard_commit_seq += 1
         self.n_used = new_used
         self.n_idle = self.n_idle - add
         self.n_ntasks += np.bincount(
@@ -3792,7 +3884,7 @@ class FastCycle:
         store = self.store
         if not rebalance_enabled():
             return
-        remote = getattr(store, "remote_solver", None)
+        remote = self._remote_solver
         if remote is not None:
             from . import whatif
 
@@ -4027,6 +4119,11 @@ class FastCycle:
         rebalance, preempt or reclaim — through the shared engine
         (``whatif.commit_inflight_plan``): any mutation/epoch/compaction
         /node-count drift voids the plan wholesale."""
+        if self.shard is not None and not self.shard.runs_evictions:
+            # The parked plan belongs to the evictor shard (shard 0);
+            # a sibling popping it would commit evictions planned
+            # against another shard's view.
+            return
         from . import whatif
 
         whatif.commit_inflight_plan(self)
@@ -4333,12 +4430,18 @@ class FastCycle:
         return msg
 
 
-def run_cycle_fast(store, conf) -> bool:
+def run_cycle_fast(store, conf, shard=None) -> bool:
     """Run one scheduling cycle on the fast path; False = not eligible
-    (caller should fall back to the object-session path)."""
-    cycle = FastCycle(store, conf)
+    (caller should fall back to the object-session path).  ``shard`` is
+    the calling loop's shard.ShardContext under the sharded control
+    plane (ISSUE 16) — cycles stay atomic under the store lock, so
+    shards interleave at cycle granularity and only the PIPELINED
+    overlap races across shards (the optimistic commit gate's domain)."""
+    cycle = FastCycle(store, conf, shard=shard)
     if not cycle.eligible():
         return False
     with store._lock:
         cycle.run()
+    if shard is not None:
+        shard.cycles += 1
     return True

@@ -278,9 +278,26 @@ class Metrics:
             f"{ns}_pipeline_stale_drop_rows_total",
             "In-flight solve rows that did not commit, by reason: the "
             "staleness guard's per-row drops (deleted, competing-bind, "
-            "capacity-taken, constraint-sensitive, node-epoch-churn) "
-            "plus whole-result voids (compaction, lost-reply, "
-            "device-crash)",
+            "capacity-taken, constraint-sensitive, node-epoch-churn, "
+            "cross-shard-conflict) plus whole-result voids "
+            "(compaction, lost-reply, device-crash)",
+        )
+        self.shard_conflicts = _Counter(
+            f"{ns}_shard_conflicts_total",
+            "Optimistic cross-shard commit conflicts (shard.py, ISSUE "
+            "16): in-flight rows voided because another shard's binds "
+            "landed during the overlap, by losing check — "
+            "competing-bind (the row itself was taken: steal race) or "
+            "capacity-taken (the target node's capacity was).  These "
+            "rows also count as the cross-shard-conflict reason of "
+            "volcano_pipeline_stale_drop_rows_total; they re-place "
+            "next cycle, never lost",
+        )
+        self.shard_steals = _Counter(
+            f"{ns}_shard_steals_total",
+            "Work-stealing queue ownership handoffs: an idle shard "
+            "claimed the most-starved foreign queue via the ownership "
+            "table's epoch-bumped handoff token (shard.py)",
         )
         self.rebalance_plans = _Counter(
             f"{ns}_rebalance_plans_total",

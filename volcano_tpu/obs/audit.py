@@ -346,6 +346,7 @@ class Auditor:
             mode = self._reconcile(cyc.store, cyc.m, anomalies,
                                    force=sampled, failed=err is not None)
             self._audit_ledger(cyc.store, anomalies)
+            self._audit_shards(cyc.store, anomalies)
             if sampled:
                 self._audit_encode_cache(cyc.store, anomalies)
                 self._audit_devincr(cyc.store, anomalies)
@@ -453,6 +454,31 @@ class Auditor:
                     "group": entry.group_uid,
                     "action": entry.action,
                 }))
+
+    # ---------------------------------------------------- cross-shard census
+
+    def _audit_shards(self, store, anomalies: List[Anomaly]) -> None:
+        """Sharded-control-plane ownership census (shard.py, ISSUE 16):
+        every queue must resolve to exactly one IN-RANGE owning shard —
+        a steal override naming a shard outside [0, n_shards) would
+        orphan its queue (no cycle would ever schedule it), which the
+        conservation reconcile above cannot see (an unscheduled queue
+        moves no pods).  Runs under the store lock (end_cycle's calling
+        contract), which is also the lock guarding the table."""
+        table = getattr(store, "shard_table", None)
+        if table is None:
+            return
+        n = table.n_shards
+        bad = {
+            name: int(owner)
+            for name, owner in table._overrides.items()
+            if not 0 <= int(owner) < n
+        }
+        if bad:
+            anomalies.append(Anomaly("shard-ownership-violation", {
+                "n_shards": n,
+                "overrides": bad,
+            }))
 
     # -------------------------------------------------- coherence samples
 

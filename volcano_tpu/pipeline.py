@@ -50,14 +50,15 @@ class InflightSolve:
     __slots__ = (
         "kind", "payload", "solve_jobs", "task_rows", "req_gather",
         "mutation_seq", "epoch", "compact_gen", "n_nodes", "solve_id",
-        "fallbacks", "dirty_seq", "devincr_token",
+        "fallbacks", "dirty_seq", "devincr_token", "shard", "shard_seq",
     )
 
     def __init__(self, kind: str, payload, solve_jobs: List[int],
                  task_rows: np.ndarray, req_gather: Tuple,
                  mutation_seq: int, epoch: int, compact_gen: int,
                  n_nodes: int, solve_id: int = 0, dirty_seq: int = 0,
-                 devincr_token=None):
+                 devincr_token=None, shard: Optional[int] = None,
+                 shard_seq: Optional[Tuple[int, int]] = None):
         self.kind = kind
         self.payload = payload
         self.solve_jobs = solve_jobs
@@ -92,6 +93,16 @@ class InflightSolve:
         # fastpath's lost-reply handling) — a skipped re-dispatch must
         # never stand in for a result nobody fetched.
         self.devincr_token = devincr_token
+        # Sharded control plane (shard.py, ISSUE 16): the dispatching
+        # shard's index (None on the single-scheduler path) and the
+        # cross-shard gate token captured at dispatch —
+        # (mirror.shard_commit_seq, ShardOwnershipTable.epoch).  An
+        # advance of either component at fetch time means another
+        # shard committed binds (or stole a queue) during the overlap;
+        # the re-validation's competing-bind / capacity-taken voids are
+        # then attributed as `cross-shard-conflict`.
+        self.shard = shard
+        self.shard_seq = shard_seq
 
     # ----------------------------------------------------------- lifecycle
 
@@ -133,27 +144,27 @@ class InflightSolve:
         self.payload = None
 
 
-def take_inflight(store) -> Optional[InflightSolve]:
+def take_inflight(store, shard: Optional[int] = None) -> Optional[InflightSolve]:
     """Pop the store's in-flight solve (None when no dispatch pending).
+    ``shard`` selects a sharded cycle's own slot
+    (``store._shard_inflight[shard]``); None is the default
+    single-scheduler slot.
 
-    The slot is lock-guarded: the cycle thread owns it between dispatch
-    and fetch, but ``store.close()`` and ``Scheduler.stop()`` pop it
-    from other threads (the RLock makes the cycle-thread re-entry
-    free)."""
+    The slots are lock-guarded: each cycle thread owns its own between
+    dispatch and fetch, but ``store.close()`` and ``Scheduler.stop()``
+    pop them from other threads (the RLock makes the cycle-thread
+    re-entry free)."""
     with store._lock:
-        inflight = store._inflight_solve
-        if inflight is not None:
-            store._inflight_solve = None
+        if shard is None:
+            inflight = store._inflight_solve
+            if inflight is not None:
+                store._inflight_solve = None
+        else:
+            inflight = getattr(store, "_shard_inflight", {}).pop(shard, None)
     return inflight
 
 
-def abandon_inflight(store) -> bool:
-    """Drop a pending dispatch, if any (scheduler shutdown / restart:
-    the solved pods stay Pending and re-place on the next cycle).
-    Returns True when one was abandoned."""
-    inflight = take_inflight(store)
-    if inflight is None:
-        return False
+def _abandon_one(store, inflight: InflightSolve) -> None:
     log.info("abandoning in-flight solve of %d task rows",
              len(inflight.task_rows))
     # The abandoned solve's result is lost: void the null-delta skip
@@ -163,7 +174,33 @@ def abandon_inflight(store) -> bool:
     if dvc is not None and inflight.devincr_token is not None:
         dvc.skip_token = None
     inflight.abandon()
-    return True
+
+
+def abandon_inflight(store, shard: Optional[int] = None) -> bool:
+    """Drop pending dispatches (scheduler shutdown / restart: the
+    solved pods stay Pending and re-place on the next cycle).
+    ``shard=None`` drains the default slot AND every per-shard slot
+    (store teardown); an integer drains only that shard's slot (one
+    shard's Scheduler stopping must not void its siblings' solves).
+    Returns True when at least one was abandoned."""
+    if shard is not None:
+        inflight = take_inflight(store, shard)
+        if inflight is None:
+            return False
+        _abandon_one(store, inflight)
+        return True
+    pending: List[InflightSolve] = []
+    with store._lock:
+        if store._inflight_solve is not None:
+            pending.append(store._inflight_solve)
+            store._inflight_solve = None
+        shard_slots = getattr(store, "_shard_inflight", None)
+        if shard_slots:
+            pending.extend(shard_slots.values())
+            shard_slots.clear()
+    for inflight in pending:
+        _abandon_one(store, inflight)
+    return bool(pending)
 
 
 class InflightPlan:

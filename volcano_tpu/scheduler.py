@@ -104,6 +104,7 @@ class Scheduler:
         conf_str: Optional[str] = None,
         schedule_period: float = 1.0,
         gate=None,
+        shard=None,
     ):
         self.store = store
         self.conf_path = conf_path
@@ -112,6 +113,12 @@ class Scheduler:
         # Optional leadership gate: the periodic loop skips cycles while it
         # returns False (active/passive HA, see volcano_tpu.ha).
         self.gate = gate
+        # Sharded control plane (shard.py, ISSUE 16): this loop's
+        # shard.ShardContext, or None for the default single-scheduler
+        # path.  A sharded loop runs the fast path only (the object
+        # session is not shard-aware and would double-schedule foreign
+        # queues) and drains only its OWN in-flight slot on stop.
+        self.shard = shard
         self._stop = threading.Event()
         # run()/stop() may race from different operator threads (service
         # shutdown vs a late start); the lifecycle lock makes the leak
@@ -185,15 +192,28 @@ class Scheduler:
         drain = getattr(self.store, "drain_bind_failures", None)
         if drain is not None:
             drain()
+        # Work stealing (shard.py, ISSUE 16): an idle shard claims the
+        # most-starved foreign queue BEFORE its cycle snapshots, so the
+        # stolen backlog is schedulable this very cycle.
+        if self.shard is not None:
+            self.shard.maybe_steal(self.store)
         with metrics.e2e_timer(), _device_trace():
-            if self._fastpath_enabled():
+            if self._fastpath_enabled() or self.shard is not None:
                 enable_compilation_cache()
                 from .fastpath import run_cycle_fast
 
                 try:
-                    if run_cycle_fast(self.store, conf):
+                    if run_cycle_fast(self.store, conf, shard=self.shard):
                         return
                 except Exception:
+                    if self.shard is not None:
+                        # The object session is not shard-aware: falling
+                        # back would re-schedule every shard's queues
+                        # from one thread and double-bind against the
+                        # siblings' in-flight solves.  Fail the cycle
+                        # loudly instead; the loop's failure accounting
+                        # and healthy() surface it.
+                        raise
                     if not self._fallback_sensible():
                         # At hyperscale the object session takes hours
                         # per cycle; silently "falling back" would stall
@@ -207,6 +227,15 @@ class Scheduler:
                     log.exception(
                         "Fast path failed; falling back to object session"
                     )
+            if self.shard is not None:
+                # Ineligible config (custom plugins / solver) under
+                # sharding: there is no shard-aware fallback.  Loud
+                # failure > silently double-scheduling foreign queues.
+                raise RuntimeError(
+                    "sharded scheduler requires a fast-path-eligible "
+                    "configuration (VOLCANO_TPU_SHARDS=1 restores the "
+                    "object-session fallback)"
+                )
             # An in-flight pipelined solve must not survive into the
             # object session: its pods still read as Pending there and
             # would double-schedule when the fast path later committed
@@ -402,8 +431,14 @@ class Scheduler:
                     return
                 self._thread = None
         # Only after the thread is dead: the cycle thread owns the
-        # in-flight handle while it runs.
+        # in-flight handle while it runs.  A sharded loop drains only
+        # its OWN slot — its siblings' parked solves are still live.
         from .pipeline import abandon_inflight, abandon_inflight_plan
 
-        abandon_inflight(self.store)
-        abandon_inflight_plan(self.store)
+        if self.shard is not None:
+            abandon_inflight(self.store, shard=self.shard.index)
+            if self.shard.runs_evictions:
+                abandon_inflight_plan(self.store)
+        else:
+            abandon_inflight(self.store)
+            abandon_inflight_plan(self.store)

@@ -215,7 +215,13 @@ class Service:
                 load_store(state_path, self.store)
         self.admitted = AdmittedStore(self.store)
         self.controllers = ControllerManager(self.store)
-        self.scheduler = Scheduler(
+        # Sharded control plane (shard.py, ISSUE 16): VOLCANO_TPU_SHARDS
+        # > 1 runs N queue-partitioned cycle threads with optimistic
+        # cross-shard commits; the default (1) is the plain single
+        # Scheduler, bitwise identical to the pre-sharding path.
+        from .shard import make_scheduler
+
+        self.scheduler = make_scheduler(
             self.store, conf_path=conf_path, schedule_period=schedule_period,
             gate=self.is_leader,
         )
@@ -393,6 +399,16 @@ class Service:
                             for a in (auditor.anomalies(n)
                                       if auditor is not None else [])
                         ])
+                    elif parts[:2] == ["debug", "shards"]:
+                        # Sharded control plane state (shard.py, ISSUE
+                        # 16): ownership table + per-shard counters.
+                        # Reads only immutable snapshots and
+                        # single-writer ints — NEVER the store lock —
+                        # so a scrape cannot block any cycle thread.
+                        snap = getattr(service.scheduler,
+                                       "debug_snapshot", None)
+                        self._json(200, snap() if snap is not None
+                                   else {"shards": 1})
                     elif parts[:2] == ["debug", "trace"]:
                         # Perfetto/chrome://tracing trace of the last K
                         # cycles (?cycles=K, default the whole ring).
