@@ -1411,9 +1411,12 @@ def config_endurance():
         real compactions) and re-add fresh clones into their gangs, so
         the backlog holds and the add/delete conservation flows run."""
         nonlocal clone_seq
-        running = [p for p in store.pods.values()
-                   if int(p.task_status()) == st_running
-                   and not p.deleting][:n]
+        # Snapshot under the store lock (the async bind dispatcher
+        # mutates `pods` concurrently; the lockdep leg enforces this).
+        with store._lock:
+            running = [p for p in store.pods.values()
+                       if int(p.task_status()) == st_running
+                       and not p.deleting][:n]
         for pod in running:
             store.delete_pod(pod)
             clone_seq += 1
@@ -1446,9 +1449,11 @@ def config_endurance():
         return gname
 
     def _teardown_wave(gname):
-        for p in [p for p in store.pods.values()
-                  if (p.annotations or {}).get(
-                      GROUP_NAME_ANNOTATION) == gname]:
+        with store._lock:  # snapshot: binds land concurrently
+            members = [p for p in store.pods.values()
+                       if (p.annotations or {}).get(
+                           GROUP_NAME_ANNOTATION) == gname]
+        for p in members:
             store.delete_pod(p)
         if f"default/{gname}" in store.pod_groups:
             store.delete_pod_group(f"default/{gname}")
@@ -1527,7 +1532,8 @@ def config_endurance():
     flap_every = max(cycles // 10, 20)
     wave_every = max(cycles // 4, 25)
     kill_at = {cycles // 2, (3 * cycles) // 4}
-    compact0 = store.mirror.compact_gen
+    with store._lock:  # compact_gen is lock-guarded mirror state
+        compact0 = store.mirror.compact_gen
     node_names = [f"node-{i:06d}" for i in range(n_nodes)]
     flaps = kills = 0
     flapped = None  # (name, restore_at_cycle)
@@ -1614,6 +1620,9 @@ def config_endurance():
                                   len(times_ms) - 1)], 2)
 
     ledger = store.migrations
+    with store._lock:  # lock-guarded store/mirror state for the tail
+        shard_table = store.shard_table
+        compact_gen = store.mirror.compact_gen
     endurance = {
         "cycles": cycles,
         "anomalies": anoms,
@@ -1635,7 +1644,7 @@ def config_endurance():
         "preempt_evictions": int(sum(
             _metrics.preempt_evictions.data.values())),
         "solver_kills": kills,
-        "compactions": store.mirror.compact_gen - compact0,
+        "compactions": compact_gen - compact0,
         "pods_deleted": clone_seq,
         "ledger_restored": (ledger.restored_pods
                             if ledger is not None else 0),
@@ -1661,7 +1670,7 @@ def config_endurance():
                     _metrics.shard_steals.data.values())),
                 "per_shard": [ctx.debug_snapshot()
                               for ctx in sched.shards],
-                "table": store.shard_table.snapshot(),
+                "table": shard_table.snapshot(),
             } if shards_n > 1 else None),
     }
     _collect_audit(store)

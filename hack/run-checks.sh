@@ -13,14 +13,24 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/3] vclint (static analysis) =="
+echo "== [1/4] vclint (static analysis) =="
 python -m tools.vclint
 
-echo "== [2/3] csrc sanitizer smoke (ASAN + TSAN, -Werror) =="
+echo "== [2/4] csrc sanitizer smoke (ASAN + TSAN, -Werror) =="
 make -C csrc test
 
-echo "== [3/3] tier-1 pytest =="
+echo "== [3/4] tier-1 pytest =="
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider "$@"
+
+echo "== [4/4] lockdep leg (runtime lock enforcement) =="
+# The concurrency-heavy suites once more with the annotation-derived
+# runtime lockdep armed (obs/lockdep.py): any unguarded access to a
+# guarded-by attribute or lock-order inversion lands in the auditor
+# ring and fails the run.  Kept to the threaded suites — lockdep is
+# process-global once armed, and these are where the races live.
+env JAX_PLATFORMS=cpu VOLCANO_TPU_LOCKDEP=1 python -m pytest \
+  tests/test_lockdep.py tests/test_shards.py tests/test_solver_pool.py \
+  tests/test_pipeline.py -q -p no:cacheprovider -p no:randomly
 
 echo "run-checks: all green"

@@ -50,9 +50,37 @@ fi
 # BENCH_ENDURANCE_SHARDS=<n> (forces pool=1 — one wire lane per shard).
 : "${BENCH_ENDURANCE_SHARDS:=2}"
 export BENCH_ENDURANCE_SHARDS
+shard_secs=""
 if [ "${BENCH_ENDURANCE_SHARDS}" -gt 1 ]; then
+  t0=$SECONDS
   BENCH_ENDURANCE=1 \
     BENCH_ENDURANCE_CYCLES=$(( BENCH_ENDURANCE_CYCLES / 2 > 150 \
       ? BENCH_ENDURANCE_CYCLES / 2 : 150 )) python bench.py "$@"
+  shard_secs=$(( SECONDS - t0 ))
   echo "endurance shard leg OK (0 anomalies, shards=${BENCH_ENDURANCE_SHARDS})"
+fi
+
+# Lockdep leg (ISSUE 17): the shard-leg shape once more with the
+# annotation-derived runtime lock enforcement armed
+# (VOLCANO_TPU_LOCKDEP=1, obs/lockdep.py) — every guarded-by attribute
+# access is checked against the held-lock set and every acquisition
+# feeds the process-wide order graph.  Violations land in the auditor
+# ring as lockdep-violation / lock-order-cycle anomalies, so the same
+# zero-anomaly exit gates them.  The wall-clock delta vs the
+# enforcement-off shard leg above is the measured lockdep overhead.
+# Skip with BENCH_ENDURANCE_LOCKDEP=0.
+: "${BENCH_ENDURANCE_LOCKDEP:=1}"
+if [ "${BENCH_ENDURANCE_LOCKDEP}" != "0" ]; then
+  t0=$SECONDS
+  BENCH_ENDURANCE=1 VOLCANO_TPU_LOCKDEP=1 \
+    BENCH_ENDURANCE_CYCLES=$(( BENCH_ENDURANCE_CYCLES / 2 > 150 \
+      ? BENCH_ENDURANCE_CYCLES / 2 : 150 )) python bench.py "$@"
+  lockdep_secs=$(( SECONDS - t0 ))
+  if [ -n "${shard_secs}" ] && [ "${shard_secs}" -gt 0 ]; then
+    echo "endurance lockdep leg OK (0 anomalies," \
+      "${lockdep_secs}s vs ${shard_secs}s enforcement-off," \
+      "overhead $(( (lockdep_secs - shard_secs) * 100 / shard_secs ))%)"
+  else
+    echo "endurance lockdep leg OK (0 anomalies, ${lockdep_secs}s)"
+  fi
 fi

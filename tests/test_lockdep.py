@@ -1,0 +1,278 @@
+"""Runtime lockdep (obs/lockdep.py, VOLCANO_TPU_LOCKDEP=1): the
+annotation-derived enforcement must catch an injected unguarded
+cross-thread write and an injected lock-order inversion, honor the
+static suppression convention, stay fully inert behind its kill
+switch, and run the pipelined sharded store anomaly-free.
+
+Plus the writer-triad runtime regression the static family surfaced:
+``EvictState.flush``'s failure-path reverts must stamp
+``mutation_seq`` (the action loop stamped BEFORE the reverts).
+
+Tier-1, CPU-only.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from volcano_tpu.api import (
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    PodPhase,
+    PriorityClass,
+    Queue,
+)
+from volcano_tpu.cache import ClusterStore
+from volcano_tpu.cache.interface import EvictFailure
+from volcano_tpu.obs import lockdep
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.synth import synthetic_cluster
+
+EVICT_CONF = """
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _lockdep_anomalies(store):
+    with store.auditor._lock:
+        return [a.to_dict() for a in store.auditor._ring
+                if a.reason in ("lockdep-violation", "lock-order-cycle")]
+
+
+# ------------------------------------------------------- kill switch
+# Runs first in this file: asserts the probe never armed in THIS
+# process before any enabling test below flips it on.
+
+
+def test_kill_switch_leaves_store_unwrapped(monkeypatch):
+    monkeypatch.delenv("VOLCANO_TPU_LOCKDEP", raising=False)
+    lockdep.reset()
+    store = ClusterStore()
+    try:
+        assert lockdep.stats()["active"] is False
+        assert not isinstance(store._lock, lockdep._LockProxy)
+        assert "_vclockdep_armed" not in store.__dict__
+        if not lockdep._installed:
+            assert not any(
+                isinstance(v, lockdep._GuardedDescriptor)
+                for v in vars(ClusterStore).values()
+            )
+        # Unguarded access reports nothing with the switch off.
+        store._solve_seq = 7
+        _ = store._solve_seq
+        assert _lockdep_anomalies(store) == []
+    finally:
+        store.close()
+
+
+# -------------------------------------------------------- fixtures
+
+
+@pytest.fixture()
+def armed_store(monkeypatch):
+    monkeypatch.setenv("VOLCANO_TPU_LOCKDEP", "1")
+    store = ClusterStore()
+    assert lockdep.stats()["active"] is True
+    assert isinstance(store._lock, lockdep._LockProxy)
+    yield store
+    store.close()
+    lockdep.reset()
+
+
+# ------------------------------------------------------- violations
+
+
+def test_injected_unguarded_cross_thread_write_caught(armed_store):
+    store = armed_store
+
+    def rogue():
+        store._solve_seq = 99  # guarded-by _lock, no lock held
+
+    t = threading.Thread(target=rogue, name="rogue-writer")
+    t.start()
+    t.join()
+
+    got = _lockdep_anomalies(store)
+    assert len(got) == 1
+    detail = got[0]["detail"]
+    assert got[0]["reason"] == "lockdep-violation"
+    assert detail["attribute"] == "_solve_seq"
+    assert detail["lock"] == "_lock"
+    assert detail["access"] == "write"
+    assert detail["thread"] == "rogue-writer"
+    assert any("test_lockdep" in fr for fr in detail["stack"])
+    # The same broken site reports once, not per hit.
+    t2 = threading.Thread(target=rogue, name="rogue-writer-2")
+    t2.start()
+    t2.join()
+    assert len(_lockdep_anomalies(store)) == 1
+
+
+def test_guarded_access_under_lock_is_clean(armed_store):
+    store = armed_store
+    with store._lock:
+        store._solve_seq = 3
+        assert store._solve_seq == 3
+    assert lockdep.held_locks() == {}
+    assert _lockdep_anomalies(store) == []
+
+
+def test_injected_lock_order_inversion_caught(armed_store):
+    store = armed_store
+
+    def ab():
+        with store._lock:
+            with store._events_lock:
+                pass
+
+    def ba():
+        with store._events_lock:
+            with store._lock:
+                pass
+
+    for name, fn in (("t-ab", ab), ("t-ba", ba)):
+        t = threading.Thread(target=fn, name=name)
+        t.start()
+        t.join()
+
+    cycles = [a for a in _lockdep_anomalies(store)
+              if a["reason"] == "lock-order-cycle"]
+    assert len(cycles) == 1
+    detail = cycles[0]["detail"]
+    assert {detail["held"], detail["acquiring"]} == {
+        "_lock", "_events_lock"}
+    assert detail["cycle"][0] == detail["cycle"][-1]
+    assert set(detail["cycle"]) == {"_lock", "_events_lock"}
+
+
+def test_static_suppression_honored_at_runtime(armed_store):
+    store = armed_store
+    # vclint: disable=VCL101 -- reviewed unguarded probe (this test)
+    _ = store.bind_backoff
+    assert _lockdep_anomalies(store) == []
+    # ... and the same read WITHOUT the annotation is a violation.
+    _ = store.bind_backoff
+    got = _lockdep_anomalies(store)
+    assert len(got) == 1
+    assert got[0]["detail"]["attribute"] == "bind_backoff"
+
+
+# ------------------------------------------------- enforcement smoke
+
+
+def test_pipelined_shard_store_runs_clean_under_enforcement(monkeypatch):
+    """The pipelined, sharded control plane schedules a synthetic
+    cluster end to end with enforcement on and reports nothing — the
+    runtime analog of the committed tree linting clean."""
+    from volcano_tpu.shard import ShardedScheduler
+
+    monkeypatch.setenv("VOLCANO_TPU_LOCKDEP", "1")
+    store = synthetic_cluster(n_nodes=4, n_pods=8, gang_size=2)
+    try:
+        store.pipeline = True
+        sched = ShardedScheduler(store, shards=2)
+        for _ in range(4):
+            for s in sched.schedulers:
+                s.run_once()
+        store.flush_binds(timeout=30)
+        assert _lockdep_anomalies(store) == []
+        with store._lock:
+            assert all(p.node_name for p in store.pods.values())
+    finally:
+        store.close()
+        lockdep.reset()
+
+
+# ------------------------------------- flush revert mutation_seq fix
+
+
+class _AlwaysFailEvictor:
+    """Evictor whose batch dispatch rejects every key."""
+
+    def __init__(self):
+        self.batches = 0
+
+    def evict_keys(self, keys, reason="preempted"):
+        self.batches += 1
+        raise EvictFailure(list(keys))
+
+    def evict(self, pod):
+        raise EvictFailure([f"{pod.namespace}/{pod.name}"])
+
+
+def _oversubscribed_store() -> ClusterStore:
+    store = ClusterStore()
+    store.add_priority_class(PriorityClass(name="low", value=100))
+    store.add_priority_class(PriorityClass(name="high", value=10000))
+    store.add_queue(Queue(name="victim", weight=1))
+    store.add_queue(Queue(name="premium", weight=9))
+    store.add_node(Node(name="n0",
+                        allocatable={"cpu": "16", "memory": "32Gi"}))
+    for k in range(2):
+        pg = PodGroup(name=f"fill-{k}", min_member=1, queue="victim")
+        store.add_pod_group(pg)
+        store.add_pod(Pod(
+            name=f"fill-{k}-0",
+            annotations={GROUP_NAME_ANNOTATION: pg.name},
+            containers=[{"cpu": "8", "memory": "16Gi"}],
+            phase=PodPhase.Running, node_name="n0",
+            priority_class="low", priority=100,
+        ))
+    store.add_pod_group(PodGroup(name="hi", min_member=1,
+                                 queue="premium"))
+    store.add_pod(Pod(
+        name="hi-0",
+        annotations={GROUP_NAME_ANNOTATION: "hi"},
+        containers=[{"cpu": "12", "memory": "8Gi"}],
+        priority_class="high", priority=10000,
+    ))
+    return store
+
+
+def test_flush_failure_revert_stamps_mutation_seq(monkeypatch):
+    """When evictions fail and flush() reverts the victims to Running,
+    the revert itself must advance mutation_seq — the action loop
+    stamped BEFORE flush ran, so without the fresh stamp the pipelined
+    staleness guard and the cross-shard commit gate would validate an
+    in-flight solve against pre-revert state."""
+    from volcano_tpu.fastpath_evict import EvictState
+
+    deltas = []
+    orig_flush = EvictState.flush
+
+    def spy(self):
+        before = self.cyc.m.mutation_seq
+        orig_flush(self)
+        if self.evicted_rows:
+            deltas.append(self.cyc.m.mutation_seq - before)
+
+    monkeypatch.setattr(EvictState, "flush", spy)
+
+    store = _oversubscribed_store()
+    try:
+        evictor = _AlwaysFailEvictor()
+        store.evictor = evictor
+        Scheduler(store, conf_str=EVICT_CONF).run_once()
+        assert evictor.batches >= 1, "preempt never dispatched evictions"
+        # All victims reverted (nothing left terminating) ...
+        with store._lock:
+            assert not any(p.deleting for p in store.pods.values())
+        # ... and the revert batch stamped the mutation counter.
+        assert deltas and all(d >= 1 for d in deltas), deltas
+    finally:
+        store.close()

@@ -79,7 +79,11 @@ def _add_gang(store, queue, name, pods, cpu="1", node_selector=None):
 
 
 def _bind_map(store):
-    return {p.name: p.node_name for p in store.pods.values()}
+    # Under the store lock: `pods` is a guarded attribute, and the
+    # lockdep leg (VOLCANO_TPU_LOCKDEP=1) holds test code to the same
+    # contract as the runtime.
+    with store._lock:
+        return {p.name: p.node_name for p in store.pods.values()}
 
 
 def _conflict_total():
@@ -236,7 +240,8 @@ def test_idle_shard_steals_most_starved_queue():
     thief.run_once()
     store.flush_binds()
 
-    assert sched.table.epoch == 1
+    with store._lock:
+        assert sched.table.epoch == 1
     assert sched.table.snapshot()["overrides"] == {qx: 1}
     assert sched.shards[1].steals == 1
     assert sum(metrics.shard_steals.data.values()) == steals_before + 1
@@ -247,7 +252,8 @@ def test_idle_shard_steals_most_starved_queue():
     # Ping-pong guard: qx is drained, so the thief is idle again — but
     # the donor's ONLY remaining pending queue (qy) must not move.
     thief.run_once()
-    assert sched.table.epoch == 1
+    with store._lock:
+        assert sched.table.epoch == 1
     assert sched.shards[1].steals == 1
 
     # Moving a queue back to its base owner clears the override: the

@@ -294,15 +294,19 @@ def test_whatif_offload_overlap(servers, monkeypatch):
     store.remote_solver = pool
     ClusterSimulator.priority_tier_workload(store, workers=4,
                                             serving_tasks=2)
-    n_logical = len(store.pods)
+    # Lock held for the read: the lockdep leg (VOLCANO_TPU_LOCKDEP=1)
+    # holds test code to the same guarded-attribute contract.
+    with store._lock:
+        n_logical = len(store.pods)
     sched = Scheduler(store, conf_str=PREEMPT_CONF)
     sim = ClusterSimulator(store, grace_steps=2)
     bound = 0
     for _ in range(16):
         sched.run_once()
         sim.step()
-        bound = sum(1 for p in store.pods.values()
-                    if p.name.startswith("serving-") and p.node_name)
+        with store._lock:
+            bound = sum(1 for p in store.pods.values()
+                        if p.name.startswith("serving-") and p.node_name)
         if bound >= 2:
             break
     assert bound >= 2, "serving gang did not bind"
@@ -313,7 +317,8 @@ def test_whatif_offload_overlap(servers, monkeypatch):
     assert ledger is not None and ledger.committed_plans >= 1
     # Commit semantics unchanged: zero lost pods (every victim
     # restored), budgets intact.
-    assert len(store.pods) == n_logical
+    with store._lock:
+        assert len(store.pods) == n_logical
     assert store.auditor.total_anomalies() == 0
     store.close()
     pool.close()

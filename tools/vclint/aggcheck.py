@@ -34,6 +34,7 @@ import ast
 import re
 from typing import Dict, Iterable, List, Sequence, Set, Tuple
 
+from . import astcache
 from .findings import Finding
 
 # Slot -> invalidation tokens that must appear in the union of its
@@ -201,7 +202,7 @@ def analyze_files(sources: Sequence[Tuple[str, str]]) -> List[Finding]:
 
     for rel, src in sources:
         try:
-            tree = ast.parse(src)
+            tree = astcache.parse(src)
         except SyntaxError as err:
             findings.append(Finding(
                 "VCL001", rel, err.lineno or 1,

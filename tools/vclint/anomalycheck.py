@@ -30,12 +30,14 @@ import ast
 import re
 from typing import Dict, List, Sequence, Tuple
 
+from . import astcache
 from .findings import Finding
 
 # Files whose Anomaly(...) constructions define the emitted set.
 SCAN_FILES: Sequence[str] = (
     "volcano_tpu/obs/audit.py",
     "volcano_tpu/obs/slo.py",
+    "volcano_tpu/obs/lockdep.py",
 )
 
 _DOC_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9-]*)`\s*\|")
@@ -47,7 +49,7 @@ def emitted_reasons(path: str, src: str
     call in ``src``; VCL603 for non-literal reasons."""
     findings: List[Finding] = []
     try:
-        tree = ast.parse(src)
+        tree = astcache.parse(src)
     except SyntaxError as err:
         return {}, [Finding(
             "VCL001", path, err.lineno or 1,

@@ -1,0 +1,42 @@
+"""Shared AST parse cache (ISSUE 17 gate-speed satellite).
+
+The gate used to re-parse the same sources once per analyzer family —
+``fastpath.py`` alone is ~4k lines and sits in the lock, hot-path,
+aggregate-cache, and writer-discipline file sets.  Every family now
+parses through this memo, so each distinct source text is parsed
+exactly once per process no matter how many families (or ``--jobs``
+workers) consume it.
+
+Trees are treated as immutable by every consumer (pure ``ast.walk``
+reads), so sharing one tree across concurrently-running families is
+safe.  Keyed by the source text itself: the repo's file reads are
+already deduplicated by the driver, and fixture tests feed small
+synthetic strings, so the memo stays tiny; a cap guards pathological
+long-lived processes.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+from typing import Dict
+
+_MAX_ENTRIES = 512
+
+_lock = threading.Lock()
+_memo: Dict[str, ast.Module] = {}
+
+
+def parse(source: str) -> ast.Module:
+    """``ast.parse`` with memoization.  Raises SyntaxError like
+    ``ast.parse`` (failures are never cached)."""
+    with _lock:
+        tree = _memo.get(source)
+    if tree is not None:
+        return tree
+    tree = ast.parse(source)
+    with _lock:
+        if len(_memo) >= _MAX_ENTRIES:
+            _memo.clear()
+        _memo[source] = tree
+    return tree

@@ -1,9 +1,15 @@
-"""vclint driver: run the three analyzer families over the repo.
+"""vclint driver: run the analyzer families over the repo.
 
 ``python -m tools.vclint`` exits 0 only when the committed tree carries
 zero unsuppressed findings — it is the first leg of the pre-snapshot
 green-gate (``hack/run-checks.sh``), ahead of the csrc ASAN/TSAN smoke
 and the tier-1 pytest suite.
+
+The driver reads every file once into a shared source cache and every
+family parses through ``astcache`` (one AST per distinct source no
+matter how many families consume it).  ``--only <family>`` runs a
+single family; ``--jobs N`` runs families concurrently (they share the
+read-only caches).
 """
 
 from __future__ import annotations
@@ -11,35 +17,17 @@ from __future__ import annotations
 import argparse
 import sys
 from pathlib import Path
-from typing import List
+from typing import Callable, Dict, List, Optional, Tuple
 
-from . import (aggcheck, anomalycheck, hotpath, lockcheck, metricscheck,
-               schemacheck)
+from . import (aggcheck, anomalycheck, hotpath, knobcheck, lockcheck,
+               metricscheck, schemacheck, writercheck)
+# The lock-discipline file set lives with the annotation parser
+# (tools/vclint/annotations.py) so the runtime lockdep enforces the
+# exact same surface; re-exported here for compatibility.
+from .annotations import LOCK_FILES  # noqa: F401
 from .findings import Finding, finish
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
-
-# Files under the lock-discipline analysis (the concurrency surface of
-# the pipelined scheduler: shared store state, the mirror, the in-flight
-# solve handle, the remote-solver client, the flight-recorder ring the
-# HTTP debug handlers read cross-thread).
-LOCK_FILES = [
-    "volcano_tpu/cache/store.py",
-    "volcano_tpu/cache/mirror.py",
-    "volcano_tpu/cache/bindqueue.py",
-    "volcano_tpu/pipeline.py",
-    "volcano_tpu/scheduler.py",
-    "volcano_tpu/shard.py",
-    "volcano_tpu/solver_service.py",
-    "volcano_tpu/solver_pool.py",
-    "volcano_tpu/fastpath.py",
-    "volcano_tpu/fastpath_evict.py",
-    "volcano_tpu/whatif.py",
-    "volcano_tpu/ops/devsnap.py",
-    "volcano_tpu/obs/recorder.py",
-    "volcano_tpu/obs/audit.py",
-    "volcano_tpu/obs/slo.py",
-]
 
 # Metrics-drift surface: every series in the registry must have a row
 # in the docs table and vice versa (VCL401/402/403).
@@ -53,6 +41,10 @@ METRICS_FILES = {
 # row and vice versa.
 ANOMALY_DOC = "docs/observability.md"
 
+# Tuning-knob surface (VCL710/711): every VOLCANO_TPU_* env read in
+# volcano_tpu/ must have a docs/tuning.md row and vice versa.
+KNOB_DOC = "docs/tuning.md"
+
 SCHEMA_FILES = {
     "snapwire": "volcano_tpu/cache/snapwire.py",
     "schema": "volcano_tpu/arrays/schema.py",
@@ -62,137 +54,183 @@ SCHEMA_FILES = {
 }
 
 
-def _read(rel: str, root: Path) -> str:
-    return (root / rel).read_text()
+class _Sources:
+    """Read-once file cache shared by every family (and safe to share
+    across ``--jobs`` workers: entries are immutable strings)."""
+
+    def __init__(self, root: Path):
+        self.root = root
+        self._text: Dict[str, str] = {}
+
+    def text(self, rel: str) -> str:
+        src = self._text.get(rel)
+        if src is None:
+            src = (self.root / rel).read_text()
+            self._text[rel] = src
+        return src
+
+    def pairs(self, rels, missing_msg: str
+              ) -> Tuple[List[Tuple[str, str]], List[Finding]]:
+        out, missing = [], []
+        for rel in rels:
+            try:
+                out.append((rel, self.text(rel)))
+            except OSError:
+                missing.append(Finding("VCL001", rel, 1, missing_msg))
+        return out, missing
 
 
-def run(root: Path = REPO_ROOT, verbose: bool = False,
-        out=sys.stdout) -> int:
-    all_findings: List[Finding] = []
-
-    # ---- lock discipline (two-pass: cross-file registries) ----------
-    sources = []
-    for rel in LOCK_FILES:
-        path = root / rel
-        if path.is_file():
-            sources.append((rel, path.read_text()))
-        else:
-            all_findings.append(Finding(
-                "VCL001", rel, 1,
-                "lock-discipline file set names a missing file",
-            ))
-    raw = lockcheck.analyze_files(sources)
-    by_file = {rel: [] for rel, _ in sources}
+def _finish_grouped(sources, raw) -> List[Finding]:
+    by_file: Dict[str, List[Finding]] = {rel: [] for rel, _ in sources}
     for f in raw:
         by_file.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
     for rel, src in sources:
-        all_findings.extend(finish(rel, src, by_file.get(rel, [])))
+        out.extend(finish(rel, src, by_file.get(rel, [])))
+    return out
 
-    # ---- hot-path hygiene ------------------------------------------
+
+# ---------------------------------------------------------------- families
+
+def _run_lock(cache: _Sources) -> List[Finding]:
+    sources, missing = cache.pairs(
+        LOCK_FILES, "lock-discipline file set names a missing file")
+    raw = lockcheck.analyze_files(sources)
+    return missing + _finish_grouped(sources, raw)
+
+
+def _run_hotpath(cache: _Sources) -> List[Finding]:
+    out: List[Finding] = []
     for rel, entries in hotpath.HOT_REGISTRY.items():
-        path = root / rel
-        if not path.is_file():
-            all_findings.append(Finding(
-                "VCL001", rel, 1,
-                "hot registry names a missing file",
-            ))
+        try:
+            src = cache.text(rel)
+        except OSError:
+            out.append(Finding(
+                "VCL001", rel, 1, "hot registry names a missing file"))
             continue
-        src = path.read_text()
-        all_findings.extend(finish(rel, src, hotpath.analyze_file(
-            rel, src, entries
-        )))
+        out.extend(finish(rel, src, hotpath.analyze_file(
+            rel, src, entries)))
+    return out
 
-    # ---- schema <-> ABI --------------------------------------------
+
+def _run_schema(cache: _Sources) -> List[Finding]:
     try:
-        texts = {k: _read(rel, root) for k, rel in SCHEMA_FILES.items()}
+        texts = {k: cache.text(rel) for k, rel in SCHEMA_FILES.items()}
     except OSError as err:
-        all_findings.append(Finding(
+        return [Finding(
             "VCL001", str(err.filename or "?"), 1,
             f"schema cross-check input unreadable: {err}",
-        ))
-    else:
-        raw3 = schemacheck.analyze(
-            SCHEMA_FILES["snapwire"], texts["snapwire"],
-            SCHEMA_FILES["schema"], texts["schema"],
-            SCHEMA_FILES["cc"], texts["cc"],
-            SCHEMA_FILES["header"], texts["header"],
-            SCHEMA_FILES["native"], texts["native"],
-        )
-        by_path = {}
-        for f in raw3:
-            by_path.setdefault(f.path, []).append(f)
-        for key, rel in SCHEMA_FILES.items():
-            all_findings.extend(finish(
-                rel, texts[key], by_path.get(rel, [])
-            ))
+        )]
+    raw = schemacheck.analyze(
+        SCHEMA_FILES["snapwire"], texts["snapwire"],
+        SCHEMA_FILES["schema"], texts["schema"],
+        SCHEMA_FILES["cc"], texts["cc"],
+        SCHEMA_FILES["header"], texts["header"],
+        SCHEMA_FILES["native"], texts["native"],
+    )
+    return _finish_grouped(
+        [(rel, texts[k]) for k, rel in SCHEMA_FILES.items()], raw)
 
-    # ---- persistent cycle-aggregate cache contract (VCL50x) --------
-    agg_sources = []
-    for rel in aggcheck.SCAN_FILES:
-        path = root / rel
-        if path.is_file():
-            agg_sources.append((rel, path.read_text()))
-        else:
-            all_findings.append(Finding(
-                "VCL001", rel, 1,
-                "aggregate-cache scan set names a missing file",
-            ))
-    raw5 = aggcheck.analyze_files(agg_sources)
-    by_file5 = {}
-    for f in raw5:
-        by_file5.setdefault(f.path, []).append(f)
-    for rel, src in agg_sources:
-        all_findings.extend(finish(rel, src, by_file5.get(rel, [])))
 
-    # ---- metrics <-> docs drift ------------------------------------
+def _run_agg(cache: _Sources) -> List[Finding]:
+    sources, missing = cache.pairs(
+        aggcheck.SCAN_FILES, "aggregate-cache scan set names a missing file")
+    raw = aggcheck.analyze_files(sources)
+    return missing + _finish_grouped(sources, raw)
+
+
+def _run_metrics(cache: _Sources) -> List[Finding]:
     try:
-        m_src = _read(METRICS_FILES["metrics"], root)
-        d_src = _read(METRICS_FILES["doc"], root)
+        m_src = cache.text(METRICS_FILES["metrics"])
+        d_src = cache.text(METRICS_FILES["doc"])
     except OSError as err:
-        all_findings.append(Finding(
+        return [Finding(
             "VCL001", str(err.filename or "?"), 1,
             f"metrics-drift input unreadable: {err}",
-        ))
-    else:
-        raw4 = metricscheck.analyze(
-            METRICS_FILES["metrics"], m_src, METRICS_FILES["doc"], d_src,
-        )
-        by_path4 = {}
-        for f in raw4:
-            by_path4.setdefault(f.path, []).append(f)
-        for key, rel in METRICS_FILES.items():
-            src4 = m_src if key == "metrics" else d_src
-            all_findings.extend(finish(rel, src4, by_path4.get(rel, [])))
+        )]
+    raw = metricscheck.analyze(
+        METRICS_FILES["metrics"], m_src, METRICS_FILES["doc"], d_src,
+    )
+    return _finish_grouped(
+        [(METRICS_FILES["metrics"], m_src),
+         (METRICS_FILES["doc"], d_src)], raw)
 
-    # ---- anomaly catalog <-> docs drift ----------------------------
-    anom_sources = []
-    for rel in anomalycheck.SCAN_FILES:
-        path = root / rel
-        if path.is_file():
-            anom_sources.append((rel, path.read_text()))
-        else:
-            all_findings.append(Finding(
-                "VCL001", rel, 1,
-                "anomaly-catalog scan set names a missing file",
-            ))
+
+def _run_anomaly(cache: _Sources) -> List[Finding]:
+    sources, missing = cache.pairs(
+        anomalycheck.SCAN_FILES,
+        "anomaly-catalog scan set names a missing file")
     try:
-        anom_doc = _read(ANOMALY_DOC, root)
+        doc = cache.text(ANOMALY_DOC)
     except OSError as err:
-        all_findings.append(Finding(
+        missing.append(Finding(
             "VCL001", ANOMALY_DOC, 1,
             f"anomaly-catalog doc unreadable: {err}",
         ))
-    else:
-        raw6 = anomalycheck.analyze(anom_sources, ANOMALY_DOC, anom_doc)
-        by_path6 = {}
-        for f in raw6:
-            by_path6.setdefault(f.path, []).append(f)
-        for rel, src6 in anom_sources + [(ANOMALY_DOC, anom_doc)]:
-            all_findings.extend(finish(
-                rel, src6, by_path6.get(rel, [])
-            ))
+        return missing
+    raw = anomalycheck.analyze(sources, ANOMALY_DOC, doc)
+    return missing + _finish_grouped(sources + [(ANOMALY_DOC, doc)], raw)
 
-    # ---- report -----------------------------------------------------
+
+def _tree_sources(cache: _Sources) -> List[Tuple[str, str]]:
+    out = []
+    for rel in writercheck.iter_py_files(cache.root):
+        try:
+            out.append((rel, cache.text(rel)))
+        except OSError:
+            pass  # racing deletion; the tree glob is re-derived per run
+    return out
+
+
+def _run_writer(cache: _Sources) -> List[Finding]:
+    sources = _tree_sources(cache)
+    raw = writercheck.analyze_files(sources)
+    return _finish_grouped(sources, raw)
+
+
+def _run_knob(cache: _Sources) -> List[Finding]:
+    sources = _tree_sources(cache)
+    try:
+        doc = cache.text(KNOB_DOC)
+    except OSError as err:
+        return [Finding(
+            "VCL001", KNOB_DOC, 1,
+            f"tuning-knob doc unreadable: {err}",
+        )]
+    raw = knobcheck.analyze(sources, KNOB_DOC, doc)
+    return _finish_grouped(sources + [(KNOB_DOC, doc)], raw)
+
+
+FAMILIES: Dict[str, Callable[[_Sources], List[Finding]]] = {
+    "lock": _run_lock,
+    "hotpath": _run_hotpath,
+    "schema": _run_schema,
+    "agg": _run_agg,
+    "metrics": _run_metrics,
+    "anomaly": _run_anomaly,
+    "writer": _run_writer,
+    "knob": _run_knob,
+}
+
+
+def run(root: Path = REPO_ROOT, verbose: bool = False,
+        out=sys.stdout, jobs: int = 1,
+        only: Optional[str] = None) -> int:
+    cache = _Sources(root)
+    names = [only] if only else list(FAMILIES)
+    all_findings: List[Finding] = []
+
+    if jobs > 1 and len(names) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            futures = [pool.submit(FAMILIES[n], cache) for n in names]
+            for fut in futures:  # family order, not completion order
+                all_findings.extend(fut.result())
+    else:
+        for n in names:
+            all_findings.extend(FAMILIES[n](cache))
+
     open_findings = [f for f in all_findings if not f.suppressed]
     suppressed = [f for f in all_findings if f.suppressed]
     for f in open_findings:
@@ -203,11 +241,12 @@ def run(root: Path = REPO_ROOT, verbose: bool = False,
     print(
         f"vclint: {len(open_findings)} finding(s), "
         f"{len(suppressed)} suppressed "
-        f"({len(sources)} lock files, "
+        f"({len(LOCK_FILES)} lock files, "
         f"{sum(len(v) for v in hotpath.HOT_REGISTRY.values())} hot "
         f"functions, {len(aggcheck.CACHE_REGISTRY)} keyed caches, "
+        f"{len(writercheck.WRITER_REGISTRY)} registered writers, "
         "1 schema/ABI surface, 1 metrics/docs surface, "
-        "1 anomaly-catalog surface)",
+        "1 anomaly-catalog surface, 1 tuning-knob surface)",
         file=out,
     )
     return 1 if open_findings else 0
@@ -217,14 +256,22 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="vclint",
         description="repo-native static analysis: lock discipline, "
-        "device hot-path hygiene, schema<->C++ ABI drift",
+        "device hot-path hygiene, schema<->C++ ABI drift, writer "
+        "triad discipline, docs drift",
     )
     parser.add_argument("--root", default=str(REPO_ROOT),
                         help="repo root (default: auto-detected)")
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="also print suppressed findings")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="run analyzer families in N threads")
+    parser.add_argument("--only", choices=sorted(FAMILIES),
+                        help="run a single analyzer family")
     args = parser.parse_args(argv)
-    return run(Path(args.root), verbose=args.verbose)
+    if args.jobs < 1:
+        parser.error("--jobs must be >= 1")
+    return run(Path(args.root), verbose=args.verbose, jobs=args.jobs,
+               only=args.only)
 
 
 if __name__ == "__main__":

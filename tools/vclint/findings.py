@@ -11,6 +11,9 @@ Finding codes (see docs/static_analysis.md for the full catalog):
           mirror's mutation_seq/epoch/compact_gen machinery)
 - VCL6xx  anomaly-catalog drift (runtime-auditor reasons vs
           docs/observability.md)
+- VCL70x  writer-triad discipline (dynamic-column mutators must mark
+          dirty, declare an audit flow, and bump mutation_seq)
+- VCL71x  tuning-knob drift (VOLCANO_TPU_* env reads vs docs/tuning.md)
 
 Suppression convention: a finding is silenced by a trailing comment on
 the SAME line it is reported at, or by a comment-only line DIRECTLY
@@ -32,8 +35,10 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-# Codes that may never be suppressed (suppression hygiene itself).
-UNSUPPRESSABLE = {"VCL001", "VCL002"}
+# Codes that may never be suppressed (suppression hygiene itself —
+# VCL705 lives here so a reasonless writer-exemption cannot be silenced
+# by a second annotation).
+UNSUPPRESSABLE = {"VCL001", "VCL002", "VCL705"}
 
 CODE_TITLES = {
     "VCL001": "malformed vclint annotation",
@@ -59,6 +64,13 @@ CODE_TITLES = {
     "VCL601": "anomaly reason missing from docs/observability.md",
     "VCL602": "catalogued anomaly reason never emitted",
     "VCL603": "anomaly reason is not a string literal",
+    "VCL701": "dynamic-column writer never marks the dirty set",
+    "VCL702": "dynamic-column writer declares no audit flow",
+    "VCL703": "dynamic-column writer never bumps mutation_seq",
+    "VCL704": "unregistered writer-shaped function",
+    "VCL705": "writer exemption without a reason",
+    "VCL710": "env knob read but undocumented in docs/tuning.md",
+    "VCL711": "documented knob never read by the runtime",
 }
 
 

@@ -29,6 +29,7 @@ import ast
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from . import astcache
 from .findings import Finding
 
 # Call leaf names whose results are device-resident (taint sources).
@@ -646,7 +647,7 @@ def analyze_file(path: str, source: str,
     Returns RAW findings (suppressions applied by the caller)."""
     findings: List[Finding] = []
     try:
-        tree = ast.parse(source)
+        tree = astcache.parse(source)
     except SyntaxError as err:
         return [Finding("VCL001", path, err.lineno or 1,
                         f"file does not parse: {err.msg}")]

@@ -1,0 +1,112 @@
+"""Tuning-knob drift analyzer (VCL71x): env reads ↔ docs/tuning.md.
+
+Every ``VOLCANO_TPU_*`` environment variable the runtime reads is an
+operator-facing knob: docs/tuning.md is its contract (default +
+meaning), the same way docs/metrics.md is the metrics contract (VCL401)
+and docs/observability.md the anomaly contract (VCL601).  ~50 getenv
+sites had accumulated with nothing keeping the table honest; this
+family closes the loop both ways:
+
+- **VCL710** — a ``VOLCANO_TPU_*`` env read in ``volcano_tpu/`` has no
+  row in docs/tuning.md (reported at the read site).
+- **VCL711** — a documented knob row names a variable the runtime never
+  reads (reported at the table row) — unless listed in ``DOC_ONLY``
+  with the reason it lives outside the package.
+
+Extraction is AST-based: a string literal matching ``VOLCANO_TPU_*``
+counts as a *read* when it appears as a call argument (``environ.get``,
+``getenv``, and the repo's ``_env_int``/``_env_on``-style wrappers), as
+an ``environ[...]`` subscript, or in a membership test against the
+environment.  Literals in other positions (dict keys for
+``/debug/health``'s armed-verifier listing, docstrings) do not count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from . import astcache
+from .findings import Finding
+
+_KNOB_RE = re.compile(r"^VOLCANO_TPU_[A-Z0-9_]+$")
+_DOC_ROW_RE = re.compile(r"^\|\s*`(VOLCANO_TPU_[A-Z0-9_]+)`\s*\|")
+
+# Documented knobs deliberately read OUTSIDE volcano_tpu/ — the reason
+# is part of the entry so the allowance stays reviewable.
+DOC_ONLY: Dict[str, str] = {
+    # Read by tests/test_evict_oracle.py and hack/run-fuzz-nightly.sh:
+    # the differential-fuzz seed count is a harness knob, not a runtime
+    # one, but operators tune it from the same table.
+    "VOLCANO_TPU_FUZZ_SEEDS": "fuzz-harness knob (tests/, hack/)",
+}
+
+
+def env_reads(path: str, src: str) -> Dict[str, int]:
+    """knob -> first lineno for every env read in ``src``."""
+    try:
+        tree = astcache.parse(src)
+    except SyntaxError:
+        return {}
+    out: Dict[str, int] = {}
+
+    def _note(node: ast.AST) -> None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _KNOB_RE.match(node.value):
+            out.setdefault(node.value, node.lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for arg in node.args:
+                _note(arg)
+            for kw in node.keywords:
+                _note(kw.value)
+        elif isinstance(node, ast.Subscript):
+            _note(node.slice)
+        elif isinstance(node, ast.Compare):
+            # "VOLCANO_TPU_X" in os.environ
+            _note(node.left)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            # Knob tables: obs/slo.py's (lane, env-var) rows are read
+            # through a loop, so the literal never appears as a direct
+            # call argument.
+            for elt in node.elts:
+                _note(elt)
+    return out
+
+
+def documented_knobs(doc_src: str) -> Dict[str, int]:
+    """knob -> first lineno for every docs/tuning.md table row."""
+    out: Dict[str, int] = {}
+    for lineno, text in enumerate(doc_src.splitlines(), start=1):
+        m = _DOC_ROW_RE.match(text.strip())
+        if m:
+            out.setdefault(m.group(1), lineno)
+    return out
+
+
+def analyze(sources: Sequence[Tuple[str, str]], doc_path: str,
+            doc_src: str) -> List[Finding]:
+    findings: List[Finding] = []
+    read: Dict[str, Tuple[str, int]] = {}
+    for path, src in sources:
+        for knob, lineno in env_reads(path, src).items():
+            read.setdefault(knob, (path, lineno))
+    docs = documented_knobs(doc_src)
+    for knob, (path, lineno) in sorted(read.items()):
+        if knob not in docs:
+            findings.append(Finding(
+                "VCL710", path, lineno,
+                f"env knob '{knob}' is read here but has no row in "
+                f"{doc_path}",
+            ))
+    for knob, lineno in sorted(docs.items()):
+        if knob not in read and knob not in DOC_ONLY:
+            findings.append(Finding(
+                "VCL711", doc_path, lineno,
+                f"documented knob '{knob}' is never read by "
+                "volcano_tpu/ (stale row, or add a DOC_ONLY entry "
+                "with the out-of-package reader)",
+            ))
+    return findings

@@ -25,6 +25,7 @@ import ast
 import re
 from typing import Dict, List, Tuple
 
+from . import astcache
 from .findings import Finding
 
 _SERIES_CTORS = {
@@ -45,7 +46,7 @@ def registry_series(metrics_path: str,
     constructs."""
     findings: List[Finding] = []
     try:
-        tree = ast.parse(metrics_src)
+        tree = astcache.parse(metrics_src)
     except SyntaxError as err:
         return {}, [Finding(
             "VCL001", metrics_path, err.lineno or 1,

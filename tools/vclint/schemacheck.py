@@ -33,6 +33,7 @@ import ast
 import re
 from typing import Dict, List, Optional, Tuple
 
+from . import astcache
 from .findings import Finding
 
 # numpy dtype name -> element width (the static mirror of np.dtype(x).
@@ -52,7 +53,7 @@ def parse_snapwire(source: str) -> Tuple[
         Optional[int]]:
     """(_DTYPES names in order, WIRE_* constants, REC_* delta record
     tags as name -> (value, line), _DTYPES line)."""
-    tree = ast.parse(source)
+    tree = astcache.parse(source)
     names: List[str] = []
     consts: Dict[str, int] = {}
     recs: Dict[str, Tuple[int, int]] = {}
@@ -88,7 +89,7 @@ def parse_wire_columns(source: str) -> Tuple[
         Optional[int]]:
     """(WIRE_COLUMNS rows, NamedTuple class -> ordered ndarray fields,
     WIRE_COLUMNS line)."""
-    tree = ast.parse(source)
+    tree = astcache.parse(source)
     rows: List[Tuple[str, str, str, int]] = []
     line: Optional[int] = None
     classes: Dict[str, List[str]] = {}
@@ -273,7 +274,7 @@ def parse_native_bindings(source: str) -> Tuple[
         List[Tuple[int, str]]]:
     """From _bind(): fn name -> (restype, argtypes, line); plus parse
     errors."""
-    tree = ast.parse(source)
+    tree = astcache.parse(source)
     out: Dict[str, Tuple[Optional[str], Optional[List[str]], int]] = {}
     errors: List[Tuple[int, str]] = []
     bind = None

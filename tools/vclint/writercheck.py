@@ -1,0 +1,365 @@
+"""Writer-discipline analyzer (VCL70x): the mirror mutation triad.
+
+The rebuild replaces Go's compiler-enforced invariants with a Python
+convention that three PRs stacked up: every mutator of the mirror's
+dynamic pod state must
+
+1. **mark the dirty set** (``mark_pods_dirty`` / ``mark_pod_dirty`` /
+   ``mark_pods_overflow``) so the incremental host lanes (ISSUE 8)
+   refresh the touched rows,
+2. **declare its conservation-audit flow** (``_audit_flow`` /
+   ``flow_rows`` / the store-edge ``flow_added``/``flow_removed``, or
+   ``reanchor`` for bulk re-derives) so the runtime auditor's
+   double-entry census (ISSUE 13) reconciles, and
+3. **bump ``mutation_seq``** so the pipelined staleness guard and the
+   cross-shard optimistic commit gate (ISSUE 16) see the move.
+
+Until now nothing checked the triad statically — a new writer missing
+one leg is a silent lost-pod / stale-commit bug the endurance harness
+only catches probabilistically.  This family turns the triad into a
+registry-backed contract over the whole ``volcano_tpu/`` tree:
+
+- **VCL701** — a registered writer's closure never marks the dirty set.
+- **VCL702** — a registered writer's closure never declares an audit
+  flow.
+- **VCL703** — a registered writer's closure never bumps
+  ``mutation_seq``.
+- **VCL704** — a writer-shaped function (one that stores into the
+  dynamic pod columns ``p_status``/``p_node``/``p_alive``, directly or
+  through a one-level local alias) is neither registered in
+  ``WRITER_REGISTRY`` nor annotated ``# vclint: writer-exempt --
+  reason``.
+- **VCL705** — a ``writer-exempt`` annotation without a ``-- reason``
+  (unsuppressable, like VCL002).
+
+Like aggcheck, each writer's evidence closure is the function itself
+plus ONE level of locally-defined helpers it calls — key helpers like
+``_audit_flow_rows`` count toward their callers.  A triad leg a writer
+deliberately delegates (``_backfill``'s caller stamps the sequence;
+``EvictState.evict`` relies on the owning action) is waived IN the
+registry with the contract spelled out, so the delegation is a
+reviewed, greppable decision rather than a silent hole.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import astcache
+from .findings import Finding
+
+# The mirror's dynamic pod columns: the state the triad protects.
+# Static spec columns (p_prio, p_feat, affinity ranges, ...) are
+# append-only per row and carry no cross-cycle mutation story.
+DYN_COLS = {"p_status", "p_node", "p_alive"}
+
+DIRTY_CALLS = {"mark_pods_dirty", "mark_pod_dirty", "mark_pods_overflow"}
+AUDIT_CALLS = {"_audit_flow", "_audit_flow_rows", "flow", "flow_added",
+               "flow_removed", "flow_rows", "reanchor"}
+SEQ_ATTR = "mutation_seq"
+
+# Every known mutator of the dynamic pod columns, with its triad
+# contract.  A leg is either "self" (the evidence must appear in the
+# writer's one-hop closure) or a waiver string documenting WHO
+# satisfies the leg instead — the registry is the reviewed record of
+# every delegation.
+WRITER_REGISTRY: Dict[str, Dict[str, str]] = {
+    # -- mirror store-edge writers (all three legs local) -------------
+    "volcano_tpu/cache/mirror.py::StoreMirror.upsert_pod": {
+        "dirty": "self", "audit": "self", "seq": "self",
+    },
+    "volcano_tpu/cache/mirror.py::StoreMirror.remove_pod": {
+        "dirty": "self", "audit": "self", "seq": "self",
+    },
+    "volcano_tpu/cache/mirror.py::StoreMirror.set_pod_state": {
+        "dirty": "self", "audit": "self", "seq": "self",
+    },
+    "volcano_tpu/cache/mirror.py::StoreMirror.upsert_node": {
+        "dirty": "self",
+        "audit": "orphan adopt moves p_node only -- no status "
+                 "transition, the per-status census is unchanged",
+        "seq": "self",
+    },
+    "volcano_tpu/cache/mirror.py::StoreMirror.resync_status": {
+        # Bulk re-derive: mark_pods_overflow voids the whole dirty
+        # mask; reanchor voids the census compare.
+        "dirty": "self", "audit": "self", "seq": "self",
+    },
+    "volcano_tpu/cache/mirror.py::StoreMirror.maybe_compact": {
+        "dirty": "compact_gen bump forces the aggregate consumer to "
+                 "full-rebuild; the fresh zero mask is exactly right",
+        "audit": "row renumbering preserves the per-status census "
+                 "exactly (only tombstones drop); the attached auditor "
+                 "survives the swap",
+        "seq": "self",
+    },
+    # -- fast-path commit/unbind/backfill -----------------------------
+    "volcano_tpu/fastpath.py::FastCycle._commit": {
+        "dirty": "self", "audit": "self", "seq": "self",
+    },
+    "volcano_tpu/fastpath.py::FastCycle._unbind_rows": {
+        "dirty": "self", "audit": "self", "seq": "self",
+    },
+    "volcano_tpu/fastpath.py::FastCycle._backfill": {
+        "dirty": "self", "audit": "self",
+        "seq": "run_cycle_fast stamps mutation_seq when _backfill "
+               "reports bound rows (disjoint rows from the solve, one "
+               "stamp per action)",
+    },
+    # -- eviction machinery -------------------------------------------
+    "volcano_tpu/fastpath_evict.py::EvictState.evict": {
+        "dirty": "self", "audit": "self",
+        "seq": "the owning action stamps mutation_seq once per batch "
+               "(fastpath action loop / whatif.commit_plan / "
+               "FastEvictor flush)",
+    },
+    "volcano_tpu/fastpath_evict.py::EvictState.unevict": {
+        "dirty": "self", "audit": "self",
+        "seq": "the owning action stamps mutation_seq once per batch "
+               "(rollback inside the planner, or the flush revert "
+               "path, which stamps after its unevicts)",
+    },
+    "volcano_tpu/whatif.py::commit_plan": {
+        "dirty": "delegates to EvictState.evict, which marks each "
+                 "victim row",
+        "audit": "delegates to EvictState.evict, which declares the "
+                 "running->releasing flow per victim",
+        "seq": "self",
+    },
+}
+
+_EXEMPT_RE = re.compile(r"#\s*vclint:\s*writer-exempt"
+                        r"(?:\s*--\s*(\S[^\n]*))?")
+
+
+def _call_leaf(node: ast.Call) -> Optional[str]:
+    return getattr(node.func, "id", None) or getattr(node.func, "attr",
+                                                    None)
+
+
+def _leg_facts(fn: ast.AST) -> Dict[str, bool]:
+    """Which triad legs the function's own body satisfies."""
+    dirty = audit = seq = False
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            leaf = _call_leaf(sub)
+            if leaf in DIRTY_CALLS:
+                dirty = True
+            elif leaf in AUDIT_CALLS:
+                audit = True
+        elif isinstance(sub, ast.AugAssign):
+            if isinstance(sub.target, ast.Attribute) \
+                    and sub.target.attr == SEQ_ATTR:
+                seq = True
+        elif isinstance(sub, ast.Assign):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and tgt.attr == SEQ_ATTR:
+                    seq = True
+    return {"dirty": dirty, "audit": audit, "seq": seq}
+
+
+def _functions(tree: ast.Module):
+    """(qualname, node) for top-level functions and class methods."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _local_facts(tree: ast.Module) -> Dict[str, Dict[str, bool]]:
+    """Bare name -> leg facts, the one-hop helper table (aggcheck
+    idiom: methods register under their bare name)."""
+    out: Dict[str, Dict[str, bool]] = {}
+    for qual, fn in _functions(tree):
+        bare = qual.rsplit(".", 1)[-1]
+        facts = _leg_facts(fn)
+        prev = out.get(bare)
+        if prev is None:
+            out[bare] = facts
+        else:
+            for k, v in facts.items():
+                prev[k] = prev[k] or v
+    return out
+
+
+def _closure_facts(fn: ast.AST,
+                   local_facts: Dict[str, Dict[str, bool]]
+                   ) -> Dict[str, bool]:
+    """Leg facts of ``fn`` plus those of locally-defined helpers it
+    calls (one hop)."""
+    facts = _leg_facts(fn)
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            leaf = _call_leaf(sub)
+            helper = local_facts.get(leaf) if leaf else None
+            if helper:
+                for k, v in helper.items():
+                    facts[k] = facts[k] or v
+    return facts
+
+
+def _dynamic_write_sites(fn: ast.AST) -> List[Tuple[str, int]]:
+    """(column, line) for every store into a dynamic pod column inside
+    ``fn`` — direct attribute subscripts/rebinds, plus subscript stores
+    through a one-level local alias of a dynamic column."""
+    aliases: Set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Assign):
+            base = sub.value
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) and base.attr in DYN_COLS:
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+    sites: List[Tuple[str, int]] = []
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Subscript) \
+                and isinstance(sub.ctx, (ast.Store, ast.Del)):
+            if isinstance(sub.value, ast.Attribute) \
+                    and sub.value.attr in DYN_COLS:
+                sites.append((sub.value.attr, sub.lineno))
+            elif isinstance(sub.value, ast.Name) \
+                    and sub.value.id in aliases:
+                sites.append((sub.value.id, sub.lineno))
+        elif isinstance(sub, ast.Attribute) \
+                and isinstance(sub.ctx, ast.Store) \
+                and sub.attr in DYN_COLS:
+            sites.append((sub.attr, sub.lineno))
+    return sites
+
+
+def _exemption_for_def(lines: List[str], node
+                       ) -> Tuple[bool, Optional[int]]:
+    """(is_exempt, reasonless_line).  Looks at the def line, its
+    decorators, and the line directly above (the # holds: idiom)."""
+    candidates = [node.lineno]
+    for dec in getattr(node, "decorator_list", []):
+        candidates.append(dec.lineno)
+    candidates.append(min(candidates) - 1)
+    for lineno in candidates:
+        if 1 <= lineno <= len(lines):
+            m = _EXEMPT_RE.search(lines[lineno - 1])
+            if m:
+                if not (m.group(1) or "").strip():
+                    return False, lineno
+                return True, None
+    return False, None
+
+
+_CTOR_EXEMPT = {"__init__", "__new__", "__del__"}
+
+
+def analyze_files(sources: Sequence[Tuple[str, str]]) -> List[Finding]:
+    """``sources``: [(rel_path, text)] over the whole volcano_tpu tree.
+    Returns raw findings (caller applies suppressions)."""
+    findings: List[Finding] = []
+    # qualified name ("rel::Class.method") -> (fn node, lines, facts)
+    seen: Dict[str, Tuple[ast.AST, int]] = {}
+    closure: Dict[str, Dict[str, bool]] = {}
+
+    for rel, src in sources:
+        try:
+            tree = astcache.parse(src)
+        except SyntaxError as err:
+            findings.append(Finding(
+                "VCL001", rel, err.lineno or 1,
+                f"writercheck could not parse: {err.msg}",
+            ))
+            continue
+        lines = src.splitlines()
+        local_facts = _local_facts(tree)
+        # Reasonless writer-exempt markers anywhere in the file: the
+        # annotation is load-bearing, so a reasonless one is hygiene
+        # breakage even when it attaches to nothing (VCL705).
+        flagged_lines: Set[int] = set()
+        for qual, fn in _functions(tree):
+            key = f"{rel}::{qual}"
+            seen[key] = (fn, fn.lineno)
+            if key in WRITER_REGISTRY:
+                closure[key] = _closure_facts(fn, local_facts)
+                continue
+            if fn.name in _CTOR_EXEMPT:
+                # The object is not published yet (same exemption the
+                # lock checker grants).
+                continue
+            sites = _dynamic_write_sites(fn)
+            if not sites:
+                continue
+            exempt, reasonless = _exemption_for_def(lines, fn)
+            if reasonless is not None:
+                flagged_lines.add(reasonless)
+                findings.append(Finding(
+                    "VCL705", rel, reasonless,
+                    "writer-exempt annotation carries no '-- reason' "
+                    "justification",
+                ))
+                continue
+            if exempt:
+                continue
+            col, lineno = sites[0]
+            findings.append(Finding(
+                "VCL704", rel, lineno,
+                f"{qual} writes dynamic pod column '{col}' but is not "
+                "registered in writercheck.WRITER_REGISTRY (declare "
+                "its dirty-mark/audit-flow/mutation_seq triad) and "
+                "carries no '# vclint: writer-exempt -- reason'",
+            ))
+        # VCL705 for reasonless markers not adjacent to any def.
+        for lineno, text in enumerate(lines, start=1):
+            m = _EXEMPT_RE.search(text)
+            if m and not (m.group(1) or "").strip() \
+                    and lineno not in flagged_lines:
+                findings.append(Finding(
+                    "VCL705", rel, lineno,
+                    "writer-exempt annotation carries no '-- reason' "
+                    "justification",
+                ))
+
+    # Registered writers: resolve and verify each "self" leg.
+    leg_codes = {"dirty": "VCL701", "audit": "VCL702", "seq": "VCL703"}
+    leg_what = {
+        "dirty": "never marks the dirty set "
+                 "(mark_pods_dirty/mark_pod_dirty/mark_pods_overflow)",
+        "audit": "never declares a conservation-audit flow "
+                 "(_audit_flow/flow_rows/flow_added/flow_removed/"
+                 "reanchor)",
+        "seq": "never bumps mutation_seq",
+    }
+    for key, legs in sorted(WRITER_REGISTRY.items()):
+        entry = seen.get(key)
+        if entry is None:
+            rel = key.split("::", 1)[0]
+            findings.append(Finding(
+                "VCL001", rel, 1,
+                f"writer registry names a missing function: {key}",
+            ))
+            continue
+        _fn, lineno = entry
+        facts = closure.get(key, {})
+        for leg, policy in legs.items():
+            if policy != "self":
+                continue  # waived in-registry with a documented reason
+            if not facts.get(leg):
+                rel = key.split("::", 1)[0]
+                qual = key.split("::", 1)[1]
+                findings.append(Finding(
+                    leg_codes[leg], rel, lineno,
+                    f"registered writer {qual} {leg_what[leg]} in its "
+                    "one-hop closure",
+                ))
+    return findings
+
+
+def iter_py_files(root) -> Iterable[str]:
+    """Relative paths of every volcano_tpu Python source under root."""
+    base = root / "volcano_tpu"
+    for path in sorted(base.rglob("*.py")):
+        yield str(path.relative_to(root))

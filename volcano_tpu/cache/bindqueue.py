@@ -49,6 +49,12 @@ class BindDispatcher:
         self._q: List[Tuple[Sequence[str], Sequence[str], Sequence[object]]] = []
         self._stopped = False  # guarded-by: _cv
         self._inflight = 0  # guarded-by: _cv
+        # Runtime lockdep (obs/lockdep.py): created lazily, after the
+        # owning store's construction-time walk — arm before the worker
+        # thread can race the wrap.  No-op when the probe is off.
+        from ..obs.lockdep import attach
+
+        attach(self)
         self._thread = threading.Thread(
             target=self._run, name="vc-bind-dispatch", daemon=True
         )

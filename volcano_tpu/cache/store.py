@@ -253,6 +253,13 @@ class ClusterStore:
         self.auditor = Auditor()
         self.auditor.slo = SLOTracker()
         self.mirror.audit = self.auditor
+        # Runtime lock enforcement (obs/lockdep.py, VOLCANO_TPU_LOCKDEP=1):
+        # wraps this store's object graph so `# guarded-by:` annotations
+        # are asserted live.  A no-op (one env read) when the switch is
+        # off.
+        from ..obs.lockdep import enable_lockdep
+
+        enable_lockdep(self)
         # Monotonic pipelined solve-id: the flow link between a
         # dispatch span in cycle N and its commit spans in cycle N+1.
         self._solve_seq = 0  # guarded-by: _lock

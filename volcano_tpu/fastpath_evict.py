@@ -343,6 +343,13 @@ class EvictState:
         if failed:
             log.warning("%d evictions failed; pods revert to Running",
                         len(failed))
+            # The unevict reverts above flipped p_status AFTER the
+            # action loop already stamped the mutation counter: without
+            # a fresh stamp the pipelined staleness guard (and the
+            # cross-shard commit gate) would judge an in-flight solve
+            # against pre-revert state and happily commit onto rows
+            # that moved back to Running.  One stamp covers the batch.
+            m.mutation_seq += 1
         if ledger is not None:
             # Ledgered victims whose eviction actually dispatched
             # (failed ones were cancelled above): the counters must

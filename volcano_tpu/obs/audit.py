@@ -305,6 +305,20 @@ class Auditor:
             if found:
                 self._pending.extend(found)
 
+    def report(self, anomaly: Anomaly) -> None:
+        """Out-of-band anomaly intake (the lockdep probe, obs/lockdep.py):
+        thread-safe, lands in the same ring/counters the cycle-end
+        audits feed, bypassing ``enabled``/sampling — the reporter has
+        its own kill switch and must not be silenced by audit
+        sampling."""
+        with self._lock:
+            self._ring.append(anomaly)
+            self.anomaly_counts[anomaly.reason] = (
+                self.anomaly_counts.get(anomaly.reason, 0) + 1)
+        from ..metrics import metrics
+
+        metrics.audit_anomalies.inc(reason=anomaly.reason)
+
     def reanchor(self, why: str) -> None:
         """Void the next reconcile (bulk resync: the declared-flow
         model can no longer match; re-anchor the census instead of

@@ -170,7 +170,10 @@ def _abandon_one(store, inflight: InflightSolve) -> None:
     # The abandoned solve's result is lost: void the null-delta skip
     # proof its dispatch anchored, or a restarted scheduler facing an
     # unchanged store would skip forever while the pods stay Pending.
-    dvc = getattr(store, "_devincr_cache", None)
+    # ``_devincr_cache`` is a guarded store attribute (both callers
+    # invoke this helper AFTER releasing the store lock).
+    with store._lock:
+        dvc = getattr(store, "_devincr_cache", None)
     if dvc is not None and inflight.devincr_token is not None:
         dvc.skip_token = None
     inflight.abandon()

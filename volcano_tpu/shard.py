@@ -103,6 +103,11 @@ class ShardOwnershipTable:
         # Immutable snapshot for lock-free /debug/shards reads (replaced
         # wholesale on every steal; readers see old or new, never torn).
         self._debug = {"epoch": 0, "overrides": {}}
+        # Runtime lockdep (obs/lockdep.py): arm this table when the
+        # probe is active — the table outlives any one store walk.
+        from .obs.lockdep import attach
+
+        attach(self)
 
     # holds: _lock
     def owner_of(self, name: str) -> int:

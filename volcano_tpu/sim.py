@@ -51,12 +51,18 @@ class ClusterSimulator:
         deleting pods still inside their grace window this tick).
         """
         started = finished = deleted = terminating = 0
+        # Snapshot under the store lock (`pods` is a guarded attribute
+        # — the async bind dispatcher mutates it concurrently), then
+        # step unlocked: the per-pod transitions below go through the
+        # store's public API, which takes the lock itself.
+        with self.store._lock:
+            pods = list(self.store.pods.values())
         if self._terminating:  # skip the O(pods) set on the common path
-            live = {p.uid for p in self.store.pods.values()}
+            live = {p.uid for p in pods}
             for uid in list(self._terminating):
                 if uid not in live:  # deleted out-of-band
                     del self._terminating[uid]
-        for pod in list(self.store.pods.values()):
+        for pod in pods:
             if pod.deleting:
                 left = self._terminating.get(pod.uid)
                 if left is None:
@@ -173,7 +179,8 @@ class ClusterSimulator:
     def fail_pod(self, uid: str, exit_code: int = 1) -> None:
         """Inject a pod failure (fault injection; the reference's e2e kills
         pods to trigger policies, job_error_handling.go:145-276)."""
-        pod = self.store.pods[uid]
+        with self.store._lock:
+            pod = self.store.pods[uid]
         updated = copy.copy(pod)
         updated.exit_code = exit_code
         updated.phase = PodPhase.Failed
@@ -192,6 +199,8 @@ class ClusterSimulator:
         spec = node_info.node
         spec.ready = False
         self.store.update_node(spec)
-        for pod in list(self.store.pods.values()):
+        with self.store._lock:
+            resident = list(self.store.pods.values())
+        for pod in resident:
             if pod.node_name == name and pod.phase == PodPhase.Running:
                 self.fail_pod(pod.uid, exit_code=255)

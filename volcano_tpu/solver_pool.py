@@ -221,6 +221,12 @@ class SolverPool:
         from .obs.trace import null_tracer
 
         self._tracer = null_tracer()
+        # Runtime lockdep (obs/lockdep.py): the pool is usually attached
+        # to a store AFTER that store's construction-time walk, so it
+        # arms itself.  No-op unless VOLCANO_TPU_LOCKDEP enabled it.
+        from .obs.lockdep import attach
+
+        attach(self)
 
     # ------------------------------------------------------- client shims
 
