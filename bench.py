@@ -172,6 +172,11 @@ def _attach_remote(store):
 # tail carries the audited-cycles count + measured overhead).
 _AUDIT_TAIL = None
 
+# Journey tail (ISSUE 18): same stash pattern for the pod-journey log —
+# every pipelined tail carries ttb_p50/p95/p99 and the gang
+# time-to-full-bind percentiles.
+_JOURNEY_TAIL = None
+
 
 def _collect_audit(store):
     global _AUDIT_TAIL
@@ -180,11 +185,18 @@ def _collect_audit(store):
         _AUDIT_TAIL = a.audit_stats()
 
 
+def _collect_journey(store):
+    global _JOURNEY_TAIL
+    jr = getattr(store, "journey", None)
+    if jr is not None:
+        _JOURNEY_TAIL = jr.stats()
+
+
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
           records=None, fallbacks=None, rebalance=None, devincr=None,
           wire=None, preempt=None, compile_ms=None, warmup_cycles=None,
           composed=None, endurance=None, pool=None, shards=None):
-    global _AUDIT_TAIL
+    global _AUDIT_TAIL, _JOURNEY_TAIL
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
         budget_ms = NORTH_STAR_MS * (n_pods / NORTH_STAR_PODS)
@@ -253,6 +265,11 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # audit-overhead datapoint.
         payload["audit"] = _AUDIT_TAIL
         _AUDIT_TAIL = None
+    if _JOURNEY_TAIL is not None:
+        # Pod-journey block (ISSUE 18): time-to-bind percentiles + gang
+        # time-to-full-bind over the benched store's journey log.
+        payload["journey"] = _JOURNEY_TAIL
+        _JOURNEY_TAIL = None
     if lanes:
         # Lane split rides in the JSON tail so the driver's BENCH_rXX
         # artifacts carry the per-mode breakdown, not just the total.
@@ -364,6 +381,7 @@ def _cycle_bench(make_store, conf, repeats, warm_store=None):
         records.extend(store_r.flight.recent())
         store_r.flush_binds()
         _collect_audit(store_r)
+        _collect_journey(store_r)
         # The dispatcher thread's callbacks pin the store; stop it so the
         # repeat's full mirror is actually freed.
         store_r.close()
@@ -535,6 +553,7 @@ def _pipelined_bench(make_store, conf, cycles=None):
         if dv is not None:
             devincr["null_delta_skips"] = dv.counts["skip"] - skip0
     _collect_audit(store)
+    _collect_journey(store)
     store.close()
     if client is not None:
         client.close()
@@ -1526,9 +1545,54 @@ def config_endurance():
     # endurance phase below reports that directly too.
     overhead_ms0 = auditor.audit_stats()["overhead_ms"]
 
+    # ---- phase 3b: journey-overhead A/B (ISSUE 18) ------------------
+    # Same interleaved-pairs design, toggling the pod-journey log
+    # instead of the auditor: detaching the store/mirror handles is the
+    # journey's kill switch, so the off leg pays exactly one getattr
+    # per seam.  Scored identically (median pairwise delta / median
+    # off), with one refinement: each leg takes the MIN of two cycles.
+    # The journey's steady-state cost is microseconds against cycles
+    # whose one-sided spikes (gc, jit warms, tombstone derives) are
+    # milliseconds — a single-sample leg couples those spikes straight
+    # into the pairwise delta, and min-of-two filters them without
+    # biasing a genuine per-cycle cost (which both samples would pay).
+    jr = store.journey
+    t_joff, t_jon = [], []
+    if jr is not None:
+        for k in range(ab_n):
+            for on_leg in ((k % 2 == 0), not (k % 2 == 0)):
+                store.journey = jr if on_leg else None
+                store.mirror.journey = jr if on_leg else None
+                leg = []
+                for _ in range(2):
+                    _lifecycle_churn(d_per_cycle)
+                    leg.append(one_cycle())
+                (t_jon if on_leg else t_joff).append(min(leg))
+        store.journey = jr
+        store.mirror.journey = jr
+        # Close the blind window: pods that moved while the journey was
+        # detached re-adopt via a bulk resync (synthetic roots), so the
+        # conservation check at the end stays airtight.
+        with store._lock:
+            m = store.mirror
+            resync_pairs = [(m.p_uid[i], int(m.p_status[i]))
+                            for i in range(len(m.p_uid))
+                            if m.p_alive[i] and m.p_uid[i]]
+        jr.pod_resync(resync_pairs)
+    jdeltas = sorted(on - off for on, off in zip(t_jon, t_joff))
+    med_joff = sorted(t_joff)[len(t_joff) // 2] if t_joff else 0.0
+    journey_overhead_pct = (
+        jdeltas[len(jdeltas) // 2] / med_joff * 100.0
+        if med_joff > 0 else 0.0)
+
     # ---- phase 4: endurance (faults on) -----------------------------
     from volcano_tpu.metrics import metrics as _metrics
 
+    # The in-process truth (the audit_stats idiom): the journey times
+    # its own capture entry points, so the endurance phase also reports
+    # capture time as a fraction of total cycle time directly —
+    # immune to the A/B's noise floor.
+    jcap0 = store.journey.capture_ns if store.journey is not None else 0
     flap_every = max(cycles // 10, 20)
     wave_every = max(cycles // 4, 25)
     kill_at = {cycles // 2, (3 * cycles) // 4}
@@ -1609,6 +1673,25 @@ def config_endurance():
 
     # ---- verdict + tail ---------------------------------------------
     store.cycle_feed = None
+    # Journey conservation (ISSUE 18): every pod the mirror says is
+    # bound-ish must have a complete, orphan-free journey.  Violations
+    # land as journey-orphan / journey-incomplete anomalies in the
+    # auditor ring and fail the gate like any other anomaly.
+    jviol = 0
+    bound_checked = 0
+    if store.journey is not None:
+        bound_mask = (int(TaskStatus.Allocated) | int(TaskStatus.Binding)
+                      | int(TaskStatus.Bound) | int(TaskStatus.Running)
+                      | int(TaskStatus.Succeeded))
+        with store._lock:
+            m = store.mirror
+            bound_uids = [m.p_uid[i] for i in range(len(m.p_uid))
+                          if m.p_alive[i] and m.p_uid[i]
+                          and int(m.p_status[i]) & bound_mask]
+        bound_checked = len(bound_uids)
+        for a in store.journey.conservation_check(bound_uids):
+            jviol += 1
+            auditor.report(a)
     anoms = auditor.total_anomalies()
     with auditor._lock:
         by_reason = dict(auditor.anomaly_counts)
@@ -1672,8 +1755,24 @@ def config_endurance():
                               for ctx in sched.shards],
                 "table": shard_table.snapshot(),
             } if shards_n > 1 else None),
+        # Journey leg (ISSUE 18): capture volume, the conservation
+        # verdict over every bound-ish pod, and the measured capture
+        # overhead — the interleaved journey-off A/B delta AND the
+        # self-timed capture fraction of the endurance phase (the
+        # in-process truth; the A/B's resolution floor is the host's
+        # cycle jitter).  The <2% gate reads journey_direct_pct.
+        "journey": ({
+            **store.journey.stats(),
+            "bound_pods_checked": bound_checked,
+            "conservation_violations": jviol,
+            "journey_overhead_pct": round(journey_overhead_pct, 2),
+            "journey_direct_pct": (round(
+                (store.journey.capture_ns - jcap0) / 1e6
+                / sum(times_ms) * 100.0, 3) if times_ms else 0.0),
+        } if store.journey is not None else None),
     }
     _collect_audit(store)
+    _collect_journey(store)
     _emit(
         f"Endurance @ {n_nodes} nodes x {n_pods} pods "
         f"({cycles} churn cycles, faults on)",
@@ -1855,6 +1954,7 @@ def config_pool():
             "anomalies": store.auditor.total_anomalies(),
         }
         _collect_audit(store)
+        _collect_journey(store)
         times_ms = sorted(t * 1e3 for t in times)
         _emit(
             f"Solver pool A/B @ {n_nodes} nodes x {n_pods} pods "
@@ -2145,6 +2245,7 @@ def config_shards():
             "anomalies": store2.auditor.total_anomalies(),
         }
         _collect_audit(store2)
+        _collect_journey(store2)
 
         tail = {
             "shards": size,

@@ -271,6 +271,72 @@ h = p["hedge_dispatches"]
 print(f"endurance smoke OK ({c} cycles, {k} pool-member kills, "
       f"{h} hedges, {n} compactions, 0 anomalies)")
 '
+# Journey smoke (ISSUE 18): /debug/pods/<uid> + the /debug/health
+# journey rollup on a TWO-SHARD store mid-churn — the stitched
+# cross-shard timeline and the why-pending verdict must serve while
+# the shards are still re-pending and re-binding the backlog, and the
+# conservation check over every bound pod must come back empty.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json, urllib.request
+import numpy as np
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.service import Service
+from volcano_tpu.shard import ShardedScheduler
+from volcano_tpu.synth import synthetic_cluster
+
+ST_BOUND = int(TaskStatus.Bound)
+store = synthetic_cluster(n_nodes=16, n_pods=96, gang_size=4,
+                          n_queues=4, seed=7)
+store.pipeline = True
+
+def feed(fc):
+    m = fc.m
+    rows = np.flatnonzero(
+        (m.p_status[:fc.Pn] == ST_BOUND) & m.p_alive[:fc.Pn])
+    if len(rows):
+        fc._unbind_rows(rows[: max(1, len(rows) // 4)])
+
+store.cycle_feed = feed
+sched = ShardedScheduler(store, shards=2)
+svc = Service(store=store, schedule_period=30.0, controller_period=5.0)
+port = svc.start(http_port=0)
+
+def get(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+def bound_uids():
+    with store._lock:
+        m = store.mirror
+        return [m.p_uid[i] for i in range(len(m.p_uid))
+                if m.p_alive[i] and m.p_uid[i]
+                and int(m.p_status[i]) == ST_BOUND]
+
+try:
+    for i in range(12):
+        sched.run_once()
+        if i == 6:
+            # Mid-churn scrape: half the backlog is in flight right now.
+            uid = bound_uids()[0]
+            tl = get(f"/debug/pods/{uid}")
+            assert tl["uid"] == uid and tl["events"], tl
+            assert tl["events"][0]["kind"] == "enqueued", tl["events"][0]
+            assert "why_pending" in tl, sorted(tl)
+            roll = get("/debug/health")["journey"]
+            assert roll["pods_tracked"] > 0, roll
+            assert any(q["bound_total"] > 0
+                       for q in roll["queues"].values()), roll
+    store.flush_binds()
+    bound = bound_uids()
+    anoms = store.journey.conservation_check(bound)
+    assert not anoms, [a.to_dict() for a in anoms]
+    print(f"journey smoke OK (2 shards, {len(bound)} bound pods, "
+          "mid-churn /debug/pods served, conservation clean)")
+finally:
+    svc.stop()
+    store.close()
+PYEOF
 exec python -m pytest tests/test_scheduler_e2e.py tests/test_controllers.py \
   tests/test_admission_cli.py tests/test_examples.py \
   tests/test_remote_solver.py tests/test_rendezvous_e2e.py -q "$@"

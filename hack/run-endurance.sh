@@ -24,8 +24,38 @@ export BENCH_ENDURANCE_CYCLES VOLCANO_TPU_AUDIT_SAMPLE
 # BENCH_ENDURANCE_SHARDS>=2 would silently turn this into a second
 # pool/shard run and leave the single-connection path ungated.
 BENCH_ENDURANCE=1 BENCH_ENDURANCE_POOL=1 BENCH_ENDURANCE_SHARDS=1 \
-  python bench.py "$@"
+  python bench.py "$@" | tee /tmp/_vtpu_endurance_single.json
 echo "endurance gate OK (0 anomalies)"
+
+# Journey leg (ISSUE 18): the tail's journey block must prove the
+# conservation check ran clean over every bound-ish pod (zero
+# journey-orphan / journey-incomplete — any violation already failed
+# the run above as an anomaly, this asserts the check actually
+# EXECUTED over a non-empty set) and the capture overhead stays
+# inside the <2%-of-cycle-time envelope.  The gated number is the
+# journey's SELF-TIMED capture fraction of the endurance phase
+# (journey_direct_pct, the audit-stats idiom): the journey-off A/B
+# delta is also reported, but its resolution floor is the host's
+# cycle jitter (the audit A/B on the same schedule swings +-5% on a
+# loaded CPU host), so a sub-2% effect can't be gated through it
+# without flaking.
+python - /tmp/_vtpu_endurance_single.json <<'PYEOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+tails = [r["endurance"] for r in rows if "endurance" in r]
+assert tails, "no endurance tail emitted"
+j = tails[0].get("journey")
+assert j is not None, "journey block missing from the endurance tail"
+assert j["bound_pods_checked"] > 0, j
+assert j["conservation_violations"] == 0, j
+assert j["events"] > 0 and j["bound"] > 0, j
+assert j["ttb_p50_ms"] is not None, j
+pct = j["journey_direct_pct"]
+assert pct < 2.0, f"journey overhead {pct}% breaches the 2% envelope"
+print(f"endurance journey leg OK ({j['bound_pods_checked']} bound pods "
+      f"conserved, {j['events']} events, capture {pct}% of cycle time,"
+      f" A/B delta {j['journey_overhead_pct']}%)")
+PYEOF
 
 # Pool leg (ISSUE 15): the same churn + fault schedule over a 2-replica
 # solver pool — kill waves hit RANDOM members while a straggler keeps

@@ -112,6 +112,69 @@ def test_registry_missing_function_is_vcl001():
     assert ("VCL001", 1) in got
 
 
+# ------------------------------------------------------- VCL706
+
+JOURNEY_FIXTURE = textwrap.dedent('''\
+    class Mirror:
+        def silent_writer(self, rows, val):
+            self.p_status[rows] = val
+            self.mark_pods_dirty(rows)
+            self.audit.flow_rows(self.p_status, rows, val, "w")
+            self.mutation_seq += 1
+
+        def tracked_writer(self, rows, val):
+            self.p_status[rows] = val
+            self.mark_pods_dirty(rows)
+            self.audit.flow_rows(self.p_status, rows, val, "w")
+            self.journey.pod_event(self.p_uid[rows], "bound")
+            self.mutation_seq += 1
+
+        def hop_writer(self, rows, val):
+            self.p_status[rows] = val
+            self.mark_pods_dirty(rows)
+            self.audit.flow_rows(self.p_status, rows, val, "w")
+            self._capture(rows)
+            self.mutation_seq += 1
+
+        def _capture(self, rows):
+            self._journey_rows(rows, "bound")
+''')
+
+
+def test_missing_journey_leg_is_vcl706():
+    """The fourth leg: a registered writer that never captures a
+    pod-journey event reports VCL706; pod_event locally or a bulk
+    helper one hop away both satisfy it."""
+    reg = {
+        "fix.py::Mirror.silent_writer": {
+            "dirty": "self", "audit": "self", "journey": "self",
+            "seq": "self"},
+        "fix.py::Mirror.tracked_writer": {
+            "dirty": "self", "audit": "self", "journey": "self",
+            "seq": "self"},
+        "fix.py::Mirror.hop_writer": {
+            "dirty": "self", "audit": "self", "journey": "self",
+            "seq": "self"},
+    }
+    with _with_registry(reg):
+        raw = writercheck.analyze_files([("fix.py", JOURNEY_FIXTURE)])
+    got = _codes(finish("fix.py", JOURNEY_FIXTURE, raw))
+    assert got == [("VCL706", 2)]
+
+
+def test_waived_journey_leg_reports_nothing():
+    reg = {
+        "fix.py::Mirror.silent_writer": {
+            "dirty": "self", "audit": "self",
+            "journey": "node-only writer -- no pod transition to record",
+            "seq": "self"},
+    }
+    with _with_registry(reg):
+        raw = writercheck.analyze_files([("fix.py", JOURNEY_FIXTURE)])
+    got = _codes(finish("fix.py", JOURNEY_FIXTURE, raw))
+    assert not any(c[0] == "VCL706" for c in got)
+
+
 # ------------------------------------------------------- VCL704
 
 UNREGISTERED_FIXTURE = textwrap.dedent('''\

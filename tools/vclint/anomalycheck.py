@@ -38,6 +38,7 @@ SCAN_FILES: Sequence[str] = (
     "volcano_tpu/obs/audit.py",
     "volcano_tpu/obs/slo.py",
     "volcano_tpu/obs/lockdep.py",
+    "volcano_tpu/obs/journey.py",
 )
 
 _DOC_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9-]*)`\s*\|")

@@ -1,7 +1,7 @@
-"""Writer-discipline analyzer (VCL70x): the mirror mutation triad.
+"""Writer-discipline analyzer (VCL70x): the mirror mutation quad.
 
 The rebuild replaces Go's compiler-enforced invariants with a Python
-convention that three PRs stacked up: every mutator of the mirror's
+convention that four PRs stacked up: every mutator of the mirror's
 dynamic pod state must
 
 1. **mark the dirty set** (``mark_pods_dirty`` / ``mark_pod_dirty`` /
@@ -10,13 +10,18 @@ dynamic pod state must
 2. **declare its conservation-audit flow** (``_audit_flow`` /
    ``flow_rows`` / the store-edge ``flow_added``/``flow_removed``, or
    ``reanchor`` for bulk re-derives) so the runtime auditor's
-   double-entry census (ISSUE 13) reconciles, and
+   double-entry census (ISSUE 13) reconciles,
 3. **bump ``mutation_seq``** so the pipelined staleness guard and the
-   cross-shard optimistic commit gate (ISSUE 16) see the move.
+   cross-shard optimistic commit gate (ISSUE 16) see the move, and
+4. **capture the pod journey** (``pod_event`` / ``pod_rows`` /
+   ``pod_resync`` / the fast path's ``_journey_event`` /
+   ``_journey_rows``) so the per-pod timeline (ISSUE 18) stays
+   conserved — a writer that moves a pod's status without recording it
+   is exactly the ``journey-orphan`` the endurance gate hunts.
 
-Until now nothing checked the triad statically — a new writer missing
+Until now nothing checked the quad statically — a new writer missing
 one leg is a silent lost-pod / stale-commit bug the endurance harness
-only catches probabilistically.  This family turns the triad into a
+only catches probabilistically.  This family turns the quad into a
 registry-backed contract over the whole ``volcano_tpu/`` tree:
 
 - **VCL701** — a registered writer's closure never marks the dirty set.
@@ -31,10 +36,12 @@ registry-backed contract over the whole ``volcano_tpu/`` tree:
   reason``.
 - **VCL705** — a ``writer-exempt`` annotation without a ``-- reason``
   (unsuppressable, like VCL002).
+- **VCL706** — a registered writer's closure never captures a pod
+  journey event (the fourth leg).
 
 Like aggcheck, each writer's evidence closure is the function itself
 plus ONE level of locally-defined helpers it calls — key helpers like
-``_audit_flow_rows`` count toward their callers.  A triad leg a writer
+``_audit_flow_rows`` count toward their callers.  A quad leg a writer
 deliberately delegates (``_backfill``'s caller stamps the sequence;
 ``EvictState.evict`` relies on the owning action) is waived IN the
 registry with the contract spelled out, so the delegation is a
@@ -58,6 +65,8 @@ DYN_COLS = {"p_status", "p_node", "p_alive"}
 DIRTY_CALLS = {"mark_pods_dirty", "mark_pod_dirty", "mark_pods_overflow"}
 AUDIT_CALLS = {"_audit_flow", "_audit_flow_rows", "flow", "flow_added",
                "flow_removed", "flow_rows", "reanchor"}
+JOURNEY_CALLS = {"pod_event", "pod_rows", "pod_resync", "pod_restored",
+                 "repeat_rows", "_journey_event", "_journey_rows"}
 SEQ_ATTR = "mutation_seq"
 
 # Every known mutator of the dynamic pod columns, with its triad
@@ -66,26 +75,34 @@ SEQ_ATTR = "mutation_seq"
 # satisfies the leg instead — the registry is the reviewed record of
 # every delegation.
 WRITER_REGISTRY: Dict[str, Dict[str, str]] = {
-    # -- mirror store-edge writers (all three legs local) -------------
+    # -- mirror store-edge writers (all four legs local) --------------
     "volcano_tpu/cache/mirror.py::StoreMirror.upsert_pod": {
-        "dirty": "self", "audit": "self", "seq": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
+        "seq": "self",
     },
     "volcano_tpu/cache/mirror.py::StoreMirror.remove_pod": {
-        "dirty": "self", "audit": "self", "seq": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
+        "seq": "self",
     },
     "volcano_tpu/cache/mirror.py::StoreMirror.set_pod_state": {
-        "dirty": "self", "audit": "self", "seq": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
+        "seq": "self",
     },
     "volcano_tpu/cache/mirror.py::StoreMirror.upsert_node": {
         "dirty": "self",
         "audit": "orphan adopt moves p_node only -- no status "
                  "transition, the per-status census is unchanged",
+        "journey": "nodes carry no pod journey -- the orphan adopt "
+                   "moves p_node only, no pod status transition to "
+                   "record",
         "seq": "self",
     },
     "volcano_tpu/cache/mirror.py::StoreMirror.resync_status": {
         # Bulk re-derive: mark_pods_overflow voids the whole dirty
-        # mask; reanchor voids the census compare.
-        "dirty": "self", "audit": "self", "seq": "self",
+        # mask; reanchor voids the census compare; pod_resync adopts
+        # the record truth journey-side.
+        "dirty": "self", "audit": "self", "journey": "self",
+        "seq": "self",
     },
     "volcano_tpu/cache/mirror.py::StoreMirror.maybe_compact": {
         "dirty": "compact_gen bump forces the aggregate consumer to "
@@ -93,30 +110,35 @@ WRITER_REGISTRY: Dict[str, Dict[str, str]] = {
         "audit": "row renumbering preserves the per-status census "
                  "exactly (only tombstones drop); the attached auditor "
                  "survives the swap",
+        "journey": "the journey is uid-keyed, so timelines survive row "
+                   "renumbering untouched; the attached handle rides "
+                   "the swap like the auditor's",
         "seq": "self",
     },
     # -- fast-path commit/unbind/backfill -----------------------------
     "volcano_tpu/fastpath.py::FastCycle._commit": {
-        "dirty": "self", "audit": "self", "seq": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
+        "seq": "self",
     },
     "volcano_tpu/fastpath.py::FastCycle._unbind_rows": {
-        "dirty": "self", "audit": "self", "seq": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
+        "seq": "self",
     },
     "volcano_tpu/fastpath.py::FastCycle._backfill": {
-        "dirty": "self", "audit": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
         "seq": "run_cycle_fast stamps mutation_seq when _backfill "
                "reports bound rows (disjoint rows from the solve, one "
                "stamp per action)",
     },
     # -- eviction machinery -------------------------------------------
     "volcano_tpu/fastpath_evict.py::EvictState.evict": {
-        "dirty": "self", "audit": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
         "seq": "the owning action stamps mutation_seq once per batch "
                "(fastpath action loop / whatif.commit_plan / "
                "FastEvictor flush)",
     },
     "volcano_tpu/fastpath_evict.py::EvictState.unevict": {
-        "dirty": "self", "audit": "self",
+        "dirty": "self", "audit": "self", "journey": "self",
         "seq": "the owning action stamps mutation_seq once per batch "
                "(rollback inside the planner, or the flush revert "
                "path, which stamps after its unevicts)",
@@ -126,6 +148,7 @@ WRITER_REGISTRY: Dict[str, Dict[str, str]] = {
                  "victim row",
         "audit": "delegates to EvictState.evict, which declares the "
                  "running->releasing flow per victim",
+        "journey": "self",
         "seq": "self",
     },
 }
@@ -140,8 +163,8 @@ def _call_leaf(node: ast.Call) -> Optional[str]:
 
 
 def _leg_facts(fn: ast.AST) -> Dict[str, bool]:
-    """Which triad legs the function's own body satisfies."""
-    dirty = audit = seq = False
+    """Which quad legs the function's own body satisfies."""
+    dirty = audit = journey = seq = False
     for sub in ast.walk(fn):
         if isinstance(sub, ast.Call):
             leaf = _call_leaf(sub)
@@ -149,6 +172,8 @@ def _leg_facts(fn: ast.AST) -> Dict[str, bool]:
                 dirty = True
             elif leaf in AUDIT_CALLS:
                 audit = True
+            elif leaf in JOURNEY_CALLS:
+                journey = True
         elif isinstance(sub, ast.AugAssign):
             if isinstance(sub.target, ast.Attribute) \
                     and sub.target.attr == SEQ_ATTR:
@@ -158,7 +183,8 @@ def _leg_facts(fn: ast.AST) -> Dict[str, bool]:
                 if isinstance(tgt, ast.Attribute) \
                         and tgt.attr == SEQ_ATTR:
                     seq = True
-    return {"dirty": dirty, "audit": audit, "seq": seq}
+    return {"dirty": dirty, "audit": audit, "journey": journey,
+            "seq": seq}
 
 
 def _functions(tree: ast.Module):
@@ -324,7 +350,8 @@ def analyze_files(sources: Sequence[Tuple[str, str]]) -> List[Finding]:
                 ))
 
     # Registered writers: resolve and verify each "self" leg.
-    leg_codes = {"dirty": "VCL701", "audit": "VCL702", "seq": "VCL703"}
+    leg_codes = {"dirty": "VCL701", "audit": "VCL702", "seq": "VCL703",
+                 "journey": "VCL706"}
     leg_what = {
         "dirty": "never marks the dirty set "
                  "(mark_pods_dirty/mark_pod_dirty/mark_pods_overflow)",
@@ -332,6 +359,9 @@ def analyze_files(sources: Sequence[Tuple[str, str]]) -> List[Finding]:
                  "(_audit_flow/flow_rows/flow_added/flow_removed/"
                  "reanchor)",
         "seq": "never bumps mutation_seq",
+        "journey": "never captures a pod-journey event "
+                   "(pod_event/pod_rows/pod_resync/_journey_event/"
+                   "_journey_rows)",
     }
     for key, legs in sorted(WRITER_REGISTRY.items()):
         entry = seen.get(key)

@@ -405,6 +405,12 @@ class StoreMirror:
         # for bare mirrors in tests; the auditor is internally
         # synchronized, so no extra locking here.
         self.audit = None
+        # Pod-journey log (obs/journey.py, ISSUE 18), attached by the
+        # owning store next to the auditor: the same dynamic-state
+        # writers record per-pod timeline events (enqueued /
+        # status-sync / removed) through it.  None for bare mirrors and
+        # under VOLCANO_TPU_JOURNEY=0; internally synchronized.
+        self.journey = None
 
     # ================================================================ pods
 
@@ -628,10 +634,13 @@ class StoreMirror:
                 # update dynamic state only.  The job link is re-derived —
                 # the podgroup controller back-annotates bare pods with a
                 # group name after the fact (pg_controller_handler.go:72-105).
-                if self.audit is not None:
-                    old = int(self.p_status[row])
-                    if old != status:
+                old = int(self.p_status[row])
+                if old != status:
+                    if self.audit is not None:
                         self.audit.flow("pod-update", old, status)
+                    if self.journey is not None:
+                        self.journey.pod_event(pod.uid, "status-sync",
+                                               status=status)
                 self.p_status[row] = status
                 self.p_node[row] = node_row
                 self.p_node_name[row] = pod.node_name or None
@@ -673,6 +682,11 @@ class StoreMirror:
         jid = pod.job_id()
         jrow = job_row_of(jid) if jid else -1
         self.p_job[row] = jrow
+        if self.journey is not None:
+            self.journey.pod_event(
+                pod.uid, "enqueued", status=status,
+                queue=self.j_queue[jrow] if jrow >= 0 else "",
+                gang=jid)
         self.p_prio[row] = feat.priority
         self.p_create[row] = feat.create
         self.p_alive[row] = True
@@ -733,8 +747,12 @@ class StoreMirror:
         self.mutation_seq += 1
         self.mark_pod_dirty(row)
         self.pod_obj_gen += 1
-        if self.audit is not None and self.p_alive[row]:
-            self.audit.flow_removed(int(self.p_status[row]))
+        if self.p_alive[row]:
+            if self.audit is not None:
+                self.audit.flow_removed(int(self.p_status[row]))
+            if self.journey is not None:
+                self.journey.pod_event(uid, "removed",
+                                       status=int(self.p_status[row]))
         self.p_alive[row] = False
         self.p_uid[row] = None
         self.p_node_name[row] = None
@@ -749,10 +767,13 @@ class StoreMirror:
         if row is not None:
             self.mutation_seq += 1
             self.mark_pod_dirty(row)
-            if self.audit is not None:
-                old = int(self.p_status[row])
-                if old != status:
+            old = int(self.p_status[row])
+            if old != status:
+                if self.audit is not None:
                     self.audit.flow("set-pod-state", old, status)
+                if self.journey is not None:
+                    self.journey.pod_event(uid, "status-sync",
+                                           status=status)
             self.p_status[row] = status
             self.p_node[row] = node_row
             self.p_node_name[row] = (
@@ -1208,12 +1229,16 @@ class StoreMirror:
         dseq = self.dirty_seq
         dirty, floor = self._node_dirty_rows, self._node_dirty_floor
         audit = self.audit
+        journey = self.journey
         self.__dict__.update(fresh.__dict__)
         # The auditor rides the STORE, not the table generation: row
         # renumbering preserves the per-status census exactly (only
         # tombstones drop), so conservation needs no re-anchor — the
-        # attached auditor itself must just survive the swap.
+        # attached auditor itself must just survive the swap.  Same for
+        # the journey: it is uid-keyed, so timelines survive row
+        # renumbering untouched; only the handle must ride the swap.
         self.audit = audit
+        self.journey = journey
         self.mutation_seq = seq + 1
         self.compact_gen = gen + 1
         self._node_dirty_rows = dirty
@@ -1238,6 +1263,13 @@ class StoreMirror:
             # Bulk re-derive: per-row flow declaration would be a scan
             # of its own; re-anchor the conservation census instead.
             self.audit.reanchor("resync-status")
+        if self.journey is not None:
+            # Same bulk shape journey-side: adopt the record truth in
+            # one pass (missing pods get synthetic roots; pods whose
+            # status says placed get a state-sync bind).
+            self.journey.pod_resync(
+                (uid, int(pod.task_status()))
+                for uid, pod in pods.items() if uid in self.p_row)
         for uid, row in self.p_row.items():
             pod = pods.get(uid)
             if pod is None:

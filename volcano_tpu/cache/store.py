@@ -240,7 +240,8 @@ class ClusterStore:
         # (the recorder's ring lock nests strictly inside _lock and is
         # never taken around store state); stdlib-only, so wiring them
         # unconditionally costs two small objects per store.
-        from ..obs import Auditor, FlightRecorder, SLOTracker, Tracer
+        from ..obs import (Auditor, FlightRecorder, JourneyLog,
+                           SLOTracker, Tracer, journey_on)
 
         self.tracer = Tracer()
         self.flight = FlightRecorder()
@@ -253,6 +254,17 @@ class ClusterStore:
         self.auditor = Auditor()
         self.auditor.slo = SLOTracker()
         self.mirror.audit = self.auditor
+        # Pod-journey tracing (obs/journey.py, ISSUE 18): the
+        # pod-centric event timeline behind /debug/pods/<uid>, the
+        # per-queue time-to-bind latency feeds, and the endurance
+        # conservation check.  Internally synchronized like the auditor
+        # (its lock nests strictly inside _lock and is never taken
+        # around store state).  Kill switch VOLCANO_TPU_JOURNEY=0
+        # leaves the slot None so hot paths pay one attribute load.
+        self.journey = (JourneyLog(slo=self.auditor.slo,
+                                   auditor=self.auditor)
+                        if journey_on() else None)
+        self.mirror.journey = self.journey
         # Runtime lock enforcement (obs/lockdep.py, VOLCANO_TPU_LOCKDEP=1):
         # wraps this store's object graph so `# guarded-by:` annotations
         # are asserted live.  A no-op (one env read) when the switch is

@@ -911,6 +911,78 @@ class FastCycle:
         if a is not None and a.enabled and len(rows):
             a.flow_rows(self.m.p_status, rows, int(new_status), reason)
 
+    # ----------------------------------------------------------- journey
+
+    def _journey_shard(self) -> int:
+        return -1 if self.shard is None else int(self.shard.index)
+
+    def _journey_masks(self):
+        """First-time row masks for the journey's steady-state bulk
+        accounting (obs/journey.py): the feed re-pends and re-binds the
+        SAME backlog rows every cycle, and per-pod Python capture at
+        that scale would dwarf the cycle.  The masks remember which
+        rows already recorded their first consideration / first bind,
+        so per-pod work is paid once per pod and repeats fold into bulk
+        counters — journey cost stays churn-proportional.  Row indices
+        are stable for a pod's lifetime; a compaction renumbers them,
+        so the masks are keyed on ``compact_gen`` and rebuilt on a
+        bump (uid-keyed journey state survives; only the first-seen
+        memo resets, costing one re-record per live pod)."""
+        m = self.m
+        n = len(m.p_uid)
+        mk = getattr(self.store, "_journey_masks", None)
+        if mk is None or mk[0] != m.compact_gen:
+            mk = self.store._journey_masks = (
+                m.compact_gen, np.zeros(n, bool), np.zeros(n, bool))
+        elif len(mk[1]) < n:
+            grow = lambda a: np.concatenate(
+                [a, np.zeros(n - len(a), bool)])
+            mk = self.store._journey_masks = (
+                mk[0], grow(mk[1]), grow(mk[2]))
+        return mk
+
+    def _journey_event(self, row: int, kind: str, *,
+                       solve_id: int = 0, detail: str = "") -> None:
+        """Scalar journey capture for one mirror row."""
+        jr = getattr(self.store, "journey", None)
+        if jr is None:
+            return
+        uid = self.m.p_uid[int(row)]
+        if uid:
+            jr.pod_event(uid, kind, shard=self._journey_shard(),
+                         solve_id=solve_id, detail=detail)
+
+    def _journey_rows(self, rows, kind: str, *, solve_id: int = 0,
+                      epoch: int = -1, detail: str = "") -> None:
+        """Bulk journey capture for the vectorized seams.  For the
+        steady-state kinds (``dispatched``/``bound``/``unbound``) only
+        FIRST-time rows pay per-pod work (see ``_journey_masks``);
+        drops and voids are churn-sized, so every row records."""
+        jr = getattr(self.store, "journey", None)
+        if jr is None or not len(rows):
+            return
+        m = self.m
+        shard = self._journey_shard()
+        if kind in ("dispatched", "bound"):
+            gen, considered, bound_seen = self._journey_masks()
+            mask = considered if kind == "dispatched" else bound_seen
+            fresh = ~mask[rows]
+            n_rep = int(len(rows) - np.count_nonzero(fresh))
+            if n_rep:
+                jr.repeat_rows(n_rep, kind)
+            if not fresh.any():
+                return
+            rows = rows[fresh]
+            mask[rows] = True
+        elif kind == "unbound":
+            # Re-pend loop: the pods' journeys already hold their
+            # first-bind latency; count in bulk only.
+            jr.repeat_rows(int(len(rows)), kind)
+            return
+        jr.pod_rows((m.p_uid[i] for i in rows.tolist()), kind,
+                    shard=shard, solve_id=solve_id, epoch=epoch,
+                    detail=detail)
+
     def _record_cycle(self, t_wall: float, duration_s: float,
                       err: Optional[BaseException]) -> None:
         """Run the cycle-end audits and seal this cycle into the
@@ -933,6 +1005,7 @@ class FastCycle:
             return
         seq = flight.record(CycleRecord(
             session=self.uid, path="fast", t_wall=t_wall,
+            shard=None if self.shard is None else int(self.shard.index),
             duration_s=duration_s, lanes=dict(self.lanes),
             pods_considered=int(st["considered"]),
             pods_bound=int(st["bound"]),
@@ -1534,6 +1607,9 @@ class FastCycle:
                     with tracer.span("encode", lanes=lanes):
                         inputs, pid, profiles, ncls = self._solve_inputs(
                             cjobs, crows, slim=(solver == "wave"))
+                    # Journey: these rows entered a device solve
+                    # (first-time rows record; repeats bulk-count).
+                    self._journey_rows(crows, "dispatched")
                     # Device-incremental context: single-chunk wave
                     # solves only (chunked solves interleave commits,
                     # so each chunk would need its own proof).
@@ -1807,6 +1883,9 @@ class FastCycle:
 
         # Commit prep that needs no assignment overlaps the round trip.
         req_gather = self.m.c_req.gather(crows)
+        # Journey: these rows entered a device solve (first-time rows
+        # record with the flow's solve-id; repeats bulk-count).
+        self._journey_rows(crows, "dispatched", solve_id=solve_id)
         shard_idx = None if self.shard is None else self.shard.index
         shard_seq = None
         if self.shard is not None:
@@ -1883,6 +1962,13 @@ class FastCycle:
                      "dropped (%d rows re-place this cycle)",
                      len(inflight.task_rows))
             self._count_drops({"compaction": len(inflight.task_rows)})
+            # Row indices are void, but the compaction preserved uids
+            # 1:1 — the journey masks rebuilt on the gen bump, so the
+            # uid lookup below must NOT use the stale rows.  The void
+            # is whole-result: attribute it without row translation.
+            jr = getattr(self.store, "journey", None)
+            if jr is not None:
+                jr.repeat_rows(len(inflight.task_rows), "unbound")
             self.stats["device_events"].append(
                 f"solve {inflight.solve_id} voided by mirror compaction"
             )
@@ -1925,6 +2011,9 @@ class FastCycle:
                 )
                 self._count_drops(
                     {"lost-reply": len(inflight.task_rows)})
+                self._journey_rows(inflight.task_rows, "dropped",
+                                   solve_id=inflight.solve_id,
+                                   detail="lost-reply")
                 self.stats["device_events"].append(
                     f"solve {inflight.solve_id} reply lost "
                     f"({type(e).__name__}); fetch failure "
@@ -1948,6 +2037,9 @@ class FastCycle:
                 # The crash event itself lands via _on_device_crash.
                 self._count_drops(
                     {"device-crash": len(inflight.task_rows)})
+                self._journey_rows(inflight.task_rows, "dropped",
+                                   solve_id=inflight.solve_id,
+                                   detail="device-crash")
                 self._devincr_drop_skip()
                 self._on_device_crash(e)
                 return
@@ -2156,6 +2248,25 @@ class FastCycle:
             if self.shard is not None:
                 self.shard.conflicts += n_comp + n_cap
         self._count_drops(drops)
+        # Journey: per-pod exclusive drop attribution (the why-pending
+        # evidence chain).  Drop sets are churn-sized; cross-shard
+        # conflicts carry the ownership-table handoff epoch so the
+        # stitched timeline shows WHICH handoff generation lost.
+        if getattr(self.store, "journey", None) is not None:
+            epoch = (-1 if self.shard is None
+                     else int(self.shard.table.epoch))
+            for mask, reason in ((r_deleted, "deleted"),
+                                 (r_competing, "competing-bind"),
+                                 (r_constraint, "constraint-sensitive"),
+                                 (r_churn, "node-epoch-churn"),
+                                 (r_capacity, "capacity-taken")):
+                if not mask.any():
+                    continue
+                if cross_shard and reason in ("competing-bind",
+                                              "capacity-taken"):
+                    reason = "cross-shard-conflict"
+                self._journey_rows(task_rows[mask], "dropped",
+                                   epoch=epoch, detail=reason)
         out = np.where(ok, assigned, -1)
         n_drop = int(np.count_nonzero(live & (out < 0)))
         if n_drop and not ok.any():
@@ -3409,6 +3520,12 @@ class FastCycle:
         # dispatch captures its sequence, so the guard semantics are
         # unchanged).
         self._audit_flow_rows(rows, ST_BOUND, "commit-bind")
+        # Journey: the placement landed (first-time rows record the
+        # bind — and their time-to-bind — with the committing solve's
+        # flow id; steady-state re-binds bulk-count).
+        self._journey_rows(
+            rows, "bound",
+            solve_id=int(self.stats.get("committed_solve_id") or 0))
         m.p_status[rows] = ST_BOUND
         m.p_node[rows] = nodes_c
         m.mark_pods_dirty(rows)
@@ -3643,6 +3760,10 @@ class FastCycle:
             nodes_f, minlength=self.Nn
         )[:self.Nn].astype(I)
         self._audit_flow_rows(rows_f, ST_PENDING, "unbind")
+        # Journey: bulk-count only — the feed's re-pend loop and the
+        # bind-failure resync both leave the pods' first-bind latency
+        # (already recorded) standing.
+        self._journey_rows(rows_f, "unbound")
         m.p_status[rows_f] = ST_PENDING
         m.p_node[rows_f] = -1
         m.p_node_name[rows_f] = None
@@ -3712,6 +3833,7 @@ class FastCycle:
             if placed is not None:
                 self._audit_flow(int(m.p_status[row]), ST_BOUND,
                                  "backfill-bind")
+                self._journey_event(row, "bound", detail="backfill")
                 m.p_status[row] = ST_BOUND
                 m.p_node[row] = placed
                 m.p_node_name[row] = m.n_name[placed]
@@ -3771,6 +3893,8 @@ class FastCycle:
                     jrow = self.jobr[row]
                     self._audit_flow(int(m.p_status[row]), ST_PENDING,
                                      "backfill-revert")
+                    self._journey_event(row, "dropped",
+                                        detail="bind-failed")
                     m.p_status[row] = ST_PENDING
                     self.n_ntasks[m.p_node[row]] -= 1
                     m.p_node[row] = -1

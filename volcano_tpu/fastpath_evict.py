@@ -141,6 +141,7 @@ class EvictState:
         n = int(m.p_node[row])
         req = self.req[row]
         c._audit_flow(int(m.p_status[row]), ST_RELEASING, "evict")
+        c._journey_event(row, "evicted")
         m.p_status[row] = ST_RELEASING
         # Direct mirror status write: the incremental derive's dirty set
         # must see it (the action stamps mutation_seq at its end).
@@ -172,6 +173,7 @@ class EvictState:
         m = c.m
         req = self.req[row]
         c._audit_flow(int(m.p_status[row]), ST_RUNNING, "evict-revert")
+        c._journey_event(row, "evict-reverted")
         m.p_status[row] = ST_RUNNING
         m.mark_pod_dirty(row)
         c.n_releasing[n] -= req

@@ -397,6 +397,32 @@ class Metrics:
             "allowed fraction.  >= 1.0 means the lane is consuming "
             "its error budget faster than the SLO allows",
         )
+        self.pod_time_to_first_consider = _Histogram(
+            f"{ns}_pod_time_to_first_consider_milliseconds",
+            "Pod-journey latency (obs/journey.py) from mirror enqueue "
+            "to the pod's FIRST entry into a device solve, per queue "
+            "— the queue-backlog component of scheduling latency",
+        )
+        self.pod_time_to_bind = _Histogram(
+            f"{ns}_pod_time_to_bind_milliseconds",
+            "Pod-journey latency from mirror enqueue to the pod's "
+            "FIRST committed bind, per queue — the end-to-end wait "
+            "signal the ttb SLO lane budgets "
+            "(VOLCANO_TPU_SLO_TTB_P99_MS)",
+        )
+        self.gang_time_to_full_bind = _Histogram(
+            f"{ns}_gang_time_to_full_bind_milliseconds",
+            "Gang-journey latency from the gang's first member "
+            "enqueue to its LAST member's first bind — the gang-level "
+            "time-to-full-bind the per-pod series can't show",
+        )
+        self.journey_events = _Counter(
+            f"{ns}_journey_events_total",
+            "Pod-journey events captured by kind (enqueued / "
+            "dispatched / dropped / bound / evicted / ...); bulk "
+            "steady-state repeats are counted by the journey's "
+            "internal counters, not here",
+        )
         # Registry-wide lock sharing: rebind every series to THIS
         # registry's lock (done before any concurrent use) so writers
         # serialize with expose_text's iteration.

@@ -1,7 +1,7 @@
 """Observability layer: trace spans, cycle flight recorder, Perfetto
 export (ISSUE 3), runtime conservation auditor + SLO layer (ISSUE 13).
 
-Five stdlib-only modules, importable without jax/numpy so the store and
+Six stdlib-only modules, importable without jax/numpy so the store and
 the HTTP service can wire them unconditionally:
 
 - ``trace``    — the low-overhead span API (``perf_counter_ns``; one
@@ -28,6 +28,12 @@ the HTTP service can wire them unconditionally:
 - ``slo``      — per-lane latency windows with declared budgets and
   error-budget burn tracking; breaches surface as auditor anomalies
   and in ``/debug/health``.
+- ``journey``  — pod-centric plane (ISSUE 18): a bounded columnar
+  per-pod event timeline (enqueued → dispatched → dropped/evicted →
+  bound) captured at every sanctioned writer, feeding per-queue
+  time-to-bind / gang full-bind latency, the ``/debug/pods/<uid>``
+  why-pending explainer, Perfetto async tracks, and the endurance
+  conservation check (``journey-orphan`` / ``journey-incomplete``).
 
 Consumers: ``service.py`` exposes ``/debug/cycles``,
 ``/debug/cycles/<seq>``, ``/debug/trace?cycles=K``, ``/debug/health``
@@ -38,6 +44,7 @@ and docs/observability.md document all of it.
 """
 
 from .audit import Anomaly, Auditor
+from .journey import JourneyLog, journey_on
 from .recorder import CycleRecord, FlightRecorder
 from .slo import SLOTracker
 from .trace import SpanRecord, Tracer, null_tracer
@@ -47,6 +54,8 @@ __all__ = [
     "Auditor",
     "CycleRecord",
     "FlightRecorder",
+    "JourneyLog",
+    "journey_on",
     "SLOTracker",
     "SpanRecord",
     "Tracer",

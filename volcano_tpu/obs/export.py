@@ -15,7 +15,13 @@ Produces the JSON object format (``{"traceEvents": [...]}``) that both
   budget-degradation) and per drop-reason tally, so "17 rows dropped:
   capacity-taken" is readable at the cycle where it happened;
 - metadata events name the process and the logical threads ("cycle",
-  "rpc", "bind").
+  "rpc", "bind");
+- pod journeys (obs/journey.py, ISSUE 18) export as ASYNC tracks: one
+  ``"ph": "b"``/``"e"`` pair per pod uid bracketing its timeline, with
+  one ``"ph": "n"`` instant per journey event (kind / shard /
+  drop-reason args).  A journey event carrying a solve-id joins that
+  solve's flow, so the arrow runs dispatch span → pod bind — the
+  pod-centric view laid over the cycle-centric spans.
 
 Spec: the Trace Event Format document (Google, monorail-hosted); only
 the stable subset above is emitted.
@@ -24,7 +30,7 @@ the stable subset above is emitted.
 from __future__ import annotations
 
 import json
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 PID = 1
 _TID_ORDER = ("cycle", "rpc", "bind")
@@ -37,8 +43,11 @@ def _tid_of(name: str, table: Dict[str, int]) -> int:
     return tid
 
 
-def trace_events(records: Iterable) -> List[dict]:
-    """Flatten CycleRecords into a trace_event list (ts in us)."""
+def trace_events(records: Iterable,
+                 journey: Optional[Iterable[dict]] = None) -> List[dict]:
+    """Flatten CycleRecords into a trace_event list (ts in us).
+    ``journey`` is an optional iterable of journey rows
+    (``JourneyLog.trace_rows()``) exported as async per-pod tracks."""
     events: List[dict] = []
     tid_table: Dict[str, int] = {}
     for known in _TID_ORDER:
@@ -93,6 +102,37 @@ def trace_events(records: Iterable) -> List[dict]:
                          "detail": anom.get("detail", {})},
             })
 
+    # Pod-journey async tracks: rows are chronological per uid (the
+    # ring preserves capture order); emitted BEFORE the flow arrows so
+    # a solve-id-carrying journey instant joins its solve's flow.
+    if journey:
+        jtid = _tid_of("journey", tid_table)
+        by_uid: Dict[str, List[dict]] = {}
+        for row in journey:
+            by_uid.setdefault(row["uid"], []).append(row)
+        for uid, rows in by_uid.items():
+            name = f"pod {uid}"
+            events.append({
+                "name": name, "cat": "journey", "ph": "b", "id": uid,
+                "ts": rows[0]["ts_us"], "pid": PID, "tid": jtid,
+            })
+            for row in rows:
+                args = {k: v for k, v in row.items()
+                        if k not in ("uid", "ts_us")}
+                events.append({
+                    "name": row["kind"], "cat": "journey", "ph": "n",
+                    "id": uid, "ts": row["ts_us"], "pid": PID,
+                    "tid": jtid, "args": args,
+                })
+                sid = row.get("solve_id")
+                if sid:
+                    flows.setdefault(int(sid), []).append(
+                        len(events) - 1)
+            events.append({
+                "name": name, "cat": "journey", "ph": "e", "id": uid,
+                "ts": rows[-1]["ts_us"], "pid": PID, "tid": jtid,
+            })
+
     # Flow arrows: start at the chronologically first span of each flow,
     # finish at the last, step through the middle.
     for flow_id, idxs in flows.items():
@@ -124,17 +164,19 @@ def trace_events(records: Iterable) -> List[dict]:
     return meta + events
 
 
-def perfetto_trace(records: Iterable) -> dict:
+def perfetto_trace(records: Iterable,
+                   journey: Optional[Iterable[dict]] = None) -> dict:
     """The JSON-object container both viewers accept."""
     return {
-        "traceEvents": trace_events(records),
+        "traceEvents": trace_events(records, journey=journey),
         "displayTimeUnit": "ms",
     }
 
 
-def write_trace(path: str, records: Iterable) -> str:
+def write_trace(path: str, records: Iterable,
+                journey: Optional[Iterable[dict]] = None) -> str:
     """Dump records to ``path`` as Perfetto-loadable JSON; returns the
     path."""
     with open(path, "w") as f:
-        json.dump(perfetto_trace(records), f)
+        json.dump(perfetto_trace(records, journey=journey), f)
     return path

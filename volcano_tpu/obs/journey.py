@@ -1,0 +1,604 @@
+"""Pod-journey tracing: per-pod scheduling timelines (ISSUE 18).
+
+Every observability layer so far is cycle-centric — lane spans, flight
+records, conservation flows, SLO windows — but none answers the
+question a batch-system user actually asks: *where did my pod's time
+go, and why is it still pending?*  With the sharded control plane a
+single pod's life spans shards (considered on shard A, voided by a
+cross-shard conflict, re-placed by shard B), so the signal cannot be
+reconstructed from any one recorder.  ``JourneyLog`` is the pod-centric
+plane: a bounded columnar event ring plus a per-pod summary, captured
+at every sanctioned mirror/fast-path writer (the writer-discipline lint
+VCL706 guarantees no writer bypasses it).
+
+Event vocabulary (docs/observability.md):
+
+- ``enqueued``           pod row created in the mirror (store edge)
+- ``status-sync``        external status overwrite (update / resync)
+- ``dispatched``         first entered a device solve (solve_id, shard)
+- ``dropped``            staleness-guard drop, one exclusive reason
+                         (``cross-shard-conflict`` carries the losing
+                         shard and the ownership handoff epoch)
+- ``bound``              commit/backfill landed the placement
+- ``unbound``            bind-failure resync or steady-state re-pend
+- ``evicted`` / ``evict-reverted``  fastpath_evict state transitions
+- ``migration-planned``  what-if plan committed this pod as a victim
+- ``restored``           migration ledger re-added it under a new uid
+- ``removed``            pod row tombstoned (store edge)
+
+Cost discipline: the fast path feeds per-pod Python work only for
+*state changes* — first consideration, first bind, drops, evictions,
+churn edges.  The steady-state feed (re-pend + re-bind of the same
+100k rows every cycle) is folded into bulk counters by the caller
+(``fastpath.FastCycle._journey_rows``'s row masks), so per-cycle
+journey cost is proportional to churn, not backlog.  The endurance
+gate measures the envelope (<2% of cycle time vs the journey-off leg).
+
+Latency feeds: first-dispatch observes time-to-first-consider, first
+bind observes time-to-bind (per queue) and the gang's
+time-to-full-bind once every member seen is bound; time-to-bind also
+feeds the ``ttb`` SLO lane (``VOLCANO_TPU_SLO_TTB_P99_MS``) whose
+burn-rate breaches surface as ``slo-budget-exceeded`` anomalies.
+
+Conservation: ``conservation_check(bound_uids)`` proves every pod
+bound at the end of a fault schedule has a complete, orphan-free
+journey — a state rooted at ``enqueued`` (``journey-orphan``
+otherwise) with a recorded bind and monotone event order across shard
+handoffs (``journey-incomplete`` otherwise).  A/B harnesses that ran
+with the journey detached re-adopt via ``pod_resync`` (synthetic
+roots, explicitly tolerated).
+
+Stdlib-only (``array`` ring, one small lock), like the rest of
+``obs/``; kill switch ``VOLCANO_TPU_JOURNEY=0`` leaves the store with
+``journey = None`` so hot paths pay one attribute load.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from array import array
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .audit import Anomaly
+
+DEFAULT_EVENTS = 65536
+
+# TaskStatus bit-flags that mean "this pod holds (or held) a placement"
+# (api/types.py): Allocated | Binding | Bound | Running | Succeeded.
+_BOUND_MASK = (1 << 1) | (1 << 3) | (1 << 4) | (1 << 5) | (1 << 7)
+
+KINDS = (
+    "enqueued", "status-sync", "dispatched", "dropped", "bound",
+    "unbound", "evicted", "evict-reverted", "migration-planned",
+    "restored", "removed",
+)
+_KIND_CODE = {k: i for i, k in enumerate(KINDS)}
+
+# Per-pod drop-chain depth (why-pending evidence window).
+_DROP_CHAIN = 8
+# Bench-percentile sample windows.
+_TTB_WINDOW = 4096
+_GANG_WINDOW = 1024
+_QUEUE_WINDOW = 256
+# Per-kind metric counts fold into the registry counter in batches of
+# this many events (read paths flush too, so totals stay fresh).
+_FLUSH_EVERY = 256
+
+
+def journey_on() -> bool:
+    return os.environ.get("VOLCANO_TPU_JOURNEY", "1") != "0"
+
+
+def ring_capacity() -> int:
+    try:
+        return max(int(os.environ.get("VOLCANO_TPU_JOURNEY_EVENTS",
+                                      DEFAULT_EVENTS)), 1024)
+    except ValueError:
+        return DEFAULT_EVENTS
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    i = min(int(q * (len(vals) - 1) + 0.5), len(vals) - 1)
+    return round(vals[i], 3)
+
+
+class _PodState:
+    """Per-pod journey summary (the stitched cross-shard view)."""
+
+    __slots__ = ("queue", "gang", "enq_ns", "first_ns", "bound_ns",
+                 "last_ns", "last_kind", "status", "drops", "solve_id",
+                 "shard", "monotone", "synthetic", "restored_from")
+
+    def __init__(self, queue: str, gang: str, now_ns: int,
+                 synthetic: bool = False):
+        self.queue = queue
+        self.gang = gang
+        self.enq_ns = now_ns
+        self.first_ns: Optional[int] = None
+        self.bound_ns: Optional[int] = None
+        self.last_ns = now_ns
+        self.last_kind = "enqueued"
+        self.status = 1  # TaskStatus.Pending
+        # Recent (reason, shard) drop attributions, newest last.
+        self.drops: deque = deque(maxlen=_DROP_CHAIN)
+        self.solve_id = 0
+        self.shard = -1
+        self.monotone = True
+        # True when adopted by pod_resync (journey was detached when
+        # the pod entered): conservation treats the root as complete.
+        self.synthetic = synthetic
+        self.restored_from: Optional[str] = None
+
+
+class _GangState:
+    __slots__ = ("first_enq_ns", "members", "bound", "alive", "done")
+
+    def __init__(self, now_ns: int):
+        self.first_enq_ns = now_ns
+        self.members = 0
+        self.bound = 0
+        self.alive = 0
+        self.done = False
+
+
+class JourneyLog:
+    """Bounded columnar per-pod event timeline + per-pod summaries.
+
+    Writers call under the store lock (mirror writers / fast path) or
+    from bench teardown; readers are the /debug HTTP threads.  All
+    shared state is guarded by the journey's own ``_lock`` — never
+    taken around store state, so a /debug/pods scrape cannot block the
+    cycle thread on store work.
+    """
+
+    def __init__(self, capacity: Optional[int] = None, slo=None,
+                 auditor=None):
+        cap = ring_capacity() if capacity is None else max(int(capacity), 8)
+        self._cap = cap
+        self._lock = threading.Lock()
+        # Wall anchor (obs/trace.py idiom): perf_counter deltas stay
+        # monotone; adding the anchor aligns exported timestamps with
+        # the tracer's span clock.
+        self._anchor_ns = time.time_ns() - time.perf_counter_ns()
+        # Columnar ring, overwrite-oldest.  guarded-by: _lock
+        self._ev_uid: List[Optional[str]] = [None] * cap
+        self._ev_detail: List[Optional[str]] = [None] * cap
+        self._ev_kind = array("b", bytes(cap))
+        self._ev_shard = array("i", bytes(4 * cap))
+        self._ev_solve = array("q", bytes(8 * cap))
+        self._ev_epoch = array("q", bytes(8 * cap))
+        self._ev_ts = array("q", bytes(8 * cap))
+        self._head = 0  # next write slot; guarded-by: _lock
+        self._count = 0  # events ever written; guarded-by: _lock
+        # Summaries.  guarded-by: _lock
+        self._pods: Dict[str, _PodState] = {}
+        self._gangs: Dict[str, _GangState] = {}
+        # Counters.  guarded-by: _lock
+        self.events_total = 0
+        self.rebinds = 0  # steady-state re-pend loop, counted in bulk
+        self.reconsiders = 0
+        self.unbinds_bulk = 0
+        self.bound_total = 0
+        # Per-kind event counts batched toward the registry counter:
+        # per-event inc() took the GLOBAL metrics lock (shared with the
+        # scrape and every other series) plus a sorted-tuple build per
+        # event — folding every _FLUSH_EVERY events amortizes that
+        # ~256x.  guarded-by: _lock
+        self._kind_counts: Dict[str, int] = {}
+        self._unflushed = 0
+        self._metrics = None  # lazy ..metrics handle (import cycle)
+        # Self-timed capture cost (the in-process truth, audit_stats
+        # idiom): nanoseconds spent inside the capture entry points,
+        # two perf_counter reads per CALL (not per event).
+        self.capture_ns = 0
+        # Latency sample windows for the bench tail / queue rollup.
+        self._ttb_ms: deque = deque(maxlen=_TTB_WINDOW)
+        self._ttfc_ms: deque = deque(maxlen=_TTB_WINDOW)
+        self._gang_ttfb_ms: deque = deque(maxlen=_GANG_WINDOW)
+        self._queue_ttb: Dict[str, deque] = {}
+        self._queue_counts: Dict[str, Dict[str, int]] = {}
+        # SLO feed (ttb lane) + breach intake (auditor.report).
+        self.slo = slo
+        self.auditor = auditor
+
+    # ------------------------------------------------------------ capture
+
+    def pod_event(self, uid: Optional[str], kind: str, *,
+                  status: int = -1, queue: str = "", gang: str = "",
+                  shard: int = -1, solve_id: int = 0, epoch: int = -1,
+                  detail: str = "") -> None:
+        """Record one event for one pod (writers hold the store lock)."""
+        if not uid:
+            return
+        t0 = time.perf_counter_ns()
+        now = time.time_ns() - self._anchor_ns
+        with self._lock:
+            self._apply(uid, kind, now, status, queue, gang, shard,
+                        solve_id, epoch, detail)
+            self.capture_ns += time.perf_counter_ns() - t0
+
+    def pod_rows(self, uids: Iterable[Optional[str]], kind: str, *,
+                 shard: int = -1, solve_id: int = 0, epoch: int = -1,
+                 detail: str = "") -> None:
+        """Bulk capture sharing one timestamp/lock acquisition (the
+        fast path's vectorized writers)."""
+        t0 = time.perf_counter_ns()
+        now = time.time_ns() - self._anchor_ns
+        with self._lock:
+            for uid in uids:
+                if uid:
+                    self._apply(uid, kind, now, -1, "", "", shard,
+                                solve_id, epoch, detail)
+            self.capture_ns += time.perf_counter_ns() - t0
+
+    def repeat_rows(self, n: int, kind: str) -> None:
+        """Steady-state bulk accounting: the feed re-pends and re-binds
+        the SAME rows every cycle; their journeys are already complete,
+        so only counters move (per-cycle journey cost stays
+        churn-proportional — see the module docstring)."""
+        if n <= 0:
+            return
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            if kind == "bound":
+                self.rebinds += n
+            elif kind == "dispatched":
+                self.reconsiders += n
+            else:
+                self.unbinds_bulk += n
+            self.capture_ns += time.perf_counter_ns() - t0
+
+    def pod_resync(self, pairs: Iterable[Tuple[Optional[str], int]]
+                   ) -> None:
+        """Bulk status adoption (mirror.resync_status, or a harness
+        re-attaching a detached journey): missing pods get synthetic
+        roots; pods whose status says placed get a state-sync bind so
+        the conservation invariant holds across the blind window."""
+        t0 = time.perf_counter_ns()
+        now = time.time_ns() - self._anchor_ns
+        with self._lock:
+            for uid, status in pairs:
+                if not uid:
+                    continue
+                st = self._pods.get(uid)
+                if st is None:
+                    st = self._pods[uid] = _PodState(
+                        "", "", now, synthetic=True)
+                st.status = int(status)
+                if (status & _BOUND_MASK) and st.bound_ns is None:
+                    self._mark_bound(uid, st, now, via="state-sync")
+            self.capture_ns += time.perf_counter_ns() - t0
+
+    def pod_restored(self, old_uid: str, new_uid: str) -> None:
+        """Migration-ledger stitch: the restored pod's fresh journey
+        links back to the evicted victim's uid."""
+        now = time.time_ns() - self._anchor_ns
+        with self._lock:
+            st = self._pods.get(new_uid)
+            if st is not None:
+                st.restored_from = old_uid
+            self._apply(new_uid, "restored", now, -1, "", "", -1, 0,
+                        -1, old_uid)
+
+    # ------------------------------------------------------- apply (locked)
+
+    def _apply(self, uid: str, kind: str, now: int, status: int,
+               queue: str, gang: str, shard: int, solve_id: int,
+               epoch: int, detail: str) -> None:
+        st = self._pods.get(uid)
+        if kind == "enqueued":
+            if st is None:
+                st = self._pods[uid] = _PodState(queue, gang, now)
+                if gang:
+                    g = self._gangs.get(gang)
+                    if g is None:
+                        g = self._gangs[gang] = _GangState(now)
+                    g.members += 1
+                    g.alive += 1
+                qc = self._queue_counts.setdefault(
+                    queue, {"enqueued": 0, "bound": 0})
+                qc["enqueued"] += 1
+            if status >= 0:
+                st.status = status
+                if (status & _BOUND_MASK) and st.bound_ns is None:
+                    self._mark_bound(uid, st, now, via="state-sync")
+        elif st is None:
+            # Event for a pod the journey never saw enqueue (adopted
+            # mid-life, e.g. re-attach after an A/B window): synthesize
+            # the root so the timeline stays rooted.
+            st = self._pods[uid] = _PodState(queue, gang, now,
+                                             synthetic=True)
+        if now < st.last_ns:
+            st.monotone = False
+        st.last_ns = now
+        st.last_kind = kind
+        if kind == "dispatched":
+            st.solve_id = solve_id
+            st.shard = shard
+            if st.first_ns is None:
+                st.first_ns = now
+                ms = (now - st.enq_ns) / 1e6
+                self._ttfc_ms.append(ms)
+                if self._metrics is None:
+                    from ..metrics import metrics
+
+                    self._metrics = metrics
+                self._metrics.pod_time_to_first_consider.observe(
+                    ms, queue=st.queue or "none")
+        elif kind == "dropped":
+            st.drops.append((detail, shard))
+        elif kind == "bound":
+            st.status = 1 << 4  # TaskStatus.Bound
+            if st.bound_ns is None:
+                self._mark_bound(uid, st, now)
+        elif kind == "status-sync":
+            if status >= 0:
+                st.status = status
+                if (status & _BOUND_MASK) and st.bound_ns is None:
+                    self._mark_bound(uid, st, now, via="state-sync")
+        elif kind == "removed":
+            self._pods.pop(uid, None)
+            if st.gang:
+                g = self._gangs.get(st.gang)
+                if g is not None:
+                    g.alive -= 1
+                    if g.alive <= 0:
+                        del self._gangs[st.gang]
+        # Ring append (columnar, overwrite-oldest).
+        i = self._head
+        self._ev_uid[i] = uid
+        self._ev_detail[i] = detail or None
+        self._ev_kind[i] = _KIND_CODE.get(kind, 0)
+        self._ev_shard[i] = shard
+        self._ev_solve[i] = solve_id
+        self._ev_epoch[i] = epoch
+        self._ev_ts[i] = now
+        self._head = (i + 1) % self._cap
+        self._count += 1
+        self.events_total += 1
+        kc = self._kind_counts
+        kc[kind] = kc.get(kind, 0) + 1
+        self._unflushed += 1
+        if self._unflushed >= _FLUSH_EVERY:
+            self._flush_kind_counts()
+
+    def _flush_kind_counts(self) -> None:
+        """Fold the batched per-kind counts into the registry counter
+        (caller holds ``_lock``); also runs on every read path so a
+        scrape after a quiet spell sees fresh totals."""
+        if not self._kind_counts:
+            return
+        if self._metrics is None:
+            from ..metrics import metrics
+
+            self._metrics = metrics
+        inc = self._metrics.journey_events.inc
+        for kind, n in self._kind_counts.items():
+            inc(n, kind=kind)
+        self._kind_counts.clear()
+        self._unflushed = 0
+
+    def _mark_bound(self, uid: str, st: _PodState, now: int,
+                    via: str = "commit") -> None:
+        st.bound_ns = now
+        self.bound_total += 1
+        ms = (now - st.enq_ns) / 1e6
+        self._ttb_ms.append(ms)
+        q = st.queue or "none"
+        self._queue_ttb.setdefault(q, deque(maxlen=_QUEUE_WINDOW)) \
+            .append(ms)
+        qc = self._queue_counts.setdefault(
+            q, {"enqueued": 0, "bound": 0})
+        qc["bound"] += 1
+        if self._metrics is None:
+            from ..metrics import metrics
+
+            self._metrics = metrics
+        self._metrics.pod_time_to_bind.observe(ms, queue=q)
+        if self.slo is not None and not st.synthetic:
+            for breach in self.slo.observe_sample("ttb", ms):
+                if self.auditor is not None:
+                    self.auditor.report(
+                        Anomaly("slo-budget-exceeded", breach))
+        if st.gang:
+            g = self._gangs.get(st.gang)
+            if g is not None:
+                g.bound += 1
+                if not g.done and g.members > 0 \
+                        and g.bound >= g.members:
+                    g.done = True
+                    gms = (now - g.first_enq_ns) / 1e6
+                    self._gang_ttfb_ms.append(gms)
+                    self._metrics.gang_time_to_full_bind.observe(gms)
+
+    # -------------------------------------------------------------- reads
+
+    def _ring_indices(self) -> List[int]:
+        if self._count < self._cap:
+            return list(range(self._head))
+        return list(range(self._head, self._cap)) + \
+            list(range(self._head))
+
+    def _row(self, i: int) -> dict:
+        row = {
+            "uid": self._ev_uid[i],
+            "kind": KINDS[self._ev_kind[i]],
+            "ts_us": round((self._anchor_ns + self._ev_ts[i]) / 1e3, 1),
+        }
+        if self._ev_shard[i] >= 0:
+            row["shard"] = self._ev_shard[i]
+        if self._ev_solve[i]:
+            row["solve_id"] = self._ev_solve[i]
+        if self._ev_epoch[i] >= 0:
+            row["handoff_epoch"] = self._ev_epoch[i]
+        if self._ev_detail[i]:
+            row["detail"] = self._ev_detail[i]
+        return row
+
+    def trace_rows(self) -> List[dict]:
+        """Chronological ring dump for the Perfetto exporter."""
+        with self._lock:
+            return [self._row(i) for i in self._ring_indices()]
+
+    def timeline(self, uid: str) -> Optional[dict]:
+        """The /debug/pods/<uid> body: stitched cross-shard event list
+        (oldest first) + summary + why-pending verdict.  Returns None
+        for a pod the journey never saw."""
+        with self._lock:
+            st = self._pods.get(uid)
+            events = [self._row(i) for i in self._ring_indices()
+                      if self._ev_uid[i] == uid]
+            if st is None and not events:
+                return None
+            body = {"uid": uid, "events": events}
+            if st is not None:
+                body.update({
+                    "queue": st.queue,
+                    "gang": st.gang,
+                    "status": st.status,
+                    "enqueued_us": round(
+                        (self._anchor_ns + st.enq_ns) / 1e3, 1),
+                    "time_to_first_consider_ms": (
+                        round((st.first_ns - st.enq_ns) / 1e6, 3)
+                        if st.first_ns is not None else None),
+                    "time_to_bind_ms": (
+                        round((st.bound_ns - st.enq_ns) / 1e6, 3)
+                        if st.bound_ns is not None else None),
+                    "last_kind": st.last_kind,
+                    "monotone": st.monotone,
+                    "restored_from": st.restored_from,
+                    "why_pending": self._verdict(st),
+                })
+            else:
+                body["why_pending"] = "removed (events only)"
+            return body
+
+    def why_pending(self, uid: str) -> str:
+        with self._lock:
+            st = self._pods.get(uid)
+            if st is None:
+                return "unknown (no journey state)"
+            return self._verdict(st)
+
+    def _verdict(self, st: _PodState) -> str:
+        """Compress the recent drop-reason chain into one operator
+        sentence, e.g. ``capacity-taken x4 on shard 1,
+        cross-shard-conflict on shard 0``."""
+        if st.status & _BOUND_MASK:
+            return "bound"
+        if st.last_kind in ("evicted", "migration-planned"):
+            return f"{st.last_kind} (awaiting restore)"
+        if st.first_ns is None:
+            return "never considered (queue backlog)"
+        if not st.drops:
+            return "considered, no drops recorded (awaiting commit)"
+        parts: List[str] = []
+        run: Optional[Tuple[str, int]] = None
+        n = 0
+        for reason, shard in st.drops:
+            key = (reason, shard)
+            if key == run:
+                n += 1
+                continue
+            if run is not None:
+                parts.append(self._drop_phrase(run, n))
+            run, n = key, 1
+        if run is not None:
+            parts.append(self._drop_phrase(run, n))
+        return ", ".join(parts)
+
+    @staticmethod
+    def _drop_phrase(key: Tuple[str, int], n: int) -> str:
+        reason, shard = key
+        out = reason or "dropped"
+        if n > 1:
+            out += f" x{n}"
+        if shard >= 0:
+            out += f" on shard {shard}"
+        return out
+
+    def queue_rollup(self) -> dict:
+        """Per-queue scheduling-latency rollup for /debug/health."""
+        with self._lock:
+            self._flush_kind_counts()
+            out: Dict[str, dict] = {}
+            for q, counts in sorted(self._queue_counts.items()):
+                win = list(self._queue_ttb.get(q, ()))
+                out[q] = {
+                    "enqueued_total": counts["enqueued"],
+                    "bound_total": counts["bound"],
+                    "ttb_p50_ms": _pct(win, 0.50),
+                    "ttb_p99_ms": _pct(win, 0.99),
+                }
+            return {
+                "queues": out,
+                "pods_tracked": len(self._pods),
+                "gangs_tracked": len(self._gangs),
+                "events_total": self.events_total,
+            }
+
+    def stats(self) -> dict:
+        """The bench JSON-tail journey block."""
+        with self._lock:
+            self._flush_kind_counts()
+            ttb = list(self._ttb_ms)
+            ttfc = list(self._ttfc_ms)
+            gang = list(self._gang_ttfb_ms)
+            return {
+                "events": self.events_total,
+                "capture_ms": round(self.capture_ns / 1e6, 3),
+                "events_dropped": max(self._count - self._cap, 0),
+                "pods": len(self._pods),
+                "bound": self.bound_total,
+                "rebinds": self.rebinds,
+                "reconsiders": self.reconsiders,
+                "ttfc_p50_ms": _pct(ttfc, 0.50),
+                "ttb_p50_ms": _pct(ttb, 0.50),
+                "ttb_p95_ms": _pct(ttb, 0.95),
+                "ttb_p99_ms": _pct(ttb, 0.99),
+                "gang_ttfb_p50_ms": _pct(gang, 0.50),
+                "gang_ttfb_p99_ms": _pct(gang, 0.99),
+            }
+
+    # ------------------------------------------------------- conservation
+
+    def conservation_check(self, bound_uids: Iterable[str]
+                           ) -> List[Anomaly]:
+        """The endurance-gate invariant: every pod bound at the end of
+        the fault schedule has a complete, orphan-free journey.
+
+        - ``journey-orphan``: a bound pod with NO journey state — some
+          writer bypassed the capture seams entirely.
+        - ``journey-incomplete``: state exists but the bind was never
+          recorded, or the event order went non-monotone across a
+          shard handoff.
+
+        Synthetic roots (``pod_resync`` adoption after a deliberate
+        detach window) count as complete — the adoption is itself the
+        recorded provenance.
+        """
+        orphans: List[str] = []
+        incomplete: List[str] = []
+        with self._lock:
+            for uid in bound_uids:
+                st = self._pods.get(uid)
+                if st is None:
+                    orphans.append(uid)
+                elif st.bound_ns is None or not st.monotone:
+                    incomplete.append(uid)
+        out: List[Anomaly] = []
+        if orphans:
+            out.append(Anomaly("journey-orphan", {
+                "count": len(orphans), "uids": orphans[:5],
+            }))
+        if incomplete:
+            out.append(Anomaly("journey-incomplete", {
+                "count": len(incomplete), "uids": incomplete[:5],
+            }))
+        return out

@@ -41,7 +41,8 @@ class CycleRecord:
     ``seq``), then read-only."""
 
     __slots__ = (
-        "seq", "session", "path", "t_wall", "duration_s", "lanes",
+        "seq", "session", "path", "t_wall", "duration_s", "shard",
+        "lanes",
         "pods_considered", "pods_bound", "pods_dropped", "drop_reasons",
         "inflight_fetch_wait_ms", "dispatched_solve_id",
         "committed_solve_id", "mutation_seq_at_dispatch",
@@ -52,6 +53,7 @@ class CycleRecord:
 
     def __init__(self, session: str = "", path: str = "fast",
                  t_wall: float = 0.0, duration_s: float = 0.0,
+                 shard: Optional[int] = None,
                  lanes: Optional[Dict[str, float]] = None,
                  pods_considered: int = 0, pods_bound: int = 0,
                  pods_dropped: int = 0,
@@ -75,6 +77,12 @@ class CycleRecord:
         self.path = path
         self.t_wall = t_wall
         self.duration_s = duration_s
+        # The recording shard's index under VOLCANO_TPU_SHARDS>1, None
+        # on the single-scheduler path.  The store's ONE recorder is
+        # shared by every shard's cycle thread (the ring lock
+        # serializes them), so /debug/cycles and /debug/trace already
+        # aggregate all shards — the tag says who recorded what.
+        self.shard = shard
         self.lanes = lanes or {}
         self.pods_considered = pods_considered
         self.pods_bound = pods_bound
@@ -113,6 +121,7 @@ class CycleRecord:
             "session": self.session,
             "path": self.path,
             "t_wall": self.t_wall,
+            "shard": self.shard,
             "duration_ms": round(self.duration_s * 1e3, 3),
             "lanes_ms": {
                 k: round(v * 1e3, 3) for k, v in self.lanes.items()

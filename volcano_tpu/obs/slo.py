@@ -26,9 +26,13 @@ not one per cycle; the auditor (obs/audit.py) turns those into
 ``slo-budget-exceeded`` anomalies.
 
 Budgets come from env (``VOLCANO_TPU_SLO_CYCLE_P99_MS`` /
-``VOLCANO_TPU_SLO_DEVICE_P99_MS`` / ``VOLCANO_TPU_SLO_IDLE_P99_MS``,
+``VOLCANO_TPU_SLO_DEVICE_P99_MS`` / ``VOLCANO_TPU_SLO_IDLE_P99_MS`` /
+``VOLCANO_TPU_SLO_TTB_P99_MS``,
 unset = tracked but unbudgeted) or programmatically via ``declare`` —
 the endurance harness declares explicit budgets and fails on burn.
+The ``ttb`` lane is pod-centric, not cycle-centric: the journey log
+(obs/journey.py, ISSUE 18) feeds one observation per first bind via
+``observe_sample``.
 
 Stdlib-only; internally synchronized (one small lock) so /debug reads
 never contend the cycle thread for more than a dict copy.
@@ -51,6 +55,9 @@ _ENV_BUDGETS = (
     ("cycle", "VOLCANO_TPU_SLO_CYCLE_P99_MS"),
     ("device", "VOLCANO_TPU_SLO_DEVICE_P99_MS"),
     ("idle", "VOLCANO_TPU_SLO_IDLE_P99_MS"),
+    # Pod time-to-bind (obs/journey.py, ISSUE 18): one observation per
+    # FIRST bind, fed via observe_sample — the pod-centric SLO lane.
+    ("ttb", "VOLCANO_TPU_SLO_TTB_P99_MS"),
 )
 
 
@@ -111,43 +118,57 @@ class SLOTracker:
         if idle:
             obs["idle"] = duration_s * 1e3
         breaches: List[dict] = []
-        from ..metrics import metrics
-
         with self._lock:
             for lane, ms in obs.items():
-                win = self._lanes.get(lane)
-                if win is None:
-                    win = self._lanes[lane] = deque(maxlen=self.window)
-                win.append(ms)
-                self.observations[lane] = (
-                    self.observations.get(lane, 0) + 1)
-                b = self.budgets.get(lane)
-                if b is None:
-                    continue
-                if ms > b.target_ms:
-                    self.violations[lane] = (
-                        self.violations.get(lane, 0) + 1)
-                if len(win) < MIN_SAMPLES:
-                    continue
-                over = sum(1 for v in win if v > b.target_ms)
-                # Burn over the CONFIGURED window (unfilled slots count
-                # healthy) — see the module docstring.
-                burn = (over / self.window) / b.allowed_frac
-                was = self._breached.get(lane, False)
-                now = burn >= 1.0
-                self._breached[lane] = now
-                metrics.slo_burn_rate.set(round(burn, 4), lane=lane)
-                if now and not was:
-                    breaches.append({
-                        "lane": lane,
-                        "target_ms": b.target_ms,
-                        "observed_ms": round(ms, 3),
-                        "window_p99_ms": round(_pct(list(win), 0.99), 3),
-                        "burn_rate": round(burn, 2),
-                        "over_in_window": over,
-                        "window": len(win),
-                    })
+                self._feed_locked(lane, ms, breaches)
         return breaches
+
+    def observe_sample(self, lane: str, ms: float) -> List[dict]:
+        """Feed one out-of-cycle observation (e.g. the journey's
+        per-pod time-to-bind) into ``lane`` with the same budget /
+        burn-rate / breach-edge semantics as ``observe``."""
+        breaches: List[dict] = []
+        with self._lock:
+            self._feed_locked(lane, float(ms), breaches)
+        return breaches
+
+    # holds: _lock
+    def _feed_locked(self, lane: str, ms: float,
+                     breaches: List[dict]) -> None:
+        from ..metrics import metrics
+
+        win = self._lanes.get(lane)
+        if win is None:
+            win = self._lanes[lane] = deque(maxlen=self.window)
+        win.append(ms)
+        self.observations[lane] = (
+            self.observations.get(lane, 0) + 1)
+        b = self.budgets.get(lane)
+        if b is None:
+            return
+        if ms > b.target_ms:
+            self.violations[lane] = (
+                self.violations.get(lane, 0) + 1)
+        if len(win) < MIN_SAMPLES:
+            return
+        over = sum(1 for v in win if v > b.target_ms)
+        # Burn over the CONFIGURED window (unfilled slots count
+        # healthy) — see the module docstring.
+        burn = (over / self.window) / b.allowed_frac
+        was = self._breached.get(lane, False)
+        now = burn >= 1.0
+        self._breached[lane] = now
+        metrics.slo_burn_rate.set(round(burn, 4), lane=lane)
+        if now and not was:
+            breaches.append({
+                "lane": lane,
+                "target_ms": b.target_ms,
+                "observed_ms": round(ms, 3),
+                "window_p99_ms": round(_pct(list(win), 0.99), 3),
+                "burn_rate": round(burn, 2),
+                "over_in_window": over,
+                "window": len(win),
+            })
 
     # ------------------------------------------------------------- reads
 

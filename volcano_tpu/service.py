@@ -387,6 +387,13 @@ class Service:
                             "health_snapshot", None)
                         if snap is not None:
                             body["solver_pool"] = snap()
+                        # Pod-journey queue rollup (ISSUE 18): per-
+                        # queue time-to-bind percentiles; reads only
+                        # the journey's own lock.
+                        journey = getattr(service.store, "journey",
+                                          None)
+                        if journey is not None:
+                            body["journey"] = journey.queue_rollup()
                         self._json(200, body)
                     elif parts[:2] == ["debug", "anomalies"]:
                         # The anomaly ring, oldest first; ?n=K limits.
@@ -409,16 +416,40 @@ class Service:
                                        "debug_snapshot", None)
                         self._json(200, snap() if snap is not None
                                    else {"shards": 1})
+                    elif parts[:2] == ["debug", "pods"] and len(parts) == 3:
+                        # Pod-journey timeline + why-pending verdict
+                        # (obs/journey.py, ISSUE 18).  The journey is
+                        # internally locked and uid-keyed: the stitched
+                        # cross-shard view, never the store lock.
+                        journey = getattr(service.store, "journey",
+                                          None)
+                        if journey is None:
+                            self._json(404, {
+                                "error": "journey disabled "
+                                         "(VOLCANO_TPU_JOURNEY=0)"})
+                        else:
+                            body = journey.timeline(parts[2])
+                            if body is None:
+                                self._json(404, {
+                                    "error": "no journey for pod",
+                                    "uid": parts[2]})
+                            else:
+                                self._json(200, body)
                     elif parts[:2] == ["debug", "trace"]:
                         # Perfetto/chrome://tracing trace of the last K
-                        # cycles (?cycles=K, default the whole ring).
+                        # cycles (?cycles=K, default the whole ring),
+                        # with pod journeys as async tracks.
                         from .obs import export as obs_export
 
                         k_raw = parse_qs(url.query).get(
                             "cycles", [None])[0]
                         k = int(k_raw) if k_raw is not None else None
+                        journey = getattr(service.store, "journey",
+                                          None)
                         self._json(200, obs_export.perfetto_trace(
-                            service.store.flight.recent(k)
+                            service.store.flight.recent(k),
+                            journey=(journey.trace_rows()
+                                     if journey is not None else None),
                         ))
                     elif parts[:2] == ["apis", "jobs"] and len(parts) == 2:
                         ns = parse_qs(url.query).get("namespace", [None])[0]
