@@ -195,7 +195,8 @@ def _collect_journey(store):
 def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
           records=None, fallbacks=None, rebalance=None, devincr=None,
           wire=None, preempt=None, compile_ms=None, warmup_cycles=None,
-          composed=None, endurance=None, pool=None, shards=None):
+          composed=None, endurance=None, pool=None, shards=None,
+          topology=None):
     global _AUDIT_TAIL, _JOURNEY_TAIL
     metric = metric + _MODE_SUFFIX
     if budget_ms is None:
@@ -231,6 +232,11 @@ def _emit(metric, value_ms, n_pods, extra="", budget_ms=None, lanes=None,
         # BENCH_PREEMPT tail: what-if plan outcomes, evictions,
         # convergence + zero-lost-pods proof (docs/preempt_reclaim.md).
         payload["preempt"] = dict(preempt)
+    if topology:
+        # BENCH_TOPOLOGY tail (ISSUE 20): best-block fit before the
+        # defrag wave, gang contiguity after it, placement-outcome
+        # counts + zero-lost-pods proof (docs/topology.md).
+        payload["topology"] = dict(topology)
     if fallbacks:
         # Two-phase shortlist-fallback rescores over the measured
         # cycles, by reason (docs/metrics.md).
@@ -939,6 +945,152 @@ def config_rebalance():
             "committed_plans": (ledger.committed_plans
                                 if ledger else 0),
             "converged_cycles": converged_cycles,
+        },
+    )
+    store.close()
+
+
+def config_topology():
+    """BENCH_TOPOLOGY: fragmented-fabric contiguous gang placement
+    (ISSUE 20, docs/topology.md).
+
+    ``synth.fabric_cluster`` at the acceptance shape: 2 racks x 2 ICI
+    slices of 16 nodes, every slice stranded by 2 Running fillers, and
+    a pending 32-task require-contiguous gang no single block can host
+    (each slice fits 28 of 32).  Measures the cycle that pregates the
+    gang AND plans+commits the slice-defrag wave, then the cycles to
+    full contiguous convergence (gang bound in one block, every filler
+    re-bound).  The tail carries the best-block fit before the wave vs
+    the gang's contiguity after it, the placement-outcome counters,
+    and the zero-lost-pods proof."""
+    import time as _t
+
+    import numpy as np
+
+    from volcano_tpu.api.spec import FABRIC_RACK, FABRIC_SLICE
+    from volcano_tpu.cache import FakeBinder
+    from volcano_tpu.framework import (
+        REBALANCE_SCHEDULER_CONF,
+        parse_scheduler_conf,
+    )
+    from volcano_tpu.metrics import metrics as _metrics
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.sim import ClusterSimulator
+    from volcano_tpu.synth import fabric_cluster
+
+    racks = int(os.environ.get("BENCH_TOPO_RACKS", 2))
+    slices = int(os.environ.get("BENCH_TOPO_SLICES", 2))
+    slice_nodes = int(os.environ.get("BENCH_TOPO_SLICE_NODES", 16))
+    gang = int(os.environ.get("BENCH_GANG", 32))
+    n_nodes = racks * slices * slice_nodes
+    n_fillers = racks * slices * 2
+    os.environ["VOLCANO_TPU_REBALANCE_DRAIN_CAP"] = str(n_nodes)
+
+    store = fabric_cluster(racks=racks, slices_per_rack=slices,
+                           nodes_per_slice=slice_nodes, gang_tasks=gang,
+                           binder=FakeBinder())
+    sched = Scheduler(store, conf_str=REBALANCE_SCHEDULER_CONF)
+    sim = ClusterSimulator(store, grace_steps=2)
+
+    def best_block_fit():
+        """Fraction of the gang's pending demand the best single
+        fabric block can host right now (the contiguity ceiling,
+        kernel-scored on live planes)."""
+        import jax
+
+        from volcano_tpu.fastpath import FastCycle
+        from volcano_tpu.ops import topology as topo
+
+        pending = sum(1 for p in store.pods.values()
+                      if p.name.startswith("fabgang")
+                      and not p.node_name)
+        if not pending:
+            return 1.0
+        cyc = FastCycle(store, parse_scheduler_conf(
+            REBALANCE_SCHEDULER_CONF))
+        with store._lock:
+            cyc.derive()
+        _, block, n_blocks = topo.fabric_planes(store.mirror)
+        if not n_blocks:
+            return 0.0
+        prof = np.zeros((1, cyc.R), np.float32)
+        prof[0, 0] = 2000.0  # the gang task: 2 cpu (milli)
+        prof[0, 1] = float(1 << 30)  # 1Gi
+        cnt = np.array([pending], np.int32)
+        bid = np.full((len(cyc.n_idle),), -1, np.int32)
+        bid[:cyc.Nn] = block[:cyc.Nn]
+        bf = topo.gang_block_fit(
+            cyc.n_idle.astype(np.float32), cyc.n_ready, cyc.n_ntasks,
+            cyc.n_maxtasks, bid, prof, cnt, cyc.eps,
+            n_blocks=int(n_blocks))
+        (score,) = jax.device_get((bf.score,))
+        return float(score.max()) / float(pending)
+
+    def gang_contiguity():
+        """Largest single-block share of the gang's BOUND members
+        (0 while the pregate holds everything back)."""
+        per_block = {}
+        bound = 0
+        for p in store.pods.values():
+            if not p.name.startswith("fabgang") or not p.node_name:
+                continue
+            bound += 1
+            n = store.nodes.get(p.node_name)
+            labels = (getattr(n, "labels", None)
+                      or getattr(getattr(n, "node", None), "labels", {})
+                      or {})
+            key = (labels.get(FABRIC_RACK), labels.get(FABRIC_SLICE))
+            per_block[key] = per_block.get(key, 0) + 1
+        return (max(per_block.values()) / bound) if bound else 0.0
+
+    def _placements(outcome):
+        return _metrics.topology_placements.data.get(
+            (("outcome", outcome),), 0.0)
+
+    def _fillers_bound():
+        return sum(1 for p in store.pods.values()
+                   if p.name.startswith("filler-") and p.node_name)
+
+    ev0 = sum(_metrics.rebalance_evictions.data.values())
+    inf0 = _placements("infeasible")
+    cont0 = _placements("contiguous")
+    fit_before = best_block_fit()
+    t0 = _t.perf_counter()
+    sched.run_once()  # pregates the gang + plans/commits the wave
+    plan_cycle_ms = (_t.perf_counter() - t0) * 1e3
+    converged_cycles = 0
+    for _ in range(24):
+        converged_cycles += 1
+        sim.step()
+        sched.run_once()
+        bound = sum(1 for p in store.pods.values()
+                    if p.name.startswith("fabgang") and p.node_name)
+        if bound >= gang and _fillers_bound() >= n_fillers:
+            break
+    ledger = store.migrations
+    contig_after = gang_contiguity()
+    _emit(
+        f"Topology defrag plan+commit cycle @ {n_nodes} nodes, "
+        f"{gang}-task require-contiguous gang",
+        plan_cycle_ms, gang,
+        f"converged_in={converged_cycles} cycles "
+        f"fit_before={fit_before:.3f} contiguity_after={contig_after:.3f}",
+        budget_ms=NORTH_STAR_MS,
+        lanes=store.last_cycle_lanes,
+        topology={
+            "fit_before": round(fit_before, 4),
+            "contiguity_after": round(contig_after, 4),
+            "gang": gang,
+            "infeasible_transitions": int(_placements("infeasible")
+                                          - inf0),
+            "contiguous_placements": int(_placements("contiguous")
+                                         - cont0),
+            "committed_plans": (ledger.committed_plans
+                                if ledger else 0),
+            "evictions": int(sum(
+                _metrics.rebalance_evictions.data.values()) - ev0),
+            "converged_cycles": converged_cycles,
+            "lost_pods": n_fillers - _fillers_bound(),
         },
     )
     store.close()
@@ -2366,6 +2518,11 @@ def main():
         # Fragmented-cluster defragmentation lane (ISSUE 5): its own
         # scenario, not a mode of the five configs.
         config_rebalance()
+        return
+    if os.environ.get("BENCH_TOPOLOGY"):
+        # Topology-aware gang placement lane (ISSUE 20): fragmented
+        # fabric + slice-defrag convergence, not a mode of the configs.
+        config_topology()
         return
     if os.environ.get("BENCH_PREEMPT"):
         # Device-native priority-tier preemption lane (ISSUE 11): its

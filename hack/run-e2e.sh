@@ -151,6 +151,30 @@ print("BENCH_SHARDS smoke OK (%s -> %s binds/sec, %.2fx, "
       % (s1["binds_per_sec"], s2["binds_per_sec"],
          s2["speedup_vs_shard1"], s2["contention"]["conflicts"]))
 '
+# BENCH_TOPOLOGY smoke (ISSUE 20): topology-aware gang placement on a
+# fragmented 2-rack fabric — asserts the pregate held the
+# require-contiguous gang exactly once (topology-infeasible), one
+# slice-defrag plan committed, the gang converged FULLY contiguous
+# (every member in one fabric block), and zero pods were lost (every
+# drained filler re-bound).
+BENCH_TOPOLOGY=1 JAX_PLATFORMS=cpu python bench.py | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+tails = [r["topology"] for r in rows if "topology" in r]
+assert tails, "no topology tail emitted"
+t = tails[0]
+assert t["infeasible_transitions"] == 1, f"pregate never held: {t}"
+assert t["committed_plans"] >= 1, f"defrag never committed: {t}"
+assert t["fit_before"] < 1.0, f"fabric was not fragmented: {t}"
+assert t["contiguity_after"] == 1.0, f"gang not contiguous: {t}"
+assert t["contiguous_placements"] >= 1, t
+assert t["evictions"] >= 1, t
+assert t["lost_pods"] == 0, f"pods lost: {t}"
+print("BENCH_TOPOLOGY smoke OK (fit %.3f -> contiguity %.3f, "
+      "%s evictions, %s cycles)"
+      % (t["fit_before"], t["contiguity_after"], t["evictions"],
+         t["converged_cycles"]))
+'
 # BENCH_PREEMPT smoke (ISSUE 11): the device-native preempt lane on a
 # small fragmented-priority cluster — asserts the DEVICE lane actually
 # engaged (a committed what-if plan + evictions through the shared

@@ -1,0 +1,402 @@
+"""Topology-aware gang placement tests (ISSUE 20, docs/topology.md):
+fabric-plane interning, kernel <-> oracle parity on seeded fragmented
+fabrics, the require/prefer constraint semantics through the pregate /
+node-order bias / post-solve gate, kill-switch bitwise identity, and
+the acceptance e2e — a 32-task require-contiguous gang on a fragmented
+2-rack fabric reports topology-infeasible, then binds fully contiguous
+after one rebalance cycle plus the eviction grace window with zero
+lost pods and budgets held."""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (
+    FABRIC_RACK,
+    FABRIC_SLICE,
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    TOPOLOGY_NONE,
+    TOPOLOGY_PREFER,
+    TOPOLOGY_REQUIRE,
+    TOPOLOGY_ANNOTATION,
+    topology_code,
+)
+from volcano_tpu.cache import ClusterStore, FakeBinder
+from volcano_tpu.framework import REBALANCE_SCHEDULER_CONF
+from volcano_tpu.metrics import metrics
+from volcano_tpu.oracle import oracle_topology
+from volcano_tpu.ops import topology as topo
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.sim import ClusterSimulator
+from volcano_tpu.synth import fabric_cluster, fabric_labels
+
+ALLOC_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _placements(outcome):
+    key = (("outcome", outcome),)
+    return metrics.topology_placements.data.get(key, 0.0)
+
+
+def _gang_pods(store, prefix="fabgang"):
+    return [p for p in store.pods.values() if p.name.startswith(prefix)]
+
+
+def _slice_of(store, node_name):
+    n = store.nodes[node_name]
+    labels = getattr(n, "labels", None) or getattr(
+        getattr(n, "node", None), "labels", {})
+    return labels.get(FABRIC_SLICE)
+
+
+def _pow2(n, floor=1):
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+# ------------------------------------------------------- kernel parity
+
+
+def test_kernel_oracle_parity_fixed_seeds():
+    """cfit/whole/score/frag planes and the target-block pick agree
+    exactly with the Go-shaped oracle on >= 8 seeded fragmented
+    fabrics (padding rows sliced off before comparison)."""
+    import jax
+
+    for seed in range(10):
+        rng = np.random.RandomState(seed)
+        N, R, U = 40, 3, 3
+        B = int(rng.randint(2, 7))
+        idle = rng.uniform(0.0, 8.0, size=(N, R)).astype(np.float32)
+        ready = rng.rand(N) > 0.15
+        ntasks = rng.randint(0, 6, size=N).astype(np.int32)
+        max_tasks = np.where(rng.rand(N) < 0.5,
+                             rng.randint(1, 8, size=N), 0).astype(
+            np.int32)
+        block_id = rng.randint(-1, B, size=N).astype(np.int32)
+        prof_req = rng.uniform(0.5, 4.0, size=(U, R)).astype(np.float32)
+        prof_req[rng.rand(U, R) < 0.3] = 0.0
+        prof_cnt = rng.randint(0, 9, size=U).astype(np.int32)
+        eps = np.full(R, 1e-3, np.float32)
+        require = bool(seed % 2)
+
+        # Kernel path: pow2-padded axes exactly as _topo_block_fit
+        # buckets them; padded nodes are not-ready / blockless, padded
+        # profiles request nothing and count zero.
+        Np, Upad, Bp = _pow2(N), _pow2(U, 4), _pow2(B, 4)
+
+        def padN(a, n=Np):
+            out = np.zeros((n, *a.shape[1:]), a.dtype)
+            out[:len(a)] = a
+            return out
+
+        bid = np.full(Np, -1, np.int32)
+        bid[:N] = block_id
+        bf = topo.gang_block_fit(
+            padN(idle), padN(ready), padN(ntasks), padN(max_tasks),
+            bid, padN(prof_req, Upad), padN(prof_cnt, Upad), eps,
+            n_blocks=Bp,
+        )
+        frag = topo.fabric_frag(bf.cfit, bf.whole, padN(prof_cnt, Upad))
+        cfit, whole, score, frag = jax.device_get(
+            (bf.cfit, bf.whole, bf.score, frag))
+        sel = topo.select_block(whole[:B], score[:B], require)
+
+        ref = oracle_topology(idle, ready, ntasks, max_tasks, block_id,
+                              prof_req, prof_cnt, eps, require)
+        np.testing.assert_array_equal(
+            cfit[:B, :U], ref.cfit, err_msg=f"seed {seed}")
+        np.testing.assert_array_equal(
+            whole[:B], ref.whole, err_msg=f"seed {seed}")
+        np.testing.assert_array_equal(
+            score[:B], ref.score, err_msg=f"seed {seed}")
+        np.testing.assert_array_equal(
+            frag[:B], ref.frag, err_msg=f"seed {seed}")
+        assert sel == ref.selected, f"seed {seed}"
+
+
+def test_select_block_and_bias_edges():
+    whole = np.array([False, False])
+    score = np.array([3.0, 5.0], np.float32)
+    assert topo.select_block(whole, score, require=True) == -1
+    assert topo.select_block(whole, score, require=False) == 1
+    # Tie -> lowest block id.
+    assert topo.select_block(
+        np.array([True, True]), np.array([2.0, 2.0], np.float32),
+        require=True) == 0
+    bias = topo.contig_bias(np.array([0, 1, 0, -1]), 0, 6, weight=2.5)
+    np.testing.assert_array_equal(
+        bias, np.array([2.5, 0, 2.5, 0, 0, 0], np.float32))
+    assert not topo.contig_bias(np.array([0, 1]), -1, 4).any()
+    assert not topo.contig_bias(np.array([0, 1]), 0, 4, weight=0.0).any()
+
+
+# ------------------------------------------------------- fabric planes
+
+
+def test_fabric_planes_interning_and_cache():
+    """Label-derived coordinates intern append-only; unlabeled nodes
+    stay blockless; the per-epoch cache invalidates on node churn and
+    codes stay stable for surviving rows."""
+    store = ClusterStore()
+    for i in range(8):
+        store.add_node(Node(
+            name=f"n{i}", allocatable={"cpu": "4", "memory": "8Gi"},
+            labels=fabric_labels(i, nodes_per_host=2, hosts_per_slice=2,
+                                 slices_per_rack=2),
+        ))
+    store.add_node(Node(name="bare",
+                        allocatable={"cpu": "4", "memory": "8Gi"}))
+    m = store.mirror
+    coords, block, n_blocks = topo.fabric_planes(m)
+    assert topo.has_fabric(m)
+    assert n_blocks == 2  # 8 nodes / 4 per slice
+    bare = m.n_row["bare"]
+    assert block[bare] == -1 and (coords[bare] == -1).all()
+    labeled = [m.n_row[f"n{i}"] for i in range(8)]
+    assert sorted(set(block[labeled])) == [0, 1]
+    # Same epoch -> cached object identity.
+    again = topo.fabric_planes(m)
+    assert again[1] is block
+    # Node add bumps the epoch; existing codes are stable.
+    store.add_node(Node(
+        name="n8", allocatable={"cpu": "4", "memory": "8Gi"},
+        labels=fabric_labels(8, nodes_per_host=2, hosts_per_slice=2,
+                             slices_per_rack=2),
+    ))
+    coords2, block2, n_blocks2 = topo.fabric_planes(m)
+    assert n_blocks2 == 3
+    for ni in labeled:
+        assert block2[ni] == block[ni]
+        assert (coords2[ni] == coords[ni]).all()
+    store.close()
+
+
+def test_topology_code_field_annotation_and_unknown():
+    assert topology_code(PodGroup(name="a")) == TOPOLOGY_NONE
+    assert topology_code(
+        PodGroup(name="b", topology="prefer-contiguous")
+    ) == TOPOLOGY_PREFER
+    assert topology_code(
+        PodGroup(name="c", annotations={
+            TOPOLOGY_ANNOTATION: "require-contiguous"})
+    ) == TOPOLOGY_REQUIRE
+    # The field wins over the annotation; unknown values degrade to
+    # unconstrained instead of erroring.
+    assert topology_code(
+        PodGroup(name="d", topology="prefer-contiguous",
+                 annotations={TOPOLOGY_ANNOTATION: "require-contiguous"})
+    ) == TOPOLOGY_PREFER
+    assert topology_code(
+        PodGroup(name="e", topology="ring-of-fire")
+    ) == TOPOLOGY_NONE
+
+
+# ------------------------------------------------- constraint semantics
+
+
+def test_require_gang_pregated_with_journey_reason():
+    """A require-contiguous gang no block can host is held OUT of the
+    solve: zero binds, one infeasible transition, and the journey's
+    why-pending verdict carries the exclusive drop reason."""
+    before = _placements("infeasible")
+    store = fabric_cluster(binder=FakeBinder())
+    sched = Scheduler(store, conf_str=ALLOC_CONF)
+    sched.run_once()
+    sched.run_once()  # standing infeasibility: no second count
+    assert not any(p.node_name for p in _gang_pods(store))
+    assert _placements("infeasible") == before + 1
+    if store.journey is not None:
+        uid = next(p.uid for p in _gang_pods(store))
+        assert "topology-infeasible" in store.journey.why_pending(uid)
+    store.close()
+
+
+def test_require_gang_binds_contiguous_when_block_fits(monkeypatch):
+    """With one slice left whole, the require gang binds in one cycle,
+    entirely inside one block, and counts a contiguous placement."""
+    before = _placements("contiguous")
+    store = fabric_cluster(fillers_per_slice=0, gang_tasks=32,
+                           binder=FakeBinder())
+    sched = Scheduler(store, conf_str=ALLOC_CONF)
+    sched.run_once()
+    bound = [p for p in _gang_pods(store) if p.node_name]
+    assert len(bound) == 32
+    assert len({_slice_of(store, p.node_name) for p in bound}) == 1
+    assert _placements("contiguous") == before + 1
+    store.close()
+
+
+def test_prefer_gang_scatters_when_no_block_fits():
+    """prefer-contiguous never loses binding: on the fragmented fabric
+    the gang binds scattered (full-N fallback) and counts scattered."""
+    before = _placements("scattered")
+    store = fabric_cluster(topology="prefer-contiguous",
+                           binder=FakeBinder())
+    sched = Scheduler(store, conf_str=ALLOC_CONF)
+    sched.run_once()
+    bound = [p for p in _gang_pods(store) if p.node_name]
+    assert len(bound) == 32
+    assert len({_slice_of(store, p.node_name) for p in bound}) > 1
+    assert _placements("scattered") == before + 1
+    store.close()
+
+
+def test_prefer_gang_bias_steers_into_whole_block():
+    """When a whole block DOES fit the gang, the node-order bias lands
+    every task inside it (ties between equal free nodes break toward
+    the selected block)."""
+    before = _placements("contiguous")
+    store = fabric_cluster(fillers_per_slice=0, gang_tasks=32,
+                           topology="prefer-contiguous",
+                           binder=FakeBinder())
+    sched = Scheduler(store, conf_str=ALLOC_CONF)
+    sched.run_once()
+    bound = [p for p in _gang_pods(store) if p.node_name]
+    assert len(bound) == 32
+    assert len({_slice_of(store, p.node_name) for p in bound}) == 1
+    assert _placements("contiguous") == before + 1
+    store.close()
+
+
+# --------------------------------------------------------- kill switch
+
+
+def test_kill_switch_bitwise_identity(monkeypatch):
+    """VOLCANO_TPU_TOPOLOGY=0 on a constrained store is BYTE-identical
+    to an unconstrained store with the feature on: every solve_wave
+    call sees the same positional arity (8 — no bias appended) and the
+    same bytes in every array leaf, and the end state is bind-for-bind
+    identical."""
+    import jax
+
+    import volcano_tpu.ops.wave as wave_mod
+
+    real = wave_mod.solve_wave
+
+    def run(store):
+        frames = []
+
+        def spy(*args, **kw):
+            frames.append((len(args), [
+                np.asarray(leaf).tobytes()
+                for leaf in jax.tree_util.tree_leaves(args)
+            ]))
+            return real(*args, **kw)
+
+        monkeypatch.setattr(wave_mod, "solve_wave", spy)
+        try:
+            Scheduler(store, conf_str=ALLOC_CONF).run_once()
+        finally:
+            monkeypatch.setattr(wave_mod, "solve_wave", real)
+        store.flush_binds()
+        binds = dict(store.binder.binds)
+        store.close()
+        return frames, binds
+
+    monkeypatch.setenv("VOLCANO_TPU_TOPOLOGY", "0")
+    frames_off, binds_off = run(fabric_cluster(binder=FakeBinder()))
+
+    monkeypatch.setenv("VOLCANO_TPU_TOPOLOGY", "1")
+    frames_plain, binds_plain = run(
+        fabric_cluster(topology="", binder=FakeBinder()))
+
+    assert frames_off and frames_off == frames_plain
+    assert all(arity == 8 for arity, _ in frames_off)
+    assert binds_off and binds_off == binds_plain
+
+
+def test_unconstrained_store_pays_nothing():
+    """A fabric-labeled cluster with NO constrained gang never derives
+    block planes on the allocate path (the j_topo.any() gate)."""
+    store = fabric_cluster(topology="", binder=FakeBinder())
+    sched = Scheduler(store, conf_str=ALLOC_CONF)
+    sched.run_once()
+    assert getattr(store.mirror, "_fabric_cache", None) is None
+    assert sum(1 for p in _gang_pods(store) if p.node_name) == 32
+    store.close()
+
+
+# ------------------------------------------------------- acceptance e2e
+
+
+def test_e2e_require_contiguous_defrag(monkeypatch):
+    """Acceptance: the fragmented 2-rack fabric reports the gang
+    topology-infeasible, ONE committed rebalance wave assembles a whole
+    slice, and after the grace window the gang binds fully contiguous —
+    zero lost pods, per-filler disruption budgets held."""
+    monkeypatch.setenv("VOLCANO_TPU_REBALANCE_DRAIN_CAP", "64")
+    inf_before = _placements("infeasible")
+    cont_before = _placements("contiguous")
+    store = fabric_cluster(binder=FakeBinder())
+    n_logical = len(store.pods)
+    sched = Scheduler(store, conf_str=REBALANCE_SCHEDULER_CONF)
+    sim = ClusterSimulator(store, grace_steps=2)
+
+    sched.run_once()  # pregate holds the gang; plan forms + commits
+    assert not any(p.node_name for p in _gang_pods(store))
+    assert _placements("infeasible") == inf_before + 1
+    ledger = store.migrations
+    assert ledger is not None and ledger.committed_plans == 1
+
+    converged_cycles = 1
+    for _ in range(12):
+        converged_cycles += 1
+        sim.step()
+        sched.run_once()
+        if sum(1 for p in _gang_pods(store) if p.node_name) >= 32:
+            break
+    bound = [p for p in _gang_pods(store) if p.node_name]
+    assert len(bound) == 32, f"gang stuck after {converged_cycles}"
+    assert len({_slice_of(store, p.node_name) for p in bound}) == 1
+    assert _placements("contiguous") == cont_before + 1
+
+    # Zero lost pods: every filler (original or migration-restored) is
+    # bound again; nothing disappeared.
+    assert len(store.pods) == n_logical
+    fillers = [p for p in store.pods.values()
+               if p.name.startswith("filler")]
+    assert len(fillers) == 8 and all(p.node_name for p in fillers)
+    # Budgets: single-member filler groups never exceed 1 disruption.
+    for i in range(8):
+        assert ledger.disrupted(store, f"default/filler-{i:04d}") <= 1
+    assert ledger.committed_plans == 1, "one wave sufficed"
+    store.close()
+
+
+def test_rejected_topology_when_no_drain_helps(monkeypatch):
+    """When even a full drain cannot complete any block (the gang is
+    bigger than every block's freed capacity), the planner counts
+    rejected-topology instead of thrashing evictions."""
+    key = (("action", "rebalance"), ("outcome", "rejected-topology"))
+    before = metrics.whatif_plans.data.get(key, 0.0)
+    # 2 tiny slices of 2 nodes: max 8 slots per block < 12 tasks.
+    store = fabric_cluster(racks=2, slices_per_rack=1,
+                           nodes_per_slice=2, hosts_per_slice=2,
+                           fillers_per_slice=1, gang_tasks=12,
+                           binder=FakeBinder())
+    sched = Scheduler(store, conf_str=REBALANCE_SCHEDULER_CONF)
+    sched.run_once()
+    sched.run_once()
+    assert store.migrations is None or \
+        store.migrations.committed_plans == 0
+    assert metrics.whatif_plans.data.get(key, 0.0) > before
+    assert not any(p.node_name for p in _gang_pods(store))
+    store.close()

@@ -53,6 +53,10 @@ DEVICE_FNS = {
     # Hierarchical block->shard->global selection (ISSUE 12): the
     # merge helper returns device id planes.
     "_merge_block_cands",
+    # Topology kernels (ISSUE 20): per-block gang-fit and fabric
+    # fragmentation planes come back device-resident; jax.device_get
+    # is the sanctioned fetch before host-side block selection.
+    "gang_block_fit", "fabric_frag",
 }
 
 # Call leaf names that force a device->host sync when fed a device value.
@@ -111,6 +115,14 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         HotEntry("FastCycle._rebalance"),
         HotEntry("FastCycle._plan_rebalance"),
         HotEntry("FastCycle._commit_inflight_plan"),
+        # Topology gates (ISSUE 20): the pregate + block-fit dispatch
+        # run before every solve round, the post-solve gate on both
+        # the sync and pipelined commit paths, the bias builder inside
+        # _solve_inputs — all on the cycle thread.
+        HotEntry("FastCycle._topo_block_fit"),
+        HotEntry("FastCycle._topology_pregate"),
+        HotEntry("FastCycle._topo_node_bias"),
+        HotEntry("FastCycle._topology_gate"),
     ],
     "volcano_tpu/whatif.py": [
         # The what-if engine (ISSUE 11): hypothetical-solve dispatch,
@@ -186,6 +198,14 @@ HOT_REGISTRY: Dict[str, List[HotEntry]] = {
         HotEntry("frag_scores"),
         HotEntry("select_drain_set"),
     ],
+    "volcano_tpu/ops/topology.py": [
+        # The jitted block-fit/frag kernels (VCL201 taint sources) and
+        # the host-only selection + bias builders over fetched planes.
+        HotEntry("gang_block_fit"),
+        HotEntry("fabric_frag"),
+        HotEntry("select_block"),
+        HotEntry("contig_bias"),
+    ],
     "volcano_tpu/parallel/mesh.py": [
         HotEntry("shard_wave_inputs"),
         HotEntry("sharded_solve_wave_cycle"),
@@ -216,6 +236,7 @@ BUDGET_FILES = {
     "volcano_tpu/ops/devincr.py",
     "volcano_tpu/ops/victim.py",
     "volcano_tpu/ops/rebalance.py",
+    "volcano_tpu/ops/topology.py",
 }
 CHUNK_BUDGET_REGISTRY: Dict[str, Set[str]] = {
     "volcano_tpu/ops/wave.py": {
@@ -232,6 +253,12 @@ CHUNK_BUDGET_REGISTRY: Dict[str, Set[str]] = {
     },
     "volcano_tpu/ops/rebalance.py": {
         "frag_scores",
+    },
+    "volcano_tpu/ops/topology.py": {
+        # Node/profile/block axes are pow2-padded to the
+        # _topo_block_fit buckets — fixed [N]- and [B, U]-bounded
+        # state, no [N, N]-class temporaries.
+        "gang_block_fit", "fabric_frag",
     },
 }
 
@@ -398,7 +425,10 @@ def collect_jits(tree: ast.Module) -> Dict[str, JitInfo]:
             elif (_dotted(dec) or "").endswith("jit"):
                 is_jit = True
             if is_jit:
-                params = [a.arg for a in node.args.args]
+                # Keyword-only params count: ``*, n_blocks`` statics
+                # (ops/topology.gang_block_fit) are legal jit statics.
+                params = [a.arg for a in
+                          node.args.args + node.args.kwonlyargs]
                 out[node.name] = JitInfo(
                     node.name, params, static, donate, node.lineno
                 )

@@ -21,6 +21,40 @@ from .types import PodGroupPhase, QueueState, TaskStatus
 # scheduling.k8s.io/group-name (v1beta1/types.go KubeGroupNameAnnotationKey).
 GROUP_NAME_ANNOTATION = "scheduling.volcano-tpu/group-name"
 
+# Per-gang fabric-topology constraint (PodGroup.topology equivalent for
+# annotation-driven workloads): "prefer-contiguous" folds the selected
+# fabric block into node ordering; "require-contiguous" refuses to bind
+# the gang scattered across blocks (drop reason ``topology-infeasible``).
+TOPOLOGY_ANNOTATION = "scheduling.volcano-tpu/topology"
+
+# Fabric coordinate label keys, coarse -> fine.  ``rack`` and ``slice``
+# define a contiguous placement block (an ICI slice / NVLink island
+# within a rack); ``host`` rides along for forensics.  Canonical here so
+# the wire schema (arrays.NodeArrays.fabric), the mirror planes
+# (ops/topology), and synth all agree on the order.
+FABRIC_RACK = "fabric.volcano-tpu/rack"
+FABRIC_SLICE = "fabric.volcano-tpu/slice"
+FABRIC_HOST = "fabric.volcano-tpu/host"
+FABRIC_LEVELS: Tuple[str, ...] = (FABRIC_RACK, FABRIC_SLICE, FABRIC_HOST)
+FABRIC_L = len(FABRIC_LEVELS)
+TOPOLOGY_NONE = 0
+TOPOLOGY_PREFER = 1
+TOPOLOGY_REQUIRE = 2
+_TOPOLOGY_CODES = {
+    "": TOPOLOGY_NONE,
+    "prefer-contiguous": TOPOLOGY_PREFER,
+    "require-contiguous": TOPOLOGY_REQUIRE,
+}
+
+
+def topology_code(pg: "PodGroup") -> int:
+    """Resolve a PodGroup's fabric constraint to its int code.  The
+    explicit field wins; the annotation is the CRD-compatible fallback.
+    Unknown values degrade to no-constraint (never block a bind on a
+    typo)."""
+    raw = pg.topology or pg.annotations.get(TOPOLOGY_ANNOTATION, "")
+    return _TOPOLOGY_CODES.get(raw or "", TOPOLOGY_NONE)
+
 # Critical-pod exemption set (conformance.go:44-66): system priority
 # classes and the system namespace.  Canonical here — the conformance
 # plugin, the evict machinery, and the mirror's p_critical column all
@@ -249,6 +283,11 @@ class PodGroup:
     # equivalent): max members a migration wave may evict at once.
     # None -> the VOLCANO_TPU_REBALANCE_MAX_UNAVAIL default.
     max_unavailable: Optional[int] = None
+    # Fabric-topology constraint: "" (none), "prefer-contiguous", or
+    # "require-contiguous"; the TOPOLOGY_ANNOTATION key is the
+    # annotation-driven equivalent (see topology_code()).
+    topology: str = ""
+    annotations: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self):
         if not self.creation_timestamp:

@@ -32,6 +32,7 @@ import numpy as np
 from .. import native
 from ..api import (
     CPU,
+    FABRIC_LEVELS,
     MEMORY,
     MIN_MEMORY,
     MIN_MILLI_CPU,
@@ -140,6 +141,12 @@ class NodeArrays(NamedTuple):
     label_bits: np.ndarray  # [N, LW] uint32 packed label-pair bitset
     taint_bits: np.ndarray  # [N, TW] uint32 packed NoSchedule/NoExecute taints
     port_bits: np.ndarray  # [N, PW] uint32 packed used host ports
+    # Fabric coordinates (rack/slice/host codes from the
+    # fabric.volcano-tpu/* labels, ops/FABRIC_LEVELS order);
+    # -1 = coordinate absent.  Interned per encode in first-seen order
+    # over the sorted node names, so identical clusters encode
+    # identically.
+    fabric: np.ndarray  # [N, FL] int32
 
 
 class TaskArrays(NamedTuple):
@@ -215,6 +222,7 @@ WIRE_COLUMNS: Tuple[Tuple[str, str, str, int], ...] = (
     ("NodeArrays", "label_bits", "uint32", 2),
     ("NodeArrays", "taint_bits", "uint32", 2),
     ("NodeArrays", "port_bits", "uint32", 2),
+    ("NodeArrays", "fabric", "int32", 2),
     ("TaskArrays", "req", "float32", 2),
     ("TaskArrays", "init_req", "float32", 2),
     ("TaskArrays", "job", "int32", 1),
@@ -364,6 +372,8 @@ def encode_cluster(
     n_real = np.zeros((N,), bool)
     n_maxtasks = np.zeros((N,), I)
     n_numtasks = np.zeros((N,), I)
+    n_fabric = np.full((N, len(FABRIC_LEVELS)), -1, I)
+    fabric_codes: Dict[Tuple[int, str], int] = {}
     label_dict = maps.label_dict
     taint_dict = maps.taint_dict
     port_dict = maps.port_dict
@@ -395,6 +405,14 @@ def encode_cluster(
             )
             if node.node.unschedulable:
                 n_ready[i] = False
+            for li, lkey in enumerate(FABRIC_LEVELS):
+                v = node.node.labels.get(lkey)
+                if v is None:
+                    continue
+                code = fabric_codes.get((li, v))
+                if code is None:
+                    code = fabric_codes[(li, v)] = len(fabric_codes)
+                n_fabric[i, li] = code
         lbl_off.append(len(lbl_idx))
         tnt_off.append(len(tnt_idx))
         prt_idx.extend(
@@ -570,6 +588,7 @@ def encode_cluster(
             label_bits=n_labels,
             taint_bits=n_taints,
             port_bits=n_ports,
+            fabric=n_fabric,
         ),
         tasks=TaskArrays(
             req=t_req,

@@ -44,6 +44,7 @@ from ..api import (
     SYSTEM_NODE_CRITICAL,
     Pod,
     TaskStatus,
+    topology_code,
 )
 from ..api.resource import Resource
 
@@ -325,6 +326,14 @@ class StoreMirror:
         self.j_gauge_key: List[Optional[tuple]] = []
         self.j_event_key: List[str] = []
         self.j_alive = np.zeros(jcap, bool)
+        # Fabric-topology constraint code per job (api.spec.topology_code:
+        # 0 none, 1 prefer-contiguous, 2 require-contiguous).
+        self.j_topo = np.zeros(jcap, np.int8)
+        # Append-only fabric interners (ops/topology.fabric_planes):
+        # (level, label value) -> code and (rack, slice) -> block id.
+        # Compaction-carried so codes stay stable for the store's life.
+        self._fabric_vals: Dict[tuple, int] = {}
+        self._fabric_blocks: Dict[tuple, int] = {}
         # Toleration specs per pod row (matched lazily per cycle, because
         # the taint dictionary may grow after the pod was added).
         self._pod_tols: List[list] = []
@@ -1030,6 +1039,7 @@ class StoreMirror:
             self.j_st_fail = _grow(self.j_st_fail, n)
             self.j_st_succ = _grow(self.j_st_succ, n)
             self.j_cond_sig = _grow(self.j_cond_sig, n)
+            self.j_topo = _grow(self.j_topo, n)
             self.j_queue.append("default")
             self.j_ns.append("default")
             self.j_pg.append(None)
@@ -1069,6 +1079,7 @@ class StoreMirror:
         self.j_queue_code[row] = self.qnames.intern(pg.queue)
         self.j_alive[row] = True
         self.j_pg[row] = pg
+        self.j_topo[row] = topology_code(pg)
         self.j_gauge_key[row] = (("job_name", pg.name),)
         self.j_event_key[row] = f"PodGroup/{pg.namespace}/{pg.name}"
         st = pg.status
@@ -1130,6 +1141,7 @@ class StoreMirror:
             self.j_pg[row] = None
             self.j_phase_code[row] = 0
             self.j_cond_sig[row] = 0
+            self.j_topo[row] = 0
 
     # ========================================================== maintenance
 
@@ -1154,7 +1166,8 @@ class StoreMirror:
                      "ns_names", "qnames", "j_ns_code", "j_queue_code",
                      "j_pg", "j_phase_code", "j_st_run", "j_st_fail",
                      "j_st_succ", "j_cond_sig", "j_gauge_key",
-                     "j_event_key",
+                     "j_event_key", "j_topo",
+                     "_fabric_vals", "_fabric_blocks",
                      "j_alive", "_pods_ref", "_orphans", "epoch",
                      "node_liveness_gen"):
             setattr(fresh, attr, getattr(old, attr))

@@ -42,7 +42,12 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .api import PodGroupCondition, PodGroupPhase, TaskStatus
+from .api import (
+    PodGroupCondition,
+    PodGroupPhase,
+    TaskStatus,
+    TOPOLOGY_REQUIRE,
+)
 from .api.resource import (
     MIN_MEMORY,
     MIN_MILLI_CPU,
@@ -1515,6 +1520,13 @@ class FastCycle:
             if prep is None:
                 break
             solve_jobs, task_rows = prep
+            # Require-contiguous gangs with no whole-gang fabric block
+            # sit the solve out (exclusive drop reason
+            # topology-infeasible) instead of scattering.
+            solve_jobs, task_rows = self._topology_pregate(
+                solve_jobs, task_rows)
+            if not len(task_rows):
+                break
             # Distinct rows entering solves this cycle: retry rounds
             # re-derive a SUBSET of round 1's pending set (commits only
             # shrink it), so the max over rounds is the distinct count —
@@ -1683,6 +1695,9 @@ class FastCycle:
                             )
                         )
                     assigned = assigned[:len(crows)]
+                    # Fabric gate: require-contiguous gangs scattered
+                    # across blocks are vetoed before the commit.
+                    assigned = self._topology_gate(crows, assigned)
                     dt_dev = time.perf_counter() - t0
                     lanes["device"] = lanes.get("device", 0.0) + dt_dev
                     metrics.device_solve_latency.observe(dt_dev * 1e3)
@@ -2121,6 +2136,11 @@ class FastCycle:
                 # Row set changed: let _commit re-gather the committed
                 # rows.
                 req_gather = None
+            # Fabric gate after the staleness guard: rows it vetoes are
+            # already -1, so the topology-infeasible reason stays
+            # exclusive with the revalidation vocabulary.
+            assigned = self._topology_gate(
+                task_rows, assigned, solve_id=inflight.solve_id)
             if (assigned >= 0).any():
                 self._commit(
                     inflight.solve_jobs, task_rows, assigned,
@@ -2165,6 +2185,13 @@ class FastCycle:
                                    gone / not ready
         - ``capacity-taken``       surviving charge would oversubscribe
                                    the node's allocatable or task slots
+
+        One more exclusive reason joins this vocabulary downstream:
+        ``topology-infeasible``, applied by the fabric gate
+        (``_topology_gate``) that runs right after this guard — a
+        require-contiguous gang whose SURVIVING rows span more than one
+        fabric block drops wholesale there, so the attribution stays
+        one-reason-per-row across both stages.
 
         Under the sharded control plane (``cross_shard=True``: another
         shard committed binds, or a queue steal landed, during the
@@ -2279,6 +2306,216 @@ class FastCycle:
                 n_drop, int(np.count_nonzero(live)),
             )
         return out
+
+    # ------------------------------------------------------ topology gates
+
+    def _topo_active(self) -> bool:
+        """Cheap master gate for every fabric-topology hook: the kill
+        switch is up, at least one job carries a constraint, and the
+        cluster has fabric-labeled nodes.  An unlabeled cluster (or
+        ``VOLCANO_TPU_TOPOLOGY=0``) short-circuits every hook, keeping
+        the solve inputs — and the remote wire frames — byte-identical
+        to the pre-topology build."""
+        from .ops import topology as topo
+
+        if not topo.topology_on():
+            return False
+        m = self.m
+        if self.Jn == 0 or not m.j_topo[:self.Jn].any():
+            return False
+        return topo.has_fabric(m)
+
+    def _topo_block_fit(self, jrow: int):
+        """Per-fabric-block whole-gang fit of job ``jrow``'s pending
+        tasks (ops/topology.gang_block_fit, fetched host-side), or None
+        when the gang has nothing pending.  Returns a dict with the
+        padded [Np] block-id plane, the per-block cfit/whole/score
+        (trash row sliced off), and the profile counts."""
+        import jax
+
+        from .ops import topology as topo
+
+        m = self.m
+        _, block, n_blocks = topo.fabric_planes(m)
+        if n_blocks == 0:
+            return None
+        Pn = self.Pn
+        pend = np.flatnonzero(
+            m.p_alive[:Pn] & (m.p_status[:Pn] == ST_PENDING)
+            & ~m.p_be[:Pn] & (self.jobr == jrow)
+        )
+        if not len(pend):
+            return None
+        # Distinct profiles of the gang's pending tasks -> dense [U, R]
+        # init-request table + per-profile counts (same interning
+        # _plan_rebalance's prof_req uses).
+        _, first, counts = np.unique(
+            m.p_prof[pend], return_index=True, return_counts=True
+        )
+        order = np.argsort(first)
+        urows = pend[first[order]]
+        counts = counts[order]
+        # Pow2 buckets on every static axis (profile rows, node rows,
+        # block rows) so fabric growth and gang-shape churn share a
+        # bounded set of compiled kernels (VCL204: planes are padded to
+        # the _solve_inputs buckets).
+        Up = _pow2(max(len(urows), 1), 4)
+        prof_req = np.zeros((Up, self.R), F)
+        er, si, v = m.c_init_req.gather(urows)
+        prof_req[er, si] = v
+        prof_cnt = np.zeros((Up,), I)
+        prof_cnt[:len(urows)] = counts
+        Np = _pow2(max(self.Nn, 1))
+
+        def padN(a, fill=0):
+            out = np.full((Np, *a.shape[1:]), fill, a.dtype)
+            out[:len(a)] = a
+            return out
+
+        bid = np.full((Np,), -1, I)
+        bid[:self.Nn] = block[:self.Nn]
+        Bp = _pow2(max(n_blocks, 1), 4)
+        bf = topo.gang_block_fit(
+            padN(self.n_idle.astype(F)), padN(self.n_ready),
+            padN(self.n_ntasks), padN(self.n_maxtasks), bid,
+            prof_req, prof_cnt, self.eps, n_blocks=Bp,
+        )
+        cfit, whole, score = jax.device_get((bf.cfit, bf.whole, bf.score))
+        return {
+            "block": bid, "n_blocks": n_blocks,
+            "cfit": cfit[:n_blocks], "whole": whole[:n_blocks],
+            "score": score[:n_blocks], "prof_cnt": prof_cnt,
+        }
+
+    def _topology_pregate(self, solve_jobs: List[int],
+                          task_rows: np.ndarray):
+        """Require-contiguous gate ahead of the solve: a gang no fabric
+        block can host WHOLE is excluded from the solve inputs — it
+        reports the exclusive drop reason ``topology-infeasible``
+        (journey + placement counter, on the gating transition) instead
+        of scattering across blocks.  The starvation this creates is
+        what the rebalance lane's fabric-defrag targeting relieves."""
+        if not self._topo_active():
+            return solve_jobs, task_rows
+        m = self.m
+        jt = m.j_topo
+        req_jobs = [j for j in solve_jobs if jt[j] == TOPOLOGY_REQUIRE]
+        if not req_jobs:
+            return solve_jobs, task_rows
+        gated = getattr(self.store, "_topo_gated", None)
+        if gated is None:
+            gated = self.store._topo_gated = set()
+        drop: List[int] = []
+        for j in req_jobs:
+            tf = self._topo_block_fit(j)
+            if tf is None:
+                continue
+            uid = m.j_uid[j]
+            if tf["whole"].any():
+                gated.discard(uid)
+                continue
+            drop.append(j)
+            if uid not in gated:
+                # Transition accounting only: the gang re-gates every
+                # cycle until the fabric changes, and re-counting a
+                # standing condition per cycle would swamp both series.
+                gated.add(uid)
+                metrics.topology_placements.inc(outcome="infeasible")
+                self._journey_rows(
+                    task_rows[self.jobr[task_rows] == j], "dropped",
+                    detail="topology-infeasible",
+                )
+                log.info(
+                    "gang %s requires contiguous placement but no "
+                    "fabric block can host it whole; held out of the "
+                    "solve (topology-infeasible)", uid,
+                )
+        if not drop:
+            return solve_jobs, task_rows
+        dropset = np.zeros(self.Jn, bool)
+        dropset[drop] = True
+        task_rows = task_rows[~dropset[self.jobr[task_rows]]]
+        solve_jobs = [j for j in solve_jobs if not dropset[j]]
+        return solve_jobs, task_rows
+
+    def _topo_node_bias(self, solve_jobs, n_pad: int):
+        """[n_pad] f32 node-order bias steering the FIRST constrained
+        gang of the solve toward its selected fabric block
+        (ops/topology.contig_bias), or None when no constraint is live
+        — the None case keeps solve_args an 8-tuple, which is the
+        wire-byte identity guarantee of the kill switch."""
+        from .ops import topology as topo
+
+        if not self._topo_active():
+            return None
+        jt = self.m.j_topo
+        target = next((int(j) for j in solve_jobs if jt[j]), None)
+        if target is None:
+            return None
+        tf = self._topo_block_fit(target)
+        if tf is None:
+            return None
+        sel = topo.select_block(
+            tf["whole"], tf["score"],
+            require=int(jt[target]) == TOPOLOGY_REQUIRE,
+        )
+        if sel < 0:
+            return None
+        bias = topo.contig_bias(tf["block"], sel, n_pad)
+        return bias if bias.any() else None
+
+    def _topology_gate(self, task_rows: np.ndarray,
+                       assigned: np.ndarray, *,
+                       solve_id: int = 0) -> np.ndarray:
+        """Post-solve fabric gate: decide each constrained gang's
+        placement outcome by the block span of its assigned rows.
+
+        ``require-contiguous`` gangs spanning more than one block (or
+        landing off-fabric) are vetoed wholesale — rows drop to -1
+        under the exclusive reason ``topology-infeasible`` before any
+        commit, so a constrained gang is never bound scattered (the
+        constraint's atomicity guarantee; ``gang_block_fit`` is only a
+        per-profile upper bound, this is the exact enforcer).  Passing
+        gangs count into ``volcano_topology_placements_total`` as
+        ``contiguous`` or ``scattered``."""
+        from .ops import topology as topo
+
+        if not len(task_rows) or not self._topo_active():
+            return assigned
+        m = self.m
+        jt = m.j_topo
+        jobr_rows = self.jobr[task_rows]
+        jobs_here = np.unique(jobr_rows)
+        topo_jobs = [int(j) for j in jobs_here if j >= 0 and jt[j]]
+        if not topo_jobs:
+            return assigned
+        _, block, _ = topo.fabric_planes(m)
+        blk = np.full((max(self.Nn, 1),), -1, I)
+        blk[:self.Nn] = block[:self.Nn]
+        assigned = np.asarray(assigned).copy()
+        veto = np.zeros(len(task_rows), bool)
+        for j in topo_jobs:
+            rows_mask = ((jobr_rows == j) & (assigned >= 0)
+                         & (assigned < self.Nn))
+            if not rows_mask.any():
+                continue
+            bsel = np.unique(blk[assigned[rows_mask]])
+            contiguous = bool(len(bsel) == 1 and bsel[0] >= 0)
+            if jt[j] == TOPOLOGY_REQUIRE and not contiguous:
+                veto |= (jobr_rows == j) & (assigned >= 0)
+                metrics.topology_placements.inc(outcome="infeasible")
+            else:
+                metrics.topology_placements.inc(
+                    outcome="contiguous" if contiguous else "scattered"
+                )
+        if veto.any():
+            assigned[veto] = -1
+            self._count_drops({"topology-infeasible":
+                               int(np.count_nonzero(veto))})
+            self._journey_rows(task_rows[veto], "dropped",
+                               solve_id=solve_id,
+                               detail="topology-infeasible")
+        return assigned
 
     def _solve_chunks(self, solve_jobs: List[int], task_rows: np.ndarray):
         """Split one solve call at job boundaries when the affinity count
@@ -3037,9 +3274,19 @@ class FastCycle:
         # the padded node axis, read by _devincr_prepare.
         self._cls_sig = cls_sig if use_classes else ""
         self._solve_np = Np
+        solve_args = (nodes, tasks, jobs, queues, weights, self.eps,
+                      self.scalar_slot, aff)
+        if slim:
+            # Topology node-order bias (9th solve_args element, sharded
+            # under mesh and framed over the remote wire like any node
+            # plane).  Appended ONLY when a fabric constraint is live:
+            # the 8-tuple form keeps frames and traces byte-identical
+            # to the pre-topology build (the kill-switch guarantee).
+            bias = self._topo_node_bias(solve_jobs, Np)
+            if bias is not None:
+                solve_args = solve_args + (bias,)
         return (
-            (nodes, tasks, jobs, queues, weights, self.eps,
-             self.scalar_slot, aff),
+            solve_args,
             pid,
             profiles,
             node_classes,
@@ -4180,6 +4427,61 @@ class FastCycle:
             frag_mean = (float(frag[alive].mean())
                          if alive.any() else 0.0)
             metrics.rebalance_frag_score.set(frag_mean)
+            # Fabric-defrag targeting (ops/topology): when the starved
+            # gang carries a topology constraint, the drain set
+            # concentrates on ONE target fabric block — the block whose
+            # drains free the most gang capacity — so the migration
+            # wave assembles a whole slice instead of shaving capacity
+            # evenly across the fabric.  Outside the target block the
+            # gain and frag signals are zeroed; select_drain_set (and
+            # its disruption-budget charging) is unchanged.
+            if m.j_topo[jrow] and self._topo_active():
+                from .ops import topology as topo
+
+                tf = self._topo_block_fit(jrow)
+                if tf is not None:
+                    frag_b = np.asarray(jax.device_get(topo.fabric_frag(
+                        tf["cfit"], tf["whole"], tf["prof_cnt"]
+                    )))
+                    metrics.topology_frag_score.set(
+                        float(frag_b.mean()) if len(frag_b) else 0.0)
+                    blk = tf["block"][:self.Nn]
+                    nb = tf["n_blocks"]
+                    total_need = int(np.sum(tf["prof_cnt"]))
+                    freed_sum = np.zeros(nb + 1, np.float64)
+                    np.add.at(freed_sum,
+                              np.where(blk >= 0, blk, nb), fit_freed)
+                    freed_sum = freed_sum[:nb]
+                    if (nb and total_need > 0
+                            and freed_sum.max() >= total_need):
+                        target = int(np.argmax(freed_sum))
+                        on_blk = blk == target
+                        # The drain wave only has to close the target
+                        # block's SHORTFALL — its standing free
+                        # capacity (cfit) already counts toward the
+                        # gang; the classic need (minav - ready) would
+                        # demand the whole gang out of drains alone
+                        # and starve forever on a mostly-free block.
+                        short = int(np.maximum(
+                            np.asarray(tf["prof_cnt"], np.int64)
+                            - np.asarray(tf["cfit"][target], np.int64),
+                            0).sum())
+                        if short <= 0:
+                            # Block already whole: the pregate lifts
+                            # next cycle; nothing to drain.
+                            return None
+                        need = short
+                        frag = np.where(on_blk, frag, 0.0)
+                        fit_freed = np.where(on_blk, fit_freed, fit_now)
+                    elif m.j_topo[jrow] == TOPOLOGY_REQUIRE:
+                        # No block gains capacity from any drain: no
+                        # migration wave can make this gang contiguous.
+                        whatif.count_plan(
+                            self, "rebalance", "rejected-topology",
+                            gang=m.j_uid[jrow], need=need,
+                        )
+                        self._rebalance_backoff_set(m.j_uid[jrow])
+                        return None
             # Per-node victim lists only for DRAIN CANDIDATES (frag-
             # positive nodes whose drain gains capacity): the Python
             # walk is then bounded by the fragmentation hotspots, not

@@ -279,8 +279,9 @@ class Metrics:
             "In-flight solve rows that did not commit, by reason: the "
             "staleness guard's per-row drops (deleted, competing-bind, "
             "capacity-taken, constraint-sensitive, node-epoch-churn, "
-            "cross-shard-conflict) plus whole-result voids "
-            "(compaction, lost-reply, device-crash)",
+            "cross-shard-conflict, topology-infeasible) plus "
+            "whole-result voids (compaction, lost-reply, "
+            "device-crash)",
         )
         self.shard_conflicts = _Counter(
             f"{ns}_shard_conflicts_total",
@@ -343,6 +344,25 @@ class Metrics:
             "planning pass: fraction of idle stranded on nodes unable "
             "to host any task of the starved gang's profiles (0 = no "
             "stranded idle, 1 = fully idle yet useless)",
+        )
+        self.topology_placements = _Counter(
+            f"{ns}_topology_placements_total",
+            "Gang placements through the topology gate (ops/topology, "
+            "ISSUE 20) by outcome: contiguous (every bound task landed "
+            "in one fabric block), scattered (a prefer-contiguous gang "
+            "bound across blocks; bias lost to capacity), infeasible "
+            "(a require-contiguous gang was held back — no block can "
+            "host the whole gang right now, or a post-solve check "
+            "caught a scattered assignment and vetoed it; the gang "
+            "re-places after defragmentation)",
+        )
+        self.topology_frag_score = _Gauge(
+            f"{ns}_topology_frag_score",
+            "Mean per-block fabric fragmentation at the last rebalance "
+            "planning pass for a topology-constrained gang: fraction "
+            "of the gang placeable on partial blocks that cannot host "
+            "it whole (0 = some block fits the entire gang, higher = "
+            "capacity stranded across partial slices)",
         )
         self.solver_pool_dispatch = _Counter(
             f"{ns}_solver_pool_dispatch_total",

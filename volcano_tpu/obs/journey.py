@@ -494,9 +494,12 @@ class JourneyLog:
             return "bound"
         if st.last_kind in ("evicted", "migration-planned"):
             return f"{st.last_kind} (awaiting restore)"
-        if st.first_ns is None:
-            return "never considered (queue backlog)"
+        # Drop evidence wins over the never-dispatched check: a pregate
+        # hold (e.g. topology-infeasible) drops the pod without it ever
+        # entering a solve, and THAT is the verdict, not "backlog".
         if not st.drops:
+            if st.first_ns is None:
+                return "never considered (queue backlog)"
             return "considered, no drops recorded (awaiting commit)"
         parts: List[str] = []
         run: Optional[Tuple[str, int]] = None

@@ -10,8 +10,9 @@ cycle 48231 drop 17 rows" investigation needs:
 - the lane breakdown (derive/feed/encode/device/order/commit/close),
 - pods considered / bound / dropped, drop counts BY REASON (the
   staleness guard's deleted / competing-bind / capacity-taken /
-  constraint-sensitive / node-epoch-churn, plus the whole-result voids
-  compaction / lost-reply / device-crash),
+  constraint-sensitive / node-epoch-churn, the topology gate's
+  topology-infeasible, plus the whole-result voids compaction /
+  lost-reply / device-crash),
 - the in-flight fetch wait (the pipeline's health signal),
 - device crash / budget-degradation events,
 - mirror ``mutation_seq`` / node-table ``epoch`` at dispatch vs commit

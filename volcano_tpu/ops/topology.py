@@ -1,0 +1,286 @@
+"""Topology-aware gang placement: fabric planes + contiguous blocks.
+
+Every scorer in the tree so far is topology-blind: a 32-task training
+gang scattered across racks binds "correctly" but trains slowly — the
+exact scenario the paper's workload (distributed training on
+accelerator fabrics, SURVEY §1) cares about most, and one quantity-based
+policies provably leave on the table (Gavel, arXiv:2008.09213).  This
+module adds the fabric as a solver *dimension*, not a new solver:
+
+- **fabric model** — nodes carry fabric coordinates from labels
+  (``fabric.volcano-tpu/rack`` / ``slice`` / ``host``).  The mirror
+  interns the values append-only (``StoreMirror._fabric_vals`` /
+  ``_fabric_blocks``, compaction-carried) and this module derives two
+  epoch-cached host planes: ``fabric_coords`` ``[N, 3]`` int32 (the
+  wire plane — ``arrays.NodeArrays.fabric`` carries the same layout
+  over snapwire protocol v2) and ``block_ids`` ``[N]`` int32, where a
+  *block* is one contiguous placement domain — an interned
+  ``(rack, slice)`` pair (an ICI slice / NVLink island within a rack).
+  Unlabeled nodes get coordinate/block ``-1`` and never join a block.
+
+- **contiguous-block gang scoring** — ``gang_block_fit`` is one jitted
+  pass over the node planes (the block-granular sibling of
+  ``ops/wave._coarse_shortlist``'s two-phase pattern): per-node task
+  capacity per gang profile, segment-summed per block, reduced to
+  per-block *whole-gang* feasibility and a partial-fit score.
+  ``select_block`` is the deterministic host-side pick (max score, tie
+  lowest block id).  Per-gang constraints (``PodGroup.topology`` /
+  the ``scheduling.volcano-tpu/topology`` annotation):
+
+  - ``require-contiguous`` — allocate pre-gates the gang (drops it
+    from the solve with the exclusive drop reason
+    ``topology-infeasible`` when no block can host the whole gang) and
+    post-gates the result (a scattered assignment is vetoed before
+    commit, never bound);
+  - ``prefer-contiguous`` — the selected block's nodes get an additive
+    node-order bias (``contig_bias``) folded into the wave solver's
+    static score plane; the solver's existing full-N fallback
+    guarantees binding is never lost to the preference.
+
+- **fabric defragmentation** — ``fabric_frag`` scores stranded partial
+  slices per block; ``FastCycle._plan_rebalance`` uses the per-block
+  fit planes to concentrate a require-gang's migration plan on one
+  target block, proven and committed through the existing what-if
+  engine under the same disruption budgets and staleness guards.
+
+Kill switch ``VOLCANO_TPU_TOPOLOGY=0``: every hook gates on
+``topology_on()`` *and* the presence of fabric labels, so an unlabeled
+cluster — or the switch — keeps the solve inputs (and therefore the
+remote-solver wire frames) byte-identical to the pre-topology build.
+
+``oracle.oracle_topology`` is the deliberately naive Go-shaped
+re-implementation of the scoring + selection; tests require exact
+agreement on seeded fragmented fabrics.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+F = np.float32
+I = np.int32
+
+# Fabric coordinate label keys (canonical definitions in api.spec so
+# the wire schema can share them without an arrays -> ops cycle).
+from ..api.spec import (  # noqa: E402  (re-export)
+    FABRIC_HOST,
+    FABRIC_L,
+    FABRIC_LEVELS,
+    FABRIC_RACK,
+    FABRIC_SLICE,
+)
+
+# Per-node fit counts are clipped here before the int32 cast (a node
+# with no requested slot would otherwise divide to inf).
+_FIT_MAX = float(2 ** 30)
+
+
+def topology_on() -> bool:
+    """Master switch (``VOLCANO_TPU_TOPOLOGY``, default on).  Read per
+    decision, not at import — in-process flips must take effect."""
+    return os.environ.get("VOLCANO_TPU_TOPOLOGY", "1") != "0"
+
+
+def topo_weight() -> float:
+    """Additive node-order bias for the selected block's nodes
+    (``VOLCANO_TPU_TOPO_WEIGHT``, default 1.0)."""
+    raw = os.environ.get("VOLCANO_TPU_TOPO_WEIGHT", "1.0")
+    try:
+        return float(raw)
+    except ValueError:
+        return 1.0
+
+
+# ------------------------------------------------------------ mirror planes
+
+def _fabric_interners(m) -> Tuple[dict, dict]:
+    """The mirror's append-only fabric interners, created on first use
+    for stores older than this module.  ``_fabric_vals`` maps
+    ``(level, label value) -> code``; ``_fabric_blocks`` maps
+    ``(rack code, slice code) -> block id``.  Both are carried across
+    compaction (cache/mirror.py ``maybe_compact``), so codes and block
+    ids are stable for the life of the store."""
+    vals = getattr(m, "_fabric_vals", None)
+    if vals is None:
+        vals = m._fabric_vals = {}
+    blocks = getattr(m, "_fabric_blocks", None)
+    if blocks is None:
+        blocks = m._fabric_blocks = {}
+    return vals, blocks
+
+
+def fabric_planes(m) -> Tuple[np.ndarray, np.ndarray, int]:
+    """``(coords [Nrows, FABRIC_L] int32, block_id [Nrows] int32,
+    n_blocks)`` for the mirror's node table; ``-1`` marks a missing
+    coordinate / blockless node.
+
+    Epoch-cached on the mirror: coordinates are a pure function of the
+    node table (every node add/update bumps ``m.epoch``), and the
+    interners are append-only, so per-row values are stable across
+    epochs — the same property that lets the label/taint bit planes
+    ride the devsnap row-delta machinery."""
+    N = len(m.n_name)
+    cache = getattr(m, "_fabric_cache", None)
+    key = (m.epoch, N)
+    if cache is not None and cache[0] == key:
+        return cache[1], cache[2], cache[3]
+    vals, blocks = _fabric_interners(m)
+    coords = np.full((N, FABRIC_L), -1, I)
+    block = np.full((N,), -1, I)
+    for ni in range(N):
+        if not m.n_alive[ni]:
+            continue
+        node = m.node_objs[ni]
+        labels = getattr(node, "labels", None) if node is not None else None
+        if not labels:
+            continue
+        for li, lkey in enumerate(FABRIC_LEVELS):
+            v = labels.get(lkey)
+            if v is None:
+                continue
+            code = vals.get((li, v))
+            if code is None:
+                code = vals[(li, v)] = len(vals)
+            coords[ni, li] = code
+        if coords[ni, 0] >= 0 and coords[ni, 1] >= 0:
+            bkey = (int(coords[ni, 0]), int(coords[ni, 1]))
+            bid = blocks.get(bkey)
+            if bid is None:
+                bid = blocks[bkey] = len(blocks)
+            block[ni] = bid
+    n_blocks = len(blocks)
+    m._fabric_cache = (key, coords, block, n_blocks)
+    return coords, block, n_blocks
+
+
+def has_fabric(m) -> bool:
+    """True when at least one live node carries a complete block
+    coordinate (the cheap gate every fast-path hook checks first)."""
+    _, block, n_blocks = fabric_planes(m)
+    return n_blocks > 0 and bool((block >= 0).any())
+
+
+# --------------------------------------------------------------- kernels
+
+class BlockFit(NamedTuple):
+    """Per-block gang-fit planes (device arrays until fetched)."""
+
+    cfit: jnp.ndarray   # [B, U] i32 gang tasks of profile u the block holds
+    whole: jnp.ndarray  # [B] bool block can host the WHOLE gang
+    score: jnp.ndarray  # [B] f32 partial-fit score (sum of min(cfit, cnt))
+
+
+@partial(jax.jit, static_argnames=("n_blocks",))
+def gang_block_fit(idle, ready, ntasks, max_tasks, block_id, prof_req,
+                   prof_cnt, eps, *, n_blocks: int):
+    """Whole-gang fit per fabric block, one kernel dispatch.
+
+    ``idle`` [N, R] f32, ``ready`` [N] bool, ``ntasks``/``max_tasks``
+    [N] i32 (``max_tasks`` 0 = unlimited), ``block_id`` [N] i32 (-1 =
+    blockless), ``prof_req`` [U, R] f32 per-profile init requests of the
+    gang's pending tasks (all-zero rows inert), ``prof_cnt`` [U] i32
+    pending tasks per profile (0 for padding), ``eps`` [R] f32.
+    ``n_blocks`` is static (pow2-bucketed by callers); blockless nodes
+    collapse into a trash row that is sliced off.
+
+    Definitions (mirrored exactly by ``oracle.oracle_topology``):
+
+    - per (node, profile) capacity = min over requested slots of
+      ``floor((idle + eps) / req)``, 0 for profiles with no requested
+      slot, 0 on not-ready nodes, capped by the node's remaining pod
+      slots when ``max_tasks > 0``;
+    - ``cfit[b, u]`` = sum of the capacity over the block's nodes;
+    - ``whole[b]`` = all profiles: ``cfit[b, u] >= prof_cnt[u]``;
+    - ``score[b]`` = sum over profiles of ``min(cfit[b, u], cnt[u])``.
+
+    The per-profile independence makes ``whole`` an upper bound when
+    profiles share capacity — it is a pre-filter; the post-solve
+    topology gate (fastpath) is the exact enforcer.
+    """
+    idle = idle.astype(jnp.float32)
+    req = prof_req.astype(jnp.float32)
+    eps = eps.astype(jnp.float32)
+    cnt = prof_cnt.astype(jnp.int32)
+
+    requested = req > eps[None, :]  # [U, R]
+    per = jnp.floor(
+        (idle[:, None, :] + eps[None, None, :])
+        / jnp.maximum(req[None, :, :], 1e-9)
+    )
+    per = jnp.where(requested[None, :, :], per, jnp.float32(_FIT_MAX))
+    cap = jnp.min(per, axis=-1)  # [N, U]
+    cap = jnp.where(jnp.any(requested, axis=-1)[None, :], cap, 0.0)
+    cap = jnp.clip(cap, 0.0, _FIT_MAX)
+    slots_left = jnp.where(
+        max_tasks > 0,
+        jnp.maximum(max_tasks - ntasks, 0).astype(jnp.float32),
+        jnp.float32(_FIT_MAX),
+    )
+    cap = jnp.minimum(cap, slots_left[:, None])
+    cap = jnp.where(ready[:, None], cap, 0.0).astype(jnp.int32)
+
+    # Segment-sum into blocks; -1 rows land in the trash row n_blocks.
+    seg = jnp.where(block_id >= 0, block_id, n_blocks)
+    cfit = jnp.zeros((n_blocks + 1, cap.shape[1]), jnp.int32)
+    cfit = cfit.at[seg].add(cap)
+    cfit = cfit[:n_blocks]
+    whole = jnp.all(cfit >= cnt[None, :], axis=-1)
+    score = jnp.sum(
+        jnp.minimum(cfit, cnt[None, :]).astype(jnp.float32), axis=-1
+    )
+    return BlockFit(cfit=cfit, whole=whole, score=score)
+
+
+@jax.jit
+def fabric_frag(cfit, whole, prof_cnt):
+    """Stranded-partial-slice score per block, in [0, 1].
+
+    A block holding gang capacity it cannot complete (``whole`` false
+    but ``score > 0``) strands that capacity for contiguous placement:
+    ``frag[b] = (1 - whole[b]) * score[b] / total_need``.  The mean
+    over blocks is the ``volcano_topology_frag_score`` gauge the
+    defragmentation lane drives toward zero."""
+    cnt = prof_cnt.astype(jnp.float32)
+    need = jnp.maximum(jnp.sum(cnt), 1.0)
+    partial = jnp.sum(
+        jnp.minimum(cfit.astype(jnp.float32), cnt[None, :]), axis=-1
+    )
+    return jnp.where(whole, 0.0, partial / need)
+
+
+# ------------------------------------------------------------- host side
+
+def select_block(whole: np.ndarray, score: np.ndarray,
+                 require: bool) -> int:
+    """Deterministic target-block pick over fetched planes: the
+    max-score block (tie: lowest block id), restricted to whole-gang
+    blocks when ``require``.  Returns -1 when no candidate exists."""
+    whole = np.asarray(whole, bool)
+    score = np.asarray(score, np.float32)
+    cand = whole if require else np.ones(len(score), bool)
+    if not cand.any():
+        return -1
+    masked = np.where(cand, score, -np.inf)
+    return int(np.argmax(masked))  # argmax ties -> lowest index
+
+
+def contig_bias(block_id: np.ndarray, target_block: int, n_pad: int,
+                weight: Optional[float] = None) -> np.ndarray:
+    """``[n_pad]`` f32 additive node-order bias: ``weight`` on the
+    target block's nodes, 0 elsewhere (padding rows included).  Folded
+    into the wave solver's static score plane (BatchNodeOrder), so the
+    preference can never outrank feasibility — infeasible nodes stay
+    NEG-masked after the add."""
+    if weight is None:
+        weight = topo_weight()
+    bias = np.zeros((n_pad,), F)
+    if target_block >= 0 and weight != 0.0:
+        n = min(len(block_id), n_pad)
+        bias[:n][np.asarray(block_id[:n]) == target_block] = F(weight)
+    return bias

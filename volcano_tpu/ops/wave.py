@@ -856,7 +856,7 @@ def _warm_shortlist(nodes: SolveNodes, prof: SolveProfiles, extra_prof,
                                    "terms_disjoint", "two_phase",
                                    "cls_identity", "fb_cap",
                                    "mesh_shards", "static_ext",
-                                   "hier_pin", "flat_keys"))
+                                   "hier_pin", "flat_keys", "has_bias"))
 def _solve_wave(
     nodes: SolveNodes,
     tasks: SolveTasks,
@@ -888,6 +888,8 @@ def _solve_wave(
     stat_score=None,  # [U, C] f32
     hier_pin: int = 0,  # resolved TOPK_BLOCKS (0 = adaptive)
     flat_keys: bool = True,  # (term x domain) key space fits int32
+    node_bias=None,  # [N] f32 additive node-order bias (topology)
+    has_bias: bool = False,  # static: bias add traced only when real
 ) -> AllocResult:
     # Static feature flags let XLA drop whole subsystems from the program
     # when the snapshot provably cannot exercise them (no host ports
@@ -1164,6 +1166,17 @@ def _solve_wave(
                 # Attempt-invariant: hoisted out of the attempt loop (XLA
                 # does not hoist out of while_loops).
                 p_static_score = p_static_score + score_prof[pids]
+
+        if has_bias:
+            # Topology node-order bias (ops/topology.contig_bias): an
+            # additive plane over nodes, identical for every profile.
+            # Folding it here covers the full-N ranking, the two-phase
+            # shortlist gather (static_sl below), and the fb-counted
+            # full-N fallback rescore in one place.  Gated by the
+            # STATIC flag — not a `+ 0.0` — so biasless solves trace
+            # the exact pre-topology program (bitwise: -0.0 + 0.0
+            # flips a sign bit).
+            p_static_score = p_static_score + node_bias[None, :].astype(f32)
 
         if two_phase:
             # Phase-2 hoists: the wave's shortlist window and every
@@ -2667,6 +2680,7 @@ def solve_wave(
     eps,
     scalar_slot,
     aff: AffinityArgs,
+    node_bias=None,
     wave: int = DEFAULT_WAVE,
     pid=None,
     profiles: SolveProfiles = None,
@@ -2687,6 +2701,12 @@ def solve_wave(
     ``profiles`` also given (rows aligned to the pid numbering, which must
     be by first occurrence), nothing per-task is recomputed here and
     ``aff``'s task-level fields may be dummies.
+
+    ``node_bias`` (optional [N] f32, ops/topology.contig_bias) is an
+    additive node-order bias folded into every profile's static score —
+    the 9th element of the fast path's solve_args tuple, so remote
+    frames and mesh sharding carry it like any other node plane, and
+    the solver wire stays byte-identical when absent.
 
     ``extra_ok`` (optional [P, N] bool) carries custom-plugin predicate
     verdicts (session add_predicate_fn / add_device_mask_fn); it folds
@@ -3029,6 +3049,8 @@ def solve_wave(
             stat_score=stat[1] if stat is not None else None,
             hier_pin=hier_pin,
             flat_keys=flat_keys,
+            node_bias=node_bias,
+            has_bias=node_bias is not None,
         )
         t_fine = _time.perf_counter() - t0
     # Dispatch-side sub-lane telemetry (the cycle driver folds it into

@@ -544,6 +544,103 @@ def oracle_rebalance(idle, allocatable, ready, evictable, prof_req, eps,
     )
 
 
+class TopologyVerdict(NamedTuple):
+    """``oracle_topology`` output: per-block gang-fit planes and the
+    deterministic target-block pick, re-derived naively."""
+
+    cfit: np.ndarray      # [B, U] int gang tasks of profile u per block
+    whole: np.ndarray     # [B] bool block hosts the WHOLE gang
+    score: np.ndarray     # [B] partial-fit score
+    frag: np.ndarray      # [B] stranded-partial-slice score
+    selected: int         # target block (-1 = none)
+
+
+def oracle_topology(idle, ready, ntasks, max_tasks, block_id, prof_req,
+                    prof_cnt, eps, require) -> TopologyVerdict:
+    """Go-shaped reference for the contiguous-block gang scorer
+    (``ops/topology.gang_block_fit`` / ``fabric_frag`` /
+    ``select_block``): object-at-a-time loops over nodes, profiles and
+    blocks, no vectorization.  The kernel must agree exactly
+    (tests/test_topology.py parity on seeded fragmented fabrics).
+
+    Definitions (shared spec with ``ops.topology``):
+
+    - per (node, profile) capacity = min over requested slots of
+      ``floor((idle + eps) / req)``; a profile requesting nothing
+      caps 0; not-ready nodes cap 0; ``max_tasks > 0`` caps by the
+      node's remaining pod slots;
+    - ``cfit[b, u]`` = sum of the capacity over the block's nodes
+      (block -1 nodes belong to no block);
+    - ``whole[b]`` = every profile's ``cfit[b, u] >= prof_cnt[u]``;
+    - ``score[b]`` = sum of ``min(cfit[b, u], cnt[u])``;
+    - ``frag[b]`` = 0 when whole, else ``score[b] / total task count``;
+    - selection = max score among candidates (all blocks, or
+      whole-gang blocks when ``require``), tie -> lowest block id,
+      -1 when no candidate.
+    """
+    idle = np.asarray(idle, np.float32)
+    req = np.asarray(prof_req, np.float32)
+    eps = np.asarray(eps, np.float32)
+    cnt = np.asarray(prof_cnt, np.int64)
+    ready = np.asarray(ready, bool)
+    ntasks = np.asarray(ntasks, np.int64)
+    max_tasks = np.asarray(max_tasks, np.int64)
+    block_id = np.asarray(block_id, np.int64)
+    N, R = idle.shape
+    U = req.shape[0]
+    B = int(block_id.max()) + 1 if len(block_id) else 0
+
+    def cap_one(n, u):
+        if not ready[n]:
+            return 0
+        c = None
+        for r in range(R):
+            if req[u][r] <= eps[r]:
+                continue
+            per = int(np.floor(
+                np.float32(idle[n][r] + eps[r])
+                / np.float32(max(req[u][r], 1e-9))
+            ))
+            c = per if c is None else min(c, per)
+        if c is None:
+            return 0
+        c = max(c, 0)
+        if max_tasks[n] > 0:
+            c = min(c, max(int(max_tasks[n] - ntasks[n]), 0))
+        return c
+
+    cfit = np.zeros((B, U), np.int64)
+    for n in range(N):
+        b = int(block_id[n])
+        if b < 0:
+            continue
+        for u in range(U):
+            cfit[b][u] += cap_one(n, u)
+
+    whole = np.zeros(B, bool)
+    score = np.zeros(B, np.float64)
+    frag = np.zeros(B, np.float32)
+    total = max(int(cnt.sum()), 1)
+    for b in range(B):
+        whole[b] = all(cfit[b][u] >= cnt[u] for u in range(U))
+        score[b] = sum(min(int(cfit[b][u]), int(cnt[u])) for u in range(U))
+        # f32 division to match the kernel's rounding exactly (an f64
+        # divide + cast can differ by 1 ulp).
+        frag[b] = (np.float32(0.0) if whole[b]
+                   else np.float32(score[b]) / np.float32(total))
+
+    selected = -1
+    best = None
+    for b in range(B):
+        if require and not whole[b]:
+            continue
+        if best is None or score[b] > best:
+            best = score[b]
+            selected = b
+    return TopologyVerdict(cfit=cfit, whole=whole, score=score,
+                           frag=frag, selected=selected)
+
+
 def oracle_backfill(be_feasible, group_inqueue, task_group):
     """backfill.go:39-88: zero-request pending tasks of Inqueue groups
     place on the first feasible node in index order (no resource charge

@@ -102,7 +102,9 @@ def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
             lambda x: jax.device_put(np.asarray(x), replicated), tree
         )
 
-    nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = solve_args
+    node_bias = solve_args[8] if len(solve_args) > 8 else None
+    nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = \
+        solve_args[:8]
     nodes = type(nodes)(*[
         jax.device_put(np.asarray(x), node_sharded) for x in nodes
     ])
@@ -115,12 +117,17 @@ def shard_solve_args(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
         t_matches=jax.device_put(np.asarray(aff.t_matches), replicated),
         t_soft=jax.device_put(np.asarray(aff.t_soft), replicated),
     )
-    return (
+    out = (
         nodes, rep(tasks), rep(jobs), rep(queues), rep(weights),
         jax.device_put(np.asarray(eps), replicated),
         jax.device_put(np.asarray(scalar_slot), replicated),
         aff,
     )
+    if node_bias is not None:
+        out = out + (
+            jax.device_put(np.asarray(node_bias), node_sharded),
+        )
+    return out
 
 
 def sharded_solve(mesh: Mesh, solve_args: Sequence, axis: str = NODES_AXIS):
@@ -192,7 +199,13 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
     replicated = NamedSharding(mesh, P())
     col_sharded = NamedSharding(mesh, P(None, axis))
 
-    nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = solve_args
+    # The slim fast path appends a 9th element (the [N] f32 topology
+    # node-order bias, ops/topology.contig_bias) only when a fabric
+    # constraint is live; it shards with the node axis like any other
+    # node plane.  The 8-tuple form stays byte-identical to before.
+    node_bias = solve_args[8] if len(solve_args) > 8 else None
+    nodes, tasks, jobs, queues, weights, eps, scalar_slot, aff = \
+        solve_args[:8]
     idle_in = nodes.idle
     n_nodes = int(idle_in.shape[0] if hasattr(idle_in, "shape")
                   else np.asarray(idle_in).shape[0])
@@ -294,6 +307,8 @@ def shard_wave_inputs(mesh: Mesh, solve_args: Sequence, pid, profiles,
         jax.device_put(np.asarray(scalar_slot), replicated),
         aff,
     )
+    if node_bias is not None:
+        args = args + (put_node(node_bias),)
     pid = jax.device_put(np.asarray(pid), replicated)
     if node_classes is not None:
         # Two-phase planes: the [N] class_id shards with the node axis

@@ -12,9 +12,45 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .api import GROUP_NAME_ANNOTATION, Node, Pod, PodGroup, Queue, TaskStatus
+from .api import (
+    FABRIC_HOST,
+    FABRIC_RACK,
+    FABRIC_SLICE,
+    GROUP_NAME_ANNOTATION,
+    Node,
+    Pod,
+    PodGroup,
+    Queue,
+    TaskStatus,
+)
 from .arrays import ResourceSlots, encode_cluster
 from .cache import ClusterStore
+
+
+def fabric_labels(
+    i: int,
+    *,
+    nodes_per_host: int = 2,
+    hosts_per_slice: int = 8,
+    slices_per_rack: int = 4,
+) -> dict:
+    """Deterministic fabric-coordinate labels for node index ``i``.
+
+    Maps the flat node index onto a rack/slice/host hierarchy (ISSUE
+    20: ``fabric.volcano-tpu/*``) — nodes_per_host chips per host
+    board, hosts_per_slice hosts per ICI slice, slices_per_rack slices
+    per rack.  Slice and host ids are GLOBAL (not per-rack), so every
+    (rack, slice) pair the mirror interns is unique and the block
+    table stays 1:1 with physical slices.
+    """
+    host = i // max(nodes_per_host, 1)
+    slc = host // max(hosts_per_slice, 1)
+    rack = slc // max(slices_per_rack, 1)
+    return {
+        FABRIC_RACK: f"rack-{rack}",
+        FABRIC_SLICE: f"slice-{slc}",
+        FABRIC_HOST: f"host-{host}",
+    }
 
 
 def synthetic_cluster(
@@ -146,7 +182,11 @@ def tier_cluster(
     Pods carry no labels/affinity — the tier measures the solve's
     scale envelope (fit/score/ranking over 100k nodes x 1M rows); the
     affinity mix rides the existing hyperscale config.  Nodes spread
-    over ``zones`` zone labels so node classes stay > 1.
+    over ``zones`` zone labels so node classes stay > 1, and carry
+    deterministic ``fabric.volcano-tpu/*`` coordinates (ISSUE 20) so
+    the tier and the endurance harness exercise the topology planes.
+    Fabric labels are never *queried* by any pod, so they add no label
+    bits and leave node classes untouched.
     """
     import gc
 
@@ -154,12 +194,15 @@ def tier_cluster(
     store = ClusterStore()
     zone_labels = [{"zone": f"zone-{z}"} for z in range(max(zones, 1))]
     for i in range(n_nodes):
+        labels = dict(fabric_labels(i))
+        if zones:
+            labels.update(zone_labels[i % len(zone_labels)])
         store.add_node(
             Node(
                 name=f"node-{i:06d}",
                 allocatable={"cpu": node_cpu, "memory": node_mem,
                              "pods": 256},
-                labels=zone_labels[i % len(zone_labels)] if zones else {},
+                labels=labels,
             )
         )
     for q in range(1, n_queues):
@@ -200,6 +243,92 @@ def tier_cluster(
             pods_made += size
             g += 1
         gc.collect()
+    return store
+
+
+def fabric_cluster(
+    racks: int = 2,
+    slices_per_rack: int = 2,
+    nodes_per_slice: int = 16,
+    hosts_per_slice: int = 8,
+    node_cpu: str = "4",
+    node_mem: str = "16Gi",
+    filler_cpu: str = "3",
+    filler_mem: str = "1Gi",
+    fillers_per_slice: int = 2,
+    gang_tasks: int = 32,
+    gang_cpu: str = "2",
+    gang_mem: str = "1Gi",
+    topology: str = "require-contiguous",
+    binder=None,
+) -> ClusterStore:
+    """A fragmented fabric no single block can host a gang on (ISSUE 20).
+
+    ``racks x slices_per_rack`` ICI slices of ``nodes_per_slice`` nodes
+    each, labeled with deterministic ``fabric.volcano-tpu/*``
+    coordinates.  Every slice carries ``fillers_per_slice`` Running
+    single-member filler pods (each its own PodGroup, so disruption
+    budgets bite per filler) sized to strand their nodes for the gang's
+    profile; the pending gang carries the ``topology`` constraint.
+
+    At the defaults the arithmetic is the acceptance shape: each slice
+    has 14 free 4-cpu nodes -> 28 two-cpu task slots < 32, so a
+    require-contiguous 32-task gang is topology-infeasible everywhere,
+    while total free capacity (4 x 28 = 112) would place it scattered.
+    Draining one slice's two fillers frees the full 16-node block; the
+    evicted fillers re-place on any other slice's free nodes.
+    """
+    from .api import PodPhase, PriorityClass
+
+    store = ClusterStore(binder=binder)
+    store.add_priority_class(PriorityClass(name="fabric-high", value=100))
+    nodes_per_host = max(nodes_per_slice // max(hosts_per_slice, 1), 1)
+    n_nodes = racks * slices_per_rack * nodes_per_slice
+    for i in range(n_nodes):
+        store.add_node(
+            Node(
+                name=f"fab-{i:04d}",
+                allocatable={"cpu": node_cpu, "memory": node_mem,
+                             "pods": 110},
+                labels=fabric_labels(
+                    i,
+                    nodes_per_host=nodes_per_host,
+                    hosts_per_slice=hosts_per_slice,
+                    slices_per_rack=slices_per_rack,
+                ),
+            )
+        )
+    # Running fillers: the first fillers_per_slice nodes of EVERY
+    # slice, pre-bound so fragmentation is deterministic.
+    f = 0
+    for s in range(racks * slices_per_rack):
+        for k in range(fillers_per_slice):
+            ni = s * nodes_per_slice + k
+            store.add_pod_group(PodGroup(name=f"filler-{f:04d}",
+                                         min_member=1))
+            store.add_pod(
+                Pod(
+                    name=f"filler-{f:04d}-0",
+                    annotations={GROUP_NAME_ANNOTATION: f"filler-{f:04d}"},
+                    containers=[{"cpu": filler_cpu, "memory": filler_mem}],
+                    phase=PodPhase.Running,
+                    node_name=f"fab-{ni:04d}",
+                )
+            )
+            f += 1
+    pg = PodGroup(name="fabgang", min_member=gang_tasks,
+                  topology=topology, priority_class="fabric-high")
+    store.add_pod_group(pg)
+    for k in range(gang_tasks):
+        store.add_pod(
+            Pod(
+                name=f"fabgang-{k:03d}",
+                annotations={GROUP_NAME_ANNOTATION: pg.name},
+                containers=[{"cpu": gang_cpu, "memory": gang_mem}],
+                priority_class="fabric-high",
+                priority=100,
+            )
+        )
     return store
 
 
